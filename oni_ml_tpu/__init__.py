@@ -1,0 +1,11 @@
+"""oni_ml_tpu — a TPU-native suspicious-connects ML framework.
+
+A ground-up JAX/XLA re-design of the capabilities of ONI's ml component
+(rabarona/oni-ml): netflow/DNS featurization into per-IP bag-of-words
+corpora, distributed variational-EM LDA, and per-event probability scoring
+— with the reference's Spark/MPI/shell plumbing replaced by columnar
+host-side featurization, a sharded XLA EM engine (psum over ICI instead of
+MPI_Reduce), and on-device scoring.
+"""
+
+__version__ = "0.1.0"

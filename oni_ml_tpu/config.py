@@ -1,0 +1,93 @@
+"""Typed configuration — the single source of truth for every knob.
+
+The reference smears its constants across 6+ files (TOPIC_COUNT in
+ml_ops.sh:26, k=20 in lda_pre.py:11, hardcoded 20-wide fallbacks in
+flow_post_lda.scala:228-231 / dns_post_lda.scala:313-316, alpha=2.5 on the
+lda CLI at ml_ops.sh:80, DUPFACTOR at ml_ops.sh:31).  Here every one of
+those lives in exactly one dataclass field, and the scorer fallbacks are
+*derived* from num_topics instead of being 20 literal floats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class LDAConfig:
+    """Variational-EM LDA hyperparameters.
+
+    Defaults mirror the reference invocation ``lda est 2.5 20 settings.txt``
+    (ml_ops.sh:80) and Blei lda-c's stock settings.txt (var max iter 20,
+    var convergence 1e-6, em max iter 100, em convergence 1e-4, alpha
+    estimated).
+    """
+
+    num_topics: int = 20
+    alpha_init: float = 2.5
+    estimate_alpha: bool = True
+    em_max_iters: int = 100
+    em_tol: float = 1e-4
+    var_max_iters: int = 20
+    var_tol: float = 1e-6
+    # Device batching: documents per E-step batch (padded, bucketed by length).
+    batch_size: int = 1024
+    # Length buckets are powers of two starting here; docs pad up to the
+    # nearest bucket, which bounds the number of distinct compiled shapes.
+    min_bucket_len: int = 16
+    # Accumulate suff-stats / likelihood in f32 even if phi math runs lower.
+    compute_dtype: str = "float32"
+    seed: int = 0
+    # Checkpoint every N EM iterations (0 = disabled).
+    checkpoint_every: int = 0
+
+    @property
+    def k(self) -> int:
+        return self.num_topics
+
+
+@dataclass(frozen=True)
+class FeedbackConfig:
+    """Analyst feedback loop: non-threatening rows are replicated DUPFACTOR
+    times into the corpus so their probability rises above the threshold
+    (ml_ops.sh:31, flow_pre_lda.scala:253-268)."""
+
+    dup_factor: int = 1000
+    nonthreatening_severity: int = 3
+
+
+@dataclass(frozen=True)
+class ScoringConfig:
+    """Event scoring (flow_post_lda.scala:227-239, dns_post_lda.scala:312-321).
+
+    The reference hardcodes per-topic fallback vectors of 0.05 (flow) and
+    0.1 (dns) for unseen IPs/words; we keep the values but derive the width.
+    """
+
+    threshold: float = 1e-20
+    flow_fallback: float = 0.05
+    dns_fallback: float = 0.1
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """End-to-end run configuration (replaces /etc/duxbay.conf + env vars)."""
+
+    data_dir: str = "."            # per-day working directory (LPATH analogue)
+    flow_path: str = ""            # raw netflow CSV file/dir (FLOW_PATH)
+    dns_path: str = ""             # raw DNS CSV/parquet paths (DNS_PATH)
+    top_domains_path: str = ""     # Alexa top-1m.csv (dns_pre_lda.scala:62)
+    lda: LDAConfig = field(default_factory=LDAConfig)
+    feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
+    scoring: ScoringConfig = field(default_factory=ScoringConfig)
+    # Mesh shape: (data, model). data shards documents, model shards the
+    # vocabulary axis of beta.  (1, 1) = single device.
+    mesh_shape: tuple = (1, 1)
+
+    def day_dir(self, fdate: str) -> str:
+        return os.path.join(self.data_dir, fdate)
+
+    def replace(self, **kw) -> "PipelineConfig":
+        return dataclasses.replace(self, **kw)

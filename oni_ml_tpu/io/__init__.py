@@ -1,0 +1,4 @@
+from . import formats
+from .corpus import Batch, Corpus, make_batches
+
+__all__ = ["formats", "Corpus", "Batch", "make_batches"]

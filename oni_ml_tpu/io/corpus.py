@@ -1,0 +1,186 @@
+"""In-memory corpus: first-seen-order vocab/doc ids + CSR token arrays +
+padded/bucketed device batches.
+
+The reference builds its corpus in three sequential dict passes
+(lda_pre.py:30-94): word ids assigned in first-seen order over
+``doc_wc.dat``, doc ids 1-based in first-seen order.  That ordering is part
+of the file contract (words.dat / doc.dat line numbers are the join keys
+used by lda_post.py:57 linecache lookups), so ``from_word_counts``
+reproduces it exactly.
+
+TPU shape discipline: documents are power-law ragged, so we bucket docs by
+unique-word count into power-of-two length buckets and pad each bucket to a
+fixed batch size.  Every (batch_size, bucket_len) pair is one compiled XLA
+program; padding tokens carry count 0 and padding docs are masked, both of
+which are arithmetically inert in the E-step (phi * 0 = 0 contributions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from . import formats
+
+
+@dataclass
+class Corpus:
+    """Bag-of-words corpus in CSR layout.
+
+    doc_names[d] is the document key (an IP address in the reference's
+    pipelines); vocab[w] is the word string.  Token j of document d lives at
+    word_idx[doc_ptr[d]:doc_ptr[d+1]] with multiplicity counts[...].
+    """
+
+    doc_names: list[str]
+    vocab: list[str]
+    doc_ptr: np.ndarray  # [D+1] int64
+    word_idx: np.ndarray  # [NNZ] int32
+    counts: np.ndarray  # [NNZ] int32
+
+    @property
+    def num_docs(self) -> int:
+        return len(self.doc_ptr) - 1
+
+    @property
+    def num_terms(self) -> int:
+        return len(self.vocab)
+
+    @property
+    def num_tokens(self) -> int:
+        return int(self.counts.sum())
+
+    def doc_lengths(self) -> np.ndarray:
+        return np.diff(self.doc_ptr)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_word_counts(cls, triples: Iterable[tuple[str, str, int]]) -> "Corpus":
+        """Build from ``(ip, word, count)`` triples, assigning ids in
+        first-seen order exactly like lda_pre.py:30-77."""
+        word_ids: dict[str, int] = {}
+        doc_ids: dict[str, int] = {}
+        doc_tokens: list[list[tuple[int, int]]] = []
+        for ip, word, count in triples:
+            w = word_ids.setdefault(word, len(word_ids))
+            d = doc_ids.get(ip)
+            if d is None:
+                d = len(doc_ids)
+                doc_ids[ip] = d
+                doc_tokens.append([])
+            doc_tokens[d].append((w, count))
+
+        ptr = np.zeros(len(doc_tokens) + 1, dtype=np.int64)
+        for d, toks in enumerate(doc_tokens):
+            ptr[d + 1] = ptr[d] + len(toks)
+        widx = np.empty(int(ptr[-1]), dtype=np.int32)
+        cnts = np.empty(int(ptr[-1]), dtype=np.int32)
+        for d, toks in enumerate(doc_tokens):
+            lo = int(ptr[d])
+            for j, (w, c) in enumerate(toks):
+                widx[lo + j] = w
+                cnts[lo + j] = c
+        return cls(list(doc_ids), list(word_ids), ptr, widx, cnts)
+
+    @classmethod
+    def from_word_counts_file(cls, path: str) -> "Corpus":
+        return cls.from_word_counts(formats.read_word_counts(path))
+
+    @classmethod
+    def from_model_dat(
+        cls, path: str, words_path: str | None = None, docs_path: str | None = None
+    ) -> "Corpus":
+        ptr, widx, cnts = formats.read_model_dat(path)
+        vocab = formats.read_words_dat(words_path) if words_path else [
+            str(i) for i in range(int(widx.max()) + 1 if len(widx) else 0)
+        ]
+        docs = formats.read_doc_dat(docs_path) if docs_path else [
+            str(i + 1) for i in range(len(ptr) - 1)
+        ]
+        return cls(docs, vocab, ptr, widx, cnts)
+
+    # -- serialization (reference contracts) --------------------------------
+
+    def save(self, directory: str) -> None:
+        """Write words.dat / doc.dat / model.dat into ``directory``."""
+        import os
+
+        formats.write_words_dat(os.path.join(directory, "words.dat"), self.vocab)
+        formats.write_doc_dat(os.path.join(directory, "doc.dat"), self.doc_names)
+        formats.write_model_dat(
+            os.path.join(directory, "model.dat"), self.doc_ptr, self.word_idx, self.counts
+        )
+
+
+@dataclass
+class Batch:
+    """One padded device batch of documents.
+
+    word_idx[B, L] int32 (0 where padded), counts[B, L] f32 (0 where padded),
+    doc_index[B] int32 global doc ids (0 where padded), doc_mask[B] f32.
+    """
+
+    word_idx: np.ndarray
+    counts: np.ndarray
+    doc_index: np.ndarray
+    doc_mask: np.ndarray
+
+    @property
+    def batch_size(self) -> int:
+        return self.word_idx.shape[0]
+
+    @property
+    def bucket_len(self) -> int:
+        return self.word_idx.shape[1]
+
+
+def _bucket_len(n: int, min_bucket: int) -> int:
+    if min_bucket < 1:
+        raise ValueError(f"min_bucket_len must be >= 1, got {min_bucket}")
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return b
+
+
+def make_batches(
+    corpus: Corpus,
+    batch_size: int,
+    min_bucket_len: int = 16,
+    pad_batch_to_multiple: bool = True,
+) -> list[Batch]:
+    """Bucket docs by unique-word count, pad to (batch_size, bucket_len).
+
+    Returns batches ordered by bucket then position; the union of doc_index
+    over all batches (where doc_mask == 1) is exactly range(num_docs).
+    """
+    lengths = corpus.doc_lengths()
+    buckets: dict[int, list[int]] = {}
+    for d in range(corpus.num_docs):
+        # Empty docs (possible only via hand-built corpora) ride the smallest
+        # bucket; their zero counts make them inert anyway.
+        L = _bucket_len(max(int(lengths[d]), 1), min_bucket_len)
+        buckets.setdefault(L, []).append(d)
+
+    batches: list[Batch] = []
+    for L in sorted(buckets):
+        docs = buckets[L]
+        for start in range(0, len(docs), batch_size):
+            chunk = docs[start : start + batch_size]
+            B = batch_size if pad_batch_to_multiple else len(chunk)
+            widx = np.zeros((B, L), dtype=np.int32)
+            cnts = np.zeros((B, L), dtype=np.float32)
+            didx = np.zeros((B,), dtype=np.int32)
+            mask = np.zeros((B,), dtype=np.float32)
+            for i, d in enumerate(chunk):
+                lo, hi = int(corpus.doc_ptr[d]), int(corpus.doc_ptr[d + 1])
+                n = hi - lo
+                widx[i, :n] = corpus.word_idx[lo:hi]
+                cnts[i, :n] = corpus.counts[lo:hi]
+                didx[i] = d
+                mask[i] = 1.0
+            batches.append(Batch(widx, cnts, didx, mask))
+    return batches

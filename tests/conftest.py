@@ -1,0 +1,17 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-device tests (sharded suff-stats psum parity etc.) run on the CPU
+backend with 8 virtual devices, mirroring how the driver's
+``dryrun_multichip`` validates the sharded path without real chips.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep test numerics deterministic and f32-stable on CPU.
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,155 @@
+"""Golden-fixture tests for every stage-boundary file contract (SURVEY §1).
+
+The reference has zero tests; these pin the formats its stages exchange:
+word_counts triples, words.dat/doc.dat/model.dat (lda_pre.py), final.*
+(lda-c outputs), doc_results.csv/word_results.csv (lda_post.py).
+"""
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.io import Corpus, formats, make_batches
+
+TRIPLES = [
+    # first-seen order fixture: words w0..w3, docs ip1..ip3
+    ("10.0.0.1", "80.0_1.0_2.0_3.0", 5),
+    ("10.0.0.1", "333333.0_0.0_1.0_1.0", 2),
+    ("10.0.0.2", "80.0_1.0_2.0_3.0", 1),
+    ("10.0.0.2", "-1_443.0_5.0_5.0_2.0", 7),
+    ("10.0.0.3", "80.0_1.0_2.0_3.0", 3),
+    ("10.0.0.1", "53.0_9.0_9.0_4.0", 1),
+]
+
+
+def test_word_counts_roundtrip(tmp_path):
+    p = str(tmp_path / "doc_wc.dat")
+    formats.write_word_counts(p, TRIPLES)
+    assert list(formats.read_word_counts(p)) == TRIPLES
+
+
+def test_corpus_first_seen_order():
+    c = Corpus.from_word_counts(TRIPLES)
+    # words in first-seen order (lda_pre.py:38-41)
+    assert c.vocab == [
+        "80.0_1.0_2.0_3.0",
+        "333333.0_0.0_1.0_1.0",
+        "-1_443.0_5.0_5.0_2.0",
+        "53.0_9.0_9.0_4.0",
+    ]
+    # docs in first-seen order (lda_pre.py:66-73)
+    assert c.doc_names == ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+    assert c.num_docs == 3 and c.num_terms == 4
+    # doc 0 holds tokens (0,5) (1,2) (3,1) — appended across the stream
+    lo, hi = c.doc_ptr[0], c.doc_ptr[1]
+    assert list(c.word_idx[lo:hi]) == [0, 1, 3]
+    assert list(c.counts[lo:hi]) == [5, 2, 1]
+    assert c.num_tokens == 19
+
+
+def test_model_dat_golden(tmp_path):
+    c = Corpus.from_word_counts(TRIPLES)
+    c.save(str(tmp_path))
+    # exact LDA-C lines (lda_pre.py:84-94 writes "<N> w:c w:c ...")
+    assert (tmp_path / "model.dat").read_text() == (
+        "3 0:5 1:2 3:1\n" "2 0:1 2:7\n" "1 0:3\n"
+    )
+    assert (tmp_path / "words.dat").read_text().splitlines()[0] == "0,80.0_1.0_2.0_3.0"
+    # doc.dat is 1-based (lda_pre.py:60)
+    assert (tmp_path / "doc.dat").read_text().splitlines() == [
+        "1,10.0.0.1",
+        "2,10.0.0.2",
+        "3,10.0.0.3",
+    ]
+
+
+def test_model_dat_roundtrip(tmp_path):
+    c = Corpus.from_word_counts(TRIPLES)
+    c.save(str(tmp_path))
+    c2 = Corpus.from_model_dat(
+        str(tmp_path / "model.dat"),
+        str(tmp_path / "words.dat"),
+        str(tmp_path / "doc.dat"),
+    )
+    assert c2.vocab == c.vocab
+    assert c2.doc_names == c.doc_names
+    np.testing.assert_array_equal(c2.doc_ptr, c.doc_ptr)
+    np.testing.assert_array_equal(c2.word_idx, c.word_idx)
+    np.testing.assert_array_equal(c2.counts, c.counts)
+
+
+def test_beta_gamma_other_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    log_beta = np.log(rng.dirichlet(np.ones(7), size=3))  # K=3, V=7
+    gamma = rng.gamma(2.0, 1.0, size=(5, 3))
+    formats.write_beta(str(tmp_path / "final.beta"), log_beta)
+    formats.write_gamma(str(tmp_path / "final.gamma"), gamma)
+    formats.write_other(str(tmp_path / "final.other"), 3, 7, 2.5)
+    np.testing.assert_allclose(
+        formats.read_beta(str(tmp_path / "final.beta")), log_beta, atol=1e-9
+    )
+    np.testing.assert_allclose(
+        formats.read_gamma(str(tmp_path / "final.gamma")), gamma, atol=1e-9
+    )
+    other = formats.read_other(str(tmp_path / "final.other"))
+    assert other == {"num_topics": 3, "num_terms": 7, "alpha": 2.5}
+
+
+def test_likelihood_dat(tmp_path):
+    p = str(tmp_path / "likelihood.dat")
+    with open(p, "w") as f:
+        formats.append_likelihood(f, -1234.5678, 1.0)
+        formats.append_likelihood(f, -1200.0, 0.028)
+    arr = formats.read_likelihood(p)
+    assert arr.shape == (2, 2)
+    np.testing.assert_allclose(arr[:, 0], [-1234.5678, -1200.0])
+
+
+def test_doc_results_contract(tmp_path):
+    # normalized rows + the literal all-zeros row (lda_post.py:48-56)
+    gamma = np.array([[2.0, 2.0], [0.0, 0.0], [3.0, 1.0]])
+    p = str(tmp_path / "doc_results.csv")
+    formats.write_doc_results(p, ["a", "b", "c"], gamma)
+    lines = open(p).read().splitlines()
+    assert lines[0] == "a,0.5 0.5"
+    assert lines[1] == "b,0.0 0.0"
+    assert lines[2] == "c,0.75 0.25"
+    names, arr = formats.read_doc_results(p)
+    assert names == ["a", "b", "c"]
+    np.testing.assert_allclose(arr, [[0.5, 0.5], [0.0, 0.0], [0.75, 0.25]])
+
+
+def test_word_results_contract(tmp_path):
+    # per-topic exp+normalize then transpose to V x K (lda_post.py:87-96)
+    log_beta = np.log(
+        np.array([[0.5, 0.25, 0.25], [0.2, 0.2, 0.6]])
+    )  # K=2, V=3, rows already normalized
+    p = str(tmp_path / "word_results.csv")
+    formats.write_word_results(p, ["w0", "w1", "w2"], log_beta)
+    words, arr = formats.read_word_results(p)
+    assert words == ["w0", "w1", "w2"]
+    assert arr.shape == (3, 2)
+    np.testing.assert_allclose(arr, [[0.5, 0.2], [0.25, 0.2], [0.25, 0.6]], atol=1e-12)
+
+
+def test_make_batches_covers_all_docs():
+    rng = np.random.default_rng(1)
+    triples = []
+    for d in range(37):
+        n = int(rng.integers(1, 60))
+        for w in rng.choice(100, size=n, replace=False):
+            triples.append((f"ip{d}", f"w{w}", int(rng.integers(1, 5))))
+    c = Corpus.from_word_counts(triples)
+    batches = make_batches(c, batch_size=8, min_bucket_len=16)
+    seen = []
+    for b in batches:
+        assert b.word_idx.shape == (8, b.bucket_len)
+        assert b.bucket_len in (16, 32, 64)
+        seen.extend(b.doc_index[b.doc_mask == 1].tolist())
+        # padded rows are inert
+        assert (b.counts[b.doc_mask == 0] == 0).all()
+        # real rows keep their full token mass
+        for i in np.nonzero(b.doc_mask)[0]:
+            d = int(b.doc_index[i])
+            lo, hi = c.doc_ptr[d], c.doc_ptr[d + 1]
+            assert b.counts[i].sum() == c.counts[lo:hi].sum()
+    assert sorted(seen) == list(range(c.num_docs))
