@@ -1,0 +1,3 @@
+from .lda import LDAResult, LDATrainer, train_corpus
+
+__all__ = ["LDAResult", "LDATrainer", "train_corpus"]

@@ -1,0 +1,256 @@
+"""Variational-EM LDA trainer — the in-tree replacement for the
+reference's MPI `oni-lda-c` engine (SURVEY.md §2.8, ml_ops.sh:80).
+
+Reference contract reproduced here:
+- input: LDA-C corpus (`model.dat`), K topics, initial symmetric alpha,
+  `random` topic initialization;
+- outputs: `final.beta` (K x V log p(w|z)), `final.gamma` (D x K
+  unnormalized doc-topic Dirichlets), `final.other`, `likelihood.dat`
+  (one "<likelihood>\\t<convergence>" line per EM iteration, README.md:119);
+- EM loop: per-doc variational fixed point (E) -> MLE beta + Newton alpha
+  (M) until |Δℓ/ℓ| < em_tol.
+
+TPU-native design: documents ride padded length-bucketed batches
+(io/corpus.py); each (B, L) shape compiles once and the EM loop replays
+compiled programs.  Sufficient statistics accumulate on device in [V, K];
+the distributed variant (oni_ml_tpu/parallel) shards batches across the
+mesh's `data` axis and `psum`s the suff stats over ICI where the reference
+did an `MPI_Reduce` across 20 ranks.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import digamma, polygamma
+
+from ..config import LDAConfig
+from ..io import Batch, Corpus, formats, make_batches
+from ..ops import estep
+
+
+# ---------------------------------------------------------------------------
+# Newton update for the symmetric Dirichlet alpha (lda-c opt_alpha)
+# ---------------------------------------------------------------------------
+
+
+def _alpha_objective_grads(log_a: jnp.ndarray, ss: jnp.ndarray, d: int, k: int):
+    a = jnp.exp(log_a)
+    df = d * k * (digamma(k * a) - digamma(a)) + ss
+    d2f = d * k * k * polygamma(1, k * a) - d * k * polygamma(1, a)
+    return a, df, d2f
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def update_alpha(alpha_ss: jnp.ndarray, alpha_init: jnp.ndarray, d: int, k: int):
+    """Maximize L(a) = D(lgam(Ka) - K lgam(a)) + a * ss over the symmetric
+    Dirichlet parameter with Newton iterations in log space.
+
+    This is the standard lda-c `opt_alpha` scheme: iterate
+    log a <- log a - df / (d2f * a + df) from the current alpha, which is
+    Newton's method on the reparameterized objective and keeps a > 0.
+    """
+    ss = alpha_ss
+
+    def body(state):
+        log_a, _, it = state
+        a, df, d2f = _alpha_objective_grads(log_a, ss, d, k)
+        log_a_new = log_a - df / (d2f * a + df)
+        return log_a_new, jnp.abs(df), it + 1
+
+    def cond(state):
+        log_a, df_abs, it = state
+        return jnp.logical_and(it < 100, df_abs > 1e-5)
+
+    log_a0 = jnp.log(alpha_init)
+    log_a, _, _ = jax.lax.while_loop(
+        cond, body, (log_a0, jnp.asarray(jnp.inf, log_a0.dtype), jnp.asarray(0, jnp.int32))
+    )
+    a = jnp.exp(log_a)
+    # Guard divergence (lda-c restarts with alpha*10; we fall back to the
+    # previous value, which keeps EM monotone-safe).
+    bad = jnp.logical_or(jnp.isnan(a), jnp.logical_or(a <= 0, jnp.isinf(a)))
+    return jnp.where(bad, alpha_init, a)
+
+
+# ---------------------------------------------------------------------------
+# Trainer
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LDAResult:
+    log_beta: np.ndarray       # [K, V]
+    gamma: np.ndarray          # [D, K]
+    alpha: float
+    likelihoods: list = field(default_factory=list)  # [(ll, conv)] per EM iter
+    em_iters: int = 0
+
+    def save(
+        self,
+        directory: str,
+        num_terms: int | None = None,
+        include_likelihood: bool = True,
+    ) -> None:
+        """Write final.beta / final.gamma / final.other (and, unless the
+        trainer already streamed it, likelihood.dat) with the reference
+        formats (README.md:116-119)."""
+        k, v = self.log_beta.shape
+        formats.write_beta(os.path.join(directory, "final.beta"), self.log_beta)
+        formats.write_gamma(os.path.join(directory, "final.gamma"), self.gamma)
+        formats.write_other(
+            os.path.join(directory, "final.other"), k, num_terms or v, self.alpha
+        )
+        if include_likelihood:
+            with open(os.path.join(directory, "likelihood.dat"), "w") as f:
+                for ll, conv in self.likelihoods:
+                    formats.append_likelihood(f, ll, conv)
+
+
+def init_log_beta(key: jax.Array, k: int, v: int, dtype=jnp.float32) -> jnp.ndarray:
+    """`random` initialization per the reference CLI (ml_ops.sh:80):
+    uniform noise + 1/V, log-normalized per topic (lda-c random_initialize_ss)."""
+    noise = jax.random.uniform(key, (k, v), dtype=dtype) + 1.0 / v
+    return jnp.log(noise / noise.sum(-1, keepdims=True))
+
+
+class LDATrainer:
+    """Single-process EM driver over bucketed batches.
+
+    The `e_step_fn` hook lets the distributed layer substitute a wrapped
+    E-step (shard_map over the mesh's data axis, psum on the outputs)
+    without changing the math; see oni_ml_tpu/parallel.
+    """
+
+    def __init__(
+        self,
+        config: LDAConfig,
+        num_terms: int,
+        e_step_fn: Callable | None = None,
+    ):
+        self.config = config
+        self.num_terms = num_terms
+        base = e_step_fn or estep.e_step
+        self._e_step = jax.jit(
+            partial(
+                base,
+                var_max_iters=config.var_max_iters,
+                var_tol=config.var_tol,
+            )
+        )
+
+    def fit(
+        self,
+        batches: Sequence[Batch],
+        num_docs: int,
+        likelihood_file: str | None = None,
+        progress: Callable[[int, float, float], None] | None = None,
+        initial_log_beta: np.ndarray | None = None,
+        initial_alpha: float | None = None,
+    ) -> LDAResult:
+        """Run EM to convergence.  `initial_log_beta`/`initial_alpha` warm-
+        start the model (checkpoint resume, tests pinning the init); by
+        default beta gets the reference's `random` initialization."""
+        cfg = self.config
+        k, v = cfg.num_topics, self.num_terms
+        dtype = jnp.dtype(cfg.compute_dtype)
+        if initial_log_beta is not None:
+            log_beta = jnp.asarray(initial_log_beta, dtype)
+        else:
+            log_beta = init_log_beta(jax.random.PRNGKey(cfg.seed), k, v, dtype)
+        alpha = jnp.asarray(
+            cfg.alpha_init if initial_alpha is None else initial_alpha, dtype
+        )
+
+        dev_batches = [
+            (
+                jnp.asarray(b.word_idx),
+                jnp.asarray(b.counts, dtype),
+                jnp.asarray(b.doc_mask, dtype),
+            )
+            for b in batches
+        ]
+        doc_index = [b.doc_index for b in batches]
+        doc_masks = [b.doc_mask for b in batches]
+
+        gamma_out = np.zeros((num_docs, k), dtype=np.float64)
+        likelihoods: list[tuple[float, float]] = []
+        ll_file = open(likelihood_file, "w") if likelihood_file else None
+        ll_prev = None
+        it = 0
+        try:
+            for it in range(1, cfg.em_max_iters + 1):
+                total_ss = jnp.zeros((v, k), dtype)
+                total_ll = jnp.zeros((), dtype)
+                total_ass = jnp.zeros((), dtype)
+                gammas = []
+                for widx, cnts, mask in dev_batches:
+                    res = self._e_step(log_beta, alpha, widx, cnts, mask)
+                    total_ss = total_ss + res.suff_stats
+                    total_ll = total_ll + res.likelihood
+                    total_ass = total_ass + res.alpha_ss
+                    gammas.append(res.gamma)
+
+                log_beta = estep.m_step(total_ss)
+                if cfg.estimate_alpha:
+                    alpha = update_alpha(total_ass, alpha, num_docs, k)
+
+                ll = float(total_ll)
+                conv = (
+                    abs((ll_prev - ll) / ll_prev) if ll_prev is not None else 1.0
+                )
+                likelihoods.append((ll, conv))
+                if ll_file:
+                    formats.append_likelihood(ll_file, ll, conv)
+                    ll_file.flush()
+                if progress:
+                    progress(it, ll, conv)
+
+                if ll_prev is not None and conv < cfg.em_tol:
+                    break
+                ll_prev = ll
+        finally:
+            if ll_file:
+                ll_file.close()
+
+        # Device->host transfer of gamma once, from the final EM iteration.
+        for g, di, dm in zip(gammas, doc_index, doc_masks):
+            g = np.asarray(g, dtype=np.float64)
+            sel = dm == 1
+            gamma_out[di[sel]] = g[sel]
+
+        return LDAResult(
+            log_beta=np.asarray(log_beta, dtype=np.float64),
+            gamma=gamma_out,
+            alpha=float(alpha),
+            likelihoods=likelihoods,
+            em_iters=it,
+        )
+
+
+def train_corpus(
+    corpus: Corpus,
+    config: LDAConfig,
+    out_dir: str | None = None,
+    progress: Callable[[int, float, float], None] | None = None,
+) -> LDAResult:
+    """Convenience: corpus -> batches -> fit -> (optionally) reference
+    output files in `out_dir`."""
+    batches = make_batches(
+        corpus, batch_size=config.batch_size, min_bucket_len=config.min_bucket_len
+    )
+    trainer = LDATrainer(config, num_terms=corpus.num_terms)
+    ll_path = os.path.join(out_dir, "likelihood.dat") if out_dir else None
+    result = trainer.fit(
+        batches, corpus.num_docs, likelihood_file=ll_path, progress=progress
+    )
+    if out_dir:
+        # likelihood.dat was already streamed (crash-safe) during fit.
+        result.save(out_dir, num_terms=corpus.num_terms, include_likelihood=False)
+    return result

@@ -1,0 +1,3 @@
+from . import estep
+
+__all__ = ["estep"]

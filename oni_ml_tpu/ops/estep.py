@@ -1,0 +1,127 @@
+"""Batched variational E-step for LDA — the TPU replacement for the
+reference engine's per-document inner loop.
+
+The reference (oni-lda-c, reconstructed in SURVEY.md §2.8/§3.3) runs, per
+document, a phi/gamma coordinate-ascent fixed point:
+
+    phi_nk ∝ beta_{k,w_n} * exp(digamma(gamma_k))
+    gamma_k = alpha + sum_n c_n phi_nk
+
+Here that loop is vectorized over a padded batch of documents [B, L] using
+the matrix form of the same fixed point (Hoffman et al., "Online Learning
+for LDA", NIPS 2010): phi is never materialized per-k-per-token across
+iterations — each step needs only
+
+    phinorm[b,l] = sum_k expEt[b,k] * beta[k, w[b,l]]
+    gamma[b,k]   = alpha + expEt[b,k] * sum_l (c/phinorm)[b,l] * beta[k, w[b,l]]
+
+which is two batched matvecs against the gathered beta slab [B, L, K] —
+dense, static-shaped work that XLA maps onto the MXU/VPU.  Padding tokens
+carry count 0 and padded docs are masked, so both are arithmetically inert.
+
+Sufficient statistics are scattered into [V, K] with a segment-sum over the
+flattened token axis — the on-device analogue of the reference's
+`MPI_Reduce` of per-rank SS arrays (the cross-device part is a `psum` by
+the caller; see oni_ml_tpu/parallel).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+# Matches lda-c's floor for log beta of zero-mass words.
+LOG_ZERO = -100.0
+
+
+class EStepResult(NamedTuple):
+    gamma: jnp.ndarray        # [B, K] variational doc-topic posteriors
+    suff_stats: jnp.ndarray   # [V, K] expected word-topic counts
+    alpha_ss: jnp.ndarray     # scalar: sum_d sum_k E[log theta_dk]
+    likelihood: jnp.ndarray   # scalar: sum over real docs of the ELBO
+    vi_iters: jnp.ndarray     # scalar: fixed-point iterations used
+
+
+def _e_log_theta(gamma: jnp.ndarray) -> jnp.ndarray:
+    """E_q[log theta] = digamma(gamma_k) - digamma(sum_k gamma_k)."""
+    return digamma(gamma) - digamma(gamma.sum(-1, keepdims=True))
+
+
+def e_step(
+    log_beta: jnp.ndarray,   # [K, V] log p(word|topic)
+    alpha: jnp.ndarray,      # scalar symmetric Dirichlet prior
+    word_idx: jnp.ndarray,   # [B, L] int32, 0 where padded
+    counts: jnp.ndarray,     # [B, L] f32, 0 where padded
+    doc_mask: jnp.ndarray,   # [B] f32, 1 for real docs
+    var_max_iters: int,
+    var_tol: float,
+) -> EStepResult:
+    """Run the per-document fixed point to convergence for one batch."""
+    B, L = word_idx.shape
+    K, V = log_beta.shape
+    dtype = log_beta.dtype
+
+    # Gather the beta columns this batch touches: [B, L, K].
+    beta_bt = jnp.exp(log_beta).T[word_idx]
+
+    n_d = counts.sum(-1, keepdims=True)                  # [B, 1]
+    gamma0 = alpha + n_d / K * jnp.ones((B, K), dtype)   # lda-c init: alpha + N/k
+
+    def body(state):
+        gamma, _, it = state
+        exp_et = jnp.exp(_e_log_theta(gamma))                        # [B, K]
+        phinorm = jnp.einsum("blk,bk->bl", beta_bt, exp_et) + 1e-30  # [B, L]
+        gamma_new = alpha + exp_et * jnp.einsum(
+            "bl,blk->bk", counts / phinorm, beta_bt
+        )
+        delta = jnp.abs(gamma_new - gamma).mean(-1)                  # [B]
+        return gamma_new, (delta * doc_mask).max(), it + 1
+
+    def cond(state):
+        _, delta, it = state
+        return jnp.logical_and(it < var_max_iters, delta > var_tol)
+
+    gamma, _, iters = jax.lax.while_loop(
+        cond, body, (gamma0, jnp.asarray(jnp.inf, dtype), jnp.asarray(0, jnp.int32))
+    )
+
+    # Final phi-weighted quantities at the converged gamma.
+    exp_et = jnp.exp(_e_log_theta(gamma))
+    phinorm = jnp.einsum("blk,bk->bl", beta_bt, exp_et) + 1e-30
+    # Per-token topic loads phi[b,l,k] * c[b,l]:
+    phi_c = beta_bt * (counts / phinorm)[..., None] * exp_et[:, None, :]  # [B,L,K]
+    phi_c = phi_c * doc_mask[:, None, None]
+    suff = jax.ops.segment_sum(
+        phi_c.reshape(B * L, K), word_idx.reshape(B * L), num_segments=V
+    )                                                                      # [V, K]
+
+    # ELBO for the batch (SURVEY §2.8 reconstructed bound; beta is a point
+    # estimate in lda-c so there is no beta-prior term).  Using normalized
+    # E[log theta] inside phinorm makes sum_l c*log(phinorm) the collapsed
+    # token + z-entropy term.
+    gamma_sum = gamma.sum(-1)
+    e_lt = _e_log_theta(gamma)
+    doc_ll = (
+        (counts * jnp.log(phinorm)).sum(-1)
+        + gammaln(K * alpha)
+        - K * gammaln(alpha)
+        + ((alpha - gamma) * e_lt).sum(-1)
+        + gammaln(gamma).sum(-1)
+        - gammaln(gamma_sum)
+    )
+    likelihood = (doc_ll * doc_mask).sum()
+    alpha_ss = (e_lt.sum(-1) * doc_mask).sum()
+    return EStepResult(gamma, suff, alpha_ss, likelihood, iters)
+
+
+def m_step(suff_stats: jnp.ndarray) -> jnp.ndarray:
+    """MLE beta from accumulated word-topic suff stats [V, K] -> [K, V]
+    log-normalized per topic, with lda-c's -100 floor for zero mass."""
+    ss = suff_stats.T  # [K, V]
+    total = ss.sum(-1, keepdims=True)
+    return jnp.where(
+        ss > 0, jnp.log(jnp.maximum(ss, 1e-300)) - jnp.log(total), LOG_ZERO
+    )

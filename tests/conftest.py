@@ -7,7 +7,9 @@ backend with 8 virtual devices, mirroring how the driver's
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the session environment pins JAX_PLATFORMS=axon (the real
+# TPU tunnel); tests must be hermetic on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

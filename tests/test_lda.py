@@ -1,0 +1,155 @@
+"""LDA engine validation: math invariants + agreement with the slow NumPy
+oracle (tests/reference_lda.py) on small synthetic corpora."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from oni_ml_tpu.config import LDAConfig
+from oni_ml_tpu.io import Corpus, make_batches
+from oni_ml_tpu.models import LDATrainer, train_corpus
+from oni_ml_tpu.models.lda import init_log_beta, update_alpha
+from oni_ml_tpu.ops import estep
+
+import reference_lda as ref
+
+
+def corpus_from_docs(docs, num_terms):
+    """Identity-vocab corpus so ids line up with the NumPy oracle."""
+    ptr = [0]
+    widx, cnts = [], []
+    for words, counts in docs:
+        widx.extend(words.tolist())
+        cnts.extend(counts.tolist())
+        ptr.append(len(widx))
+    return Corpus(
+        doc_names=[f"ip{d}" for d in range(len(docs))],
+        vocab=[f"w{i}" for i in range(num_terms)],
+        doc_ptr=np.asarray(ptr, np.int64),
+        word_idx=np.asarray(widx, np.int32),
+        counts=np.asarray(cnts, np.int32),
+    )
+
+
+@pytest.fixture(scope="module")
+def small_problem():
+    docs, _ = ref.make_synthetic_corpus(num_docs=24, num_terms=30, num_topics=3,
+                                        seed=7)
+    V, K = 30, 4
+    rng = np.random.default_rng(3)
+    noise = rng.uniform(size=(K, V)) + 1.0 / V
+    log_beta = np.log(noise / noise.sum(-1, keepdims=True))
+    return docs, V, K, log_beta
+
+
+def test_e_step_matches_numpy(small_problem):
+    docs, V, K, log_beta = small_problem
+    alpha = 2.5
+    corpus = corpus_from_docs(docs, V)
+    batches = make_batches(corpus, batch_size=32, min_bucket_len=64)
+    assert len(batches) == 1
+    b = batches[0]
+    res = estep.e_step(
+        jnp.asarray(log_beta, jnp.float32),
+        jnp.float32(alpha),
+        jnp.asarray(b.word_idx),
+        jnp.asarray(b.counts),
+        jnp.asarray(b.doc_mask),
+        var_max_iters=50,
+        var_tol=1e-8,
+    )
+    # oracle, doc by doc
+    ss_ref = np.zeros((K, V))
+    ll_ref = 0.0
+    gamma_ref = np.zeros((len(docs), K))
+    for d, (w, c) in enumerate(docs):
+        g, phi, ll = ref.e_step_doc(log_beta, alpha, w, c.astype(np.float64),
+                                    var_max_iters=50, var_tol=1e-8)
+        gamma_ref[d] = g
+        ll_ref += ll
+        np.add.at(ss_ref.T, w, phi * c[:, None])
+
+    gamma = np.asarray(res.gamma)[np.asarray(b.doc_mask) == 1]
+    order = np.argsort(b.doc_index[b.doc_mask == 1])
+    np.testing.assert_allclose(gamma[order], gamma_ref, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(res.suff_stats).T, ss_ref,
+                               rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(float(res.likelihood), ll_ref, rtol=1e-4)
+
+
+def test_em_matches_numpy(small_problem):
+    docs, V, K, log_beta0 = small_problem
+    corpus = corpus_from_docs(docs, V)
+    cfg = LDAConfig(num_topics=K, alpha_init=2.5, estimate_alpha=False,
+                    em_max_iters=6, em_tol=0.0, var_max_iters=30, var_tol=1e-7,
+                    batch_size=32, min_bucket_len=64)
+    batches = make_batches(corpus, cfg.batch_size, cfg.min_bucket_len)
+
+    trainer = LDATrainer(cfg, num_terms=V)
+    result = trainer.fit(batches, corpus.num_docs, initial_log_beta=log_beta0)
+
+    oracle = ref.em(docs, V, K, alpha=2.5, em_max_iters=6, em_tol=0.0,
+                    var_max_iters=30, var_tol=1e-7, init_log_beta=log_beta0)
+    # likelihood trajectories agree
+    np.testing.assert_allclose(
+        [l for l, _ in result.likelihoods], oracle["likelihoods"], rtol=1e-3)
+    # final topics agree (compare in probability space; log space is
+    # dominated by the -100 floor of untouched words)
+    np.testing.assert_allclose(
+        np.exp(result.log_beta), np.exp(oracle["log_beta"]), atol=2e-3)
+    np.testing.assert_allclose(result.gamma, oracle["gamma"], rtol=5e-3,
+                               atol=5e-3)
+
+
+def test_invariants(small_problem):
+    docs, V, K, _ = small_problem
+    corpus = corpus_from_docs(docs, V)
+    cfg = LDAConfig(num_topics=K, em_max_iters=12, em_tol=0.0,
+                    batch_size=16, min_bucket_len=16, seed=5)
+    result = train_corpus(corpus, cfg)
+    # rows of exp(beta) sum to 1
+    np.testing.assert_allclose(np.exp(result.log_beta).sum(-1), np.ones(K),
+                               rtol=1e-4)
+    # gamma strictly positive, every doc row written
+    assert (result.gamma > 0).all()
+    # likelihood non-decreasing (tiny f32 wiggle allowed)
+    lls = np.array([l for l, _ in result.likelihoods])
+    assert (np.diff(lls) > -abs(lls[0]) * 1e-5).all(), lls
+    # alpha stayed positive and finite under Newton updates
+    assert np.isfinite(result.alpha) and result.alpha > 0
+
+
+def test_alpha_newton_finds_maximum():
+    # compare against brute-force maximization of the objective
+    from scipy.special import gammaln as g
+    D, K = 100, 20
+    rng = np.random.default_rng(0)
+    # plausible ss: sum over docs of sum_k E[log theta].  Must satisfy
+    # ss < -D*K*log(K) (~ -5991 here) for a finite maximizer to exist;
+    # a symmetric-Dirichlet corpus gives ss ~ D*K*(digamma(a)-digamma(Ka)).
+    ss = -float(rng.uniform(6500, 9000))
+
+    def obj(a):
+        return D * (g(K * a) - K * g(a)) + a * ss
+
+    grid = np.linspace(0.01, 10, 20000)
+    best = grid[np.argmax([obj(a) for a in grid])]
+    a_hat = float(update_alpha(jnp.float32(ss), jnp.float32(1.0), D, K))
+    assert abs(a_hat - best) < 5e-3, (a_hat, best)
+
+
+def test_train_corpus_writes_reference_files(tmp_path, small_problem):
+    docs, V, K, _ = small_problem
+    corpus = corpus_from_docs(docs, V)
+    cfg = LDAConfig(num_topics=K, em_max_iters=3, em_tol=0.0, batch_size=16,
+                    min_bucket_len=16)
+    result = train_corpus(corpus, cfg, out_dir=str(tmp_path))
+    from oni_ml_tpu.io import formats
+    lb = formats.read_beta(str(tmp_path / "final.beta"))
+    gm = formats.read_gamma(str(tmp_path / "final.gamma"))
+    other = formats.read_other(str(tmp_path / "final.other"))
+    ll = formats.read_likelihood(str(tmp_path / "likelihood.dat"))
+    assert lb.shape == (K, V) and gm.shape == (corpus.num_docs, K)
+    assert other["num_topics"] == K and other["num_terms"] == V
+    assert ll.shape == (3, 2)
+    np.testing.assert_allclose(lb, result.log_beta, atol=1e-9)
