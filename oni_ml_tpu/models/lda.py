@@ -133,9 +133,19 @@ class LDATrainer:
         config: LDAConfig,
         num_terms: int,
         e_step_fn: Callable | None = None,
+        m_step_fn: Callable | None = None,
+        mesh=None,
+        vocab_sharded: bool = False,
     ):
+        """When `mesh` is set, batches are device_put ONCE with the
+        data-axis layout (and beta with the vocab-sharded layout if
+        requested) — without this, every EM iteration re-shards the
+        host-committed arrays, and on multi-host meshes the computation
+        would fail outright on non-addressable devices."""
         self.config = config
         self.num_terms = num_terms
+        self.mesh = mesh
+        self.vocab_sharded = vocab_sharded
         base = e_step_fn or estep.e_step
         self._e_step = jax.jit(
             partial(
@@ -144,6 +154,7 @@ class LDATrainer:
                 var_tol=config.var_tol,
             )
         )
+        self._m_step = jax.jit(m_step_fn or estep.m_step)
 
     def fit(
         self,
@@ -167,12 +178,31 @@ class LDATrainer:
         alpha = jnp.asarray(
             cfg.alpha_init if initial_alpha is None else initial_alpha, dtype
         )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+            if self.vocab_sharded:
+                log_beta = jax.device_put(
+                    log_beta, NamedSharding(self.mesh, P(None, MODEL_AXIS))
+                )
+
+            def put(x):
+                spec = P(DATA_AXIS, *(None,) * (np.ndim(x) - 1))
+                return jax.device_put(
+                    jnp.asarray(x), NamedSharding(self.mesh, spec)
+                )
+
+        else:
+
+            def put(x):
+                return jnp.asarray(x)
 
         dev_batches = [
             (
-                jnp.asarray(b.word_idx),
-                jnp.asarray(b.counts, dtype),
-                jnp.asarray(b.doc_mask, dtype),
+                put(b.word_idx),
+                put(b.counts.astype(dtype)),
+                put(b.doc_mask.astype(dtype)),
             )
             for b in batches
         ]
@@ -197,7 +227,7 @@ class LDATrainer:
                     total_ass = total_ass + res.alpha_ss
                     gammas.append(res.gamma)
 
-                log_beta = estep.m_step(total_ss)
+                log_beta = self._m_step(total_ss)
                 if cfg.estimate_alpha:
                     alpha = update_alpha(total_ass, alpha, num_docs, k)
 
@@ -239,17 +269,80 @@ def train_corpus(
     config: LDAConfig,
     out_dir: str | None = None,
     progress: Callable[[int, float, float], None] | None = None,
+    mesh=None,
+    vocab_sharded: bool = False,
 ) -> LDAResult:
     """Convenience: corpus -> batches -> fit -> (optionally) reference
-    output files in `out_dir`."""
+    output files in `out_dir`.
+
+    With `mesh`, documents shard over the mesh's `data` axis (suff-stats
+    psum over ICI — the reference's MPI_Reduce, SURVEY §2.8); with
+    `vocab_sharded` additionally, beta/suff-stats shard their vocabulary
+    axis over `model` (BASELINE.json config 4).
+    """
+    e_fn = m_fn = None
+    num_terms = corpus.num_terms
+    initial_log_beta = None
+    if vocab_sharded and mesh is None:
+        raise ValueError("vocab_sharded=True requires a mesh")
+    if mesh is not None:
+        from ..parallel import sharded
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        if config.batch_size % mesh.shape[DATA_AXIS]:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by data axis "
+                f"{mesh.shape[DATA_AXIS]}"
+            )
+        if not vocab_sharded and mesh.shape[MODEL_AXIS] > 1:
+            import warnings
+
+            warnings.warn(
+                f"mesh has model axis {mesh.shape[MODEL_AXIS]} but "
+                "vocab_sharded=False: those devices will replicate work",
+                stacklevel=2,
+            )
+        if vocab_sharded:
+            e_fn, m_fn = sharded.make_vocab_sharded_fns(mesh)
+            num_terms = sharded.pad_vocab(corpus.num_terms, mesh.shape[MODEL_AXIS])
+            if num_terms != corpus.num_terms:
+                # Pad init with LOG_ZERO columns so padded words carry ~no
+                # mass and single- vs multi-device runs agree numerically.
+                base = init_log_beta(
+                    jax.random.PRNGKey(config.seed),
+                    config.num_topics,
+                    corpus.num_terms,
+                    jnp.dtype(config.compute_dtype),
+                )
+                initial_log_beta = jnp.pad(
+                    base,
+                    ((0, 0), (0, num_terms - corpus.num_terms)),
+                    constant_values=estep.LOG_ZERO,
+                )
+        else:
+            e_fn = sharded.make_data_parallel_e_step(mesh)
+
     batches = make_batches(
         corpus, batch_size=config.batch_size, min_bucket_len=config.min_bucket_len
     )
-    trainer = LDATrainer(config, num_terms=corpus.num_terms)
+    trainer = LDATrainer(
+        config,
+        num_terms=num_terms,
+        e_step_fn=e_fn,
+        m_step_fn=m_fn,
+        mesh=mesh,
+        vocab_sharded=vocab_sharded,
+    )
     ll_path = os.path.join(out_dir, "likelihood.dat") if out_dir else None
     result = trainer.fit(
-        batches, corpus.num_docs, likelihood_file=ll_path, progress=progress
+        batches,
+        corpus.num_docs,
+        likelihood_file=ll_path,
+        progress=progress,
+        initial_log_beta=initial_log_beta,
     )
+    if num_terms != corpus.num_terms:
+        result.log_beta = result.log_beta[:, : corpus.num_terms]
     if out_dir:
         # likelihood.dat was already streamed (crash-safe) during fit.
         result.save(out_dir, num_terms=corpus.num_terms, include_likelihood=False)

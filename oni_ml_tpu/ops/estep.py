@@ -9,8 +9,8 @@ document, a phi/gamma coordinate-ascent fixed point:
 
 Here that loop is vectorized over a padded batch of documents [B, L] using
 the matrix form of the same fixed point (Hoffman et al., "Online Learning
-for LDA", NIPS 2010): phi is never materialized per-k-per-token across
-iterations — each step needs only
+for LDA", NIPS 2010): phi is never materialized across iterations — each
+step needs only
 
     phinorm[b,l] = sum_k expEt[b,k] * beta[k, w[b,l]]
     gamma[b,k]   = alpha + expEt[b,k] * sum_l (c/phinorm)[b,l] * beta[k, w[b,l]]
@@ -23,6 +23,11 @@ Sufficient statistics are scattered into [V, K] with a segment-sum over the
 flattened token axis — the on-device analogue of the reference's
 `MPI_Reduce` of per-rank SS arrays (the cross-device part is a `psum` by
 the caller; see oni_ml_tpu/parallel).
+
+The building blocks (`gather_beta`, `fixed_point`, `suff_stats`,
+`batch_likelihood`) are exposed separately so the distributed layer can
+recompose them — e.g. building the beta slab with a psum over a
+vocab-sharded beta — without duplicating any math.
 """
 
 from __future__ import annotations
@@ -50,23 +55,22 @@ def _e_log_theta(gamma: jnp.ndarray) -> jnp.ndarray:
     return digamma(gamma) - digamma(gamma.sum(-1, keepdims=True))
 
 
-def e_step(
-    log_beta: jnp.ndarray,   # [K, V] log p(word|topic)
-    alpha: jnp.ndarray,      # scalar symmetric Dirichlet prior
-    word_idx: jnp.ndarray,   # [B, L] int32, 0 where padded
-    counts: jnp.ndarray,     # [B, L] f32, 0 where padded
-    doc_mask: jnp.ndarray,   # [B] f32, 1 for real docs
+def gather_beta(log_beta: jnp.ndarray, word_idx: jnp.ndarray) -> jnp.ndarray:
+    """[K, V] log beta + [B, L] word ids -> [B, L, K] probability slab."""
+    return jnp.exp(log_beta).T[word_idx]
+
+
+def fixed_point(
+    beta_bt: jnp.ndarray,    # [B, L, K] gathered beta
+    alpha: jnp.ndarray,      # scalar
+    counts: jnp.ndarray,     # [B, L]
+    doc_mask: jnp.ndarray,   # [B]
     var_max_iters: int,
     var_tol: float,
-) -> EStepResult:
-    """Run the per-document fixed point to convergence for one batch."""
-    B, L = word_idx.shape
-    K, V = log_beta.shape
-    dtype = log_beta.dtype
-
-    # Gather the beta columns this batch touches: [B, L, K].
-    beta_bt = jnp.exp(log_beta).T[word_idx]
-
+):
+    """Per-document gamma fixed point.  Returns (gamma [B, K], iters)."""
+    B, L, K = beta_bt.shape
+    dtype = beta_bt.dtype
     n_d = counts.sum(-1, keepdims=True)                  # [B, 1]
     gamma0 = alpha + n_d / K * jnp.ones((B, K), dtype)   # lda-c init: alpha + N/k
 
@@ -77,32 +81,52 @@ def e_step(
         gamma_new = alpha + exp_et * jnp.einsum(
             "bl,blk->bk", counts / phinorm, beta_bt
         )
-        delta = jnp.abs(gamma_new - gamma).mean(-1)                  # [B]
-        return gamma_new, (delta * doc_mask).max(), it + 1
+        delta = jnp.abs(gamma_new - gamma).mean(-1) * doc_mask       # [B]
+        return gamma_new, delta, it + 1
 
     def cond(state):
         _, delta, it = state
-        return jnp.logical_and(it < var_max_iters, delta > var_tol)
+        return jnp.logical_and(it < var_max_iters, delta.max() > var_tol)
 
+    # The per-doc delta carry is derived from `counts` (not a fresh
+    # constant) so that under shard_map its varying-axes type matches the
+    # body output; each device shard then iterates until its own docs
+    # converge — no cross-shard sync inside the loop.
+    delta0 = counts[:, 0] * 0.0 + jnp.asarray(jnp.inf, dtype)
     gamma, _, iters = jax.lax.while_loop(
-        cond, body, (gamma0, jnp.asarray(jnp.inf, dtype), jnp.asarray(0, jnp.int32))
+        cond, body, (gamma0, delta0, jnp.asarray(0, jnp.int32))
     )
+    return gamma, iters
 
-    # Final phi-weighted quantities at the converged gamma.
+
+def phi_weighted(beta_bt, gamma, counts, doc_mask):
+    """Converged per-token quantities.
+
+    Returns (phi_c [B, L, K], phinorm [B, L]) where phi_c[b,l,k] is
+    phi[b,l,k] * counts[b,l], masked to real docs.
+    """
     exp_et = jnp.exp(_e_log_theta(gamma))
     phinorm = jnp.einsum("blk,bk->bl", beta_bt, exp_et) + 1e-30
-    # Per-token topic loads phi[b,l,k] * c[b,l]:
-    phi_c = beta_bt * (counts / phinorm)[..., None] * exp_et[:, None, :]  # [B,L,K]
-    phi_c = phi_c * doc_mask[:, None, None]
-    suff = jax.ops.segment_sum(
-        phi_c.reshape(B * L, K), word_idx.reshape(B * L), num_segments=V
-    )                                                                      # [V, K]
+    phi_c = beta_bt * (counts / phinorm)[..., None] * exp_et[:, None, :]
+    return phi_c * doc_mask[:, None, None], phinorm
 
-    # ELBO for the batch (SURVEY §2.8 reconstructed bound; beta is a point
-    # estimate in lda-c so there is no beta-prior term).  Using normalized
-    # E[log theta] inside phinorm makes sum_l c*log(phinorm) the collapsed
-    # token + z-entropy term.
-    gamma_sum = gamma.sum(-1)
+
+def suff_stats(phi_c: jnp.ndarray, word_idx: jnp.ndarray, num_segments: int):
+    """Scatter phi-weighted counts into [num_segments, K]."""
+    B, L, K = phi_c.shape
+    return jax.ops.segment_sum(
+        phi_c.reshape(B * L, K), word_idx.reshape(B * L), num_segments=num_segments
+    )
+
+
+def batch_likelihood(gamma, phinorm, counts, alpha, doc_mask):
+    """ELBO summed over real docs + alpha suff stats (sum E[log theta]).
+
+    Uses the collapsed form: sum_l c*log(phinorm) absorbs the token term
+    and the z-entropy; beta is a point estimate in lda-c so there is no
+    beta-prior term (SURVEY §2.8).
+    """
+    K = gamma.shape[-1]
     e_lt = _e_log_theta(gamma)
     doc_ll = (
         (counts * jnp.log(phinorm)).sum(-1)
@@ -110,10 +134,30 @@ def e_step(
         - K * gammaln(alpha)
         + ((alpha - gamma) * e_lt).sum(-1)
         + gammaln(gamma).sum(-1)
-        - gammaln(gamma_sum)
+        - gammaln(gamma.sum(-1))
     )
     likelihood = (doc_ll * doc_mask).sum()
     alpha_ss = (e_lt.sum(-1) * doc_mask).sum()
+    return likelihood, alpha_ss
+
+
+def e_step(
+    log_beta: jnp.ndarray,   # [K, V] log p(word|topic)
+    alpha: jnp.ndarray,      # scalar symmetric Dirichlet prior
+    word_idx: jnp.ndarray,   # [B, L] int32, 0 where padded
+    counts: jnp.ndarray,     # [B, L] f32, 0 where padded
+    doc_mask: jnp.ndarray,   # [B] f32, 1 for real docs
+    var_max_iters: int,
+    var_tol: float,
+) -> EStepResult:
+    """Run the per-document fixed point to convergence for one batch."""
+    V = log_beta.shape[1]
+    beta_bt = gather_beta(log_beta, word_idx)
+    gamma, iters = fixed_point(beta_bt, alpha, counts, doc_mask,
+                               var_max_iters, var_tol)
+    phi_c, phinorm = phi_weighted(beta_bt, gamma, counts, doc_mask)
+    suff = suff_stats(phi_c, word_idx, V)
+    likelihood, alpha_ss = batch_likelihood(gamma, phinorm, counts, alpha, doc_mask)
     return EStepResult(gamma, suff, alpha_ss, likelihood, iters)
 
 

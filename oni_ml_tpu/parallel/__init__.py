@@ -1,0 +1,25 @@
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    beta_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicated,
+    vocab_sharding,
+)
+from .sharded import make_data_parallel_e_step, make_vocab_sharded_fns, pad_vocab
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "initialize_distributed",
+    "batch_sharding",
+    "beta_sharding",
+    "replicated",
+    "vocab_sharding",
+    "make_data_parallel_e_step",
+    "make_vocab_sharded_fns",
+    "pad_vocab",
+]

@@ -1,0 +1,159 @@
+"""Sharded E/M steps: shard_map wrappers over ops/estep building blocks.
+
+Two execution plans, both SPMD over the (data, model) mesh:
+
+1. **Data-parallel** (`make_data_parallel_e_step`) — the direct analogue
+   of the reference's 20-rank MPI document sharding (README.md:121):
+   batches shard over `data`, beta replicates, suff-stats/likelihood
+   `psum` over ICI.  This is the default whenever beta fits per device.
+
+2. **Vocab-sharded** (`make_vocab_sharded_fns`) — for huge-V corpora
+   (BASELINE.json config 4: high-cardinality DNS vocab).  beta [K, V] and
+   suff-stats [V, K] shard their vocabulary axis over `model`; each shard
+   gathers the beta slab for the tokens whose words it owns and a
+   `psum` over `model` assembles the full [B, L, K] slab (one collective
+   per batch — the slab, not beta, so HBM never holds another full copy).
+   The fixed point then runs identically on every model shard; suff-stats
+   scatter only into the locally-owned vocab slice.  The M-step
+   renormalizes with a `psum` of per-topic totals over `model`.
+
+Both plans compose: a (8, 4) mesh runs 8-way document parallelism with
+4-way vocabulary sharding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import estep
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+def make_data_parallel_e_step(mesh: Mesh):
+    """e_step-compatible callable: inputs batch-sharded over `data`,
+    outputs gamma sharded / reductions replicated."""
+
+    def local(log_beta, alpha, word_idx, counts, doc_mask, var_max_iters, var_tol):
+        res = estep.e_step(
+            log_beta, alpha, word_idx, counts, doc_mask, var_max_iters, var_tol
+        )
+        return estep.EStepResult(
+            gamma=res.gamma,
+            suff_stats=jax.lax.psum(res.suff_stats, DATA_AXIS),
+            alpha_ss=jax.lax.psum(res.alpha_ss, DATA_AXIS),
+            likelihood=jax.lax.psum(res.likelihood, DATA_AXIS),
+            vi_iters=jax.lax.pmax(res.vi_iters, DATA_AXIS),
+        )
+
+    def wrapped(log_beta, alpha, word_idx, counts, doc_mask,
+                var_max_iters, var_tol):
+        fn = jax.shard_map(
+            partial(local, var_max_iters=var_max_iters, var_tol=var_tol),
+            mesh=mesh,
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=estep.EStepResult(
+                gamma=P(DATA_AXIS),
+                suff_stats=P(),
+                alpha_ss=P(),
+                likelihood=P(),
+                vi_iters=P(),
+            ),
+        )
+        return fn(log_beta, alpha, word_idx, counts, doc_mask)
+
+    return wrapped
+
+
+def make_vocab_sharded_fns(mesh: Mesh):
+    """Returns (e_step_fn, m_step_fn) with beta/suff-stats vocab-sharded
+    over `model` and batches sharded over `data`.
+
+    Global shapes stay [K, V] / [V, K]; shard_map sees per-device slices
+    [K, V/m] / [V/m, K].  V must be divisible by the model-axis size
+    (pad the vocabulary — padded words never appear in word_idx, so their
+    suff-stats stay zero and m_step floors them to LOG_ZERO).
+    """
+    m = mesh.shape[MODEL_AXIS]
+
+    def local_e_step(log_beta_l, alpha, word_idx, counts, doc_mask,
+                     var_max_iters, var_tol):
+        K, v_local = log_beta_l.shape
+        shard = jax.lax.axis_index(MODEL_AXIS)
+        offset = shard * v_local
+        # Gather only locally-owned words, zero elsewhere; psum over the
+        # model axis assembles the full [B, L, K] slab.
+        local_idx = word_idx - offset
+        owned = (local_idx >= 0) & (local_idx < v_local)
+        safe_idx = jnp.clip(local_idx, 0, v_local - 1)
+        slab_l = estep.gather_beta(log_beta_l, safe_idx)   # [B, L, K]
+        slab_l = jnp.where(owned[..., None], slab_l, 0.0)
+        beta_bt = jax.lax.psum(slab_l, MODEL_AXIS)
+
+        gamma, iters = estep.fixed_point(
+            beta_bt, alpha, counts, doc_mask, var_max_iters, var_tol
+        )
+        phi_c, phinorm = estep.phi_weighted(beta_bt, gamma, counts, doc_mask)
+        # Scatter only into the owned vocab slice.
+        phi_c = jnp.where(owned[..., None], phi_c, 0.0)
+        ss_l = estep.suff_stats(phi_c, safe_idx, v_local)  # [V/m, K]
+        likelihood, alpha_ss = estep.batch_likelihood(
+            gamma, phinorm, counts, alpha, doc_mask
+        )
+        return estep.EStepResult(
+            gamma=gamma,
+            suff_stats=jax.lax.psum(ss_l, DATA_AXIS),
+            alpha_ss=jax.lax.psum(alpha_ss, DATA_AXIS),
+            likelihood=jax.lax.psum(likelihood, DATA_AXIS),
+            vi_iters=jax.lax.pmax(iters, DATA_AXIS),
+        )
+
+    def e_step_fn(log_beta, alpha, word_idx, counts, doc_mask,
+                  var_max_iters, var_tol):
+        if log_beta.shape[1] % m:
+            raise ValueError(
+                f"vocab size {log_beta.shape[1]} not divisible by model axis {m}"
+            )
+        fn = jax.shard_map(
+            partial(local_e_step, var_max_iters=var_max_iters, var_tol=var_tol),
+            mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=estep.EStepResult(
+                gamma=P(DATA_AXIS),
+                suff_stats=P(MODEL_AXIS, None),
+                alpha_ss=P(),
+                likelihood=P(),
+                vi_iters=P(),
+            ),
+        )
+        return fn(log_beta, alpha, word_idx, counts, doc_mask)
+
+    def local_m_step(ss_l):
+        # ss_l: [V/m, K].  Per-topic totals need the full vocab.
+        ss_t = ss_l.T                                   # [K, V/m]
+        total = jax.lax.psum(ss_t.sum(-1, keepdims=True), MODEL_AXIS)
+        return jnp.where(
+            ss_t > 0,
+            jnp.log(jnp.maximum(ss_t, 1e-300)) - jnp.log(total),
+            estep.LOG_ZERO,
+        )
+
+    def m_step_fn(suff):
+        fn = jax.shard_map(
+            local_m_step,
+            mesh=mesh,
+            in_specs=(P(MODEL_AXIS, None),),
+            out_specs=P(None, MODEL_AXIS),
+        )
+        return fn(suff)
+
+    return e_step_fn, m_step_fn
+
+
+def pad_vocab(v: int, model_size: int) -> int:
+    """Smallest padded vocab size divisible by the model axis."""
+    return -(-v // model_size) * model_size

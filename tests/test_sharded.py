@@ -1,0 +1,130 @@
+"""Multi-device parity: sharded E/M steps must reproduce the single-device
+engine on the 8-device virtual CPU mesh (SURVEY §4: "asserting sharded-vs-
+single-device ... equality of suff-stats psums")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oni_ml_tpu.config import LDAConfig
+from oni_ml_tpu.io import make_batches
+from oni_ml_tpu.models import LDATrainer, train_corpus
+from oni_ml_tpu.parallel import (
+    make_data_parallel_e_step,
+    make_mesh,
+    make_vocab_sharded_fns,
+    pad_vocab,
+)
+from oni_ml_tpu.ops import estep
+
+import reference_lda as ref
+from test_lda import corpus_from_docs
+
+
+@pytest.fixture(scope="module")
+def problem():
+    docs, _ = ref.make_synthetic_corpus(num_docs=48, num_terms=37, num_topics=3,
+                                        seed=11)
+    corpus = corpus_from_docs(docs, 37)
+    rng = np.random.default_rng(5)
+    K = 4
+    noise = rng.uniform(size=(K, 37)) + 1 / 37
+    log_beta = np.log(noise / noise.sum(-1, keepdims=True))
+    return corpus, K, log_beta
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8, jax.devices()
+
+
+def test_data_parallel_e_step_parity(problem):
+    corpus, K, log_beta = problem
+    mesh = make_mesh(data=8, model=1)
+    batches = make_batches(corpus, batch_size=64, min_bucket_len=64)
+    assert len(batches) == 1
+    b = batches[0]
+    args = (
+        jnp.asarray(log_beta, jnp.float32),
+        jnp.float32(2.5),
+        jnp.asarray(b.word_idx),
+        jnp.asarray(b.counts),
+        jnp.asarray(b.doc_mask),
+    )
+    single = estep.e_step(*args, var_max_iters=30, var_tol=1e-7)
+    fn = make_data_parallel_e_step(mesh)
+    sharded = jax.jit(
+        lambda *a: fn(*a, var_max_iters=30, var_tol=1e-7)
+    )(*args)
+    np.testing.assert_allclose(np.asarray(sharded.gamma), np.asarray(single.gamma),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sharded.suff_stats), np.asarray(single.suff_stats),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(sharded.likelihood), float(single.likelihood),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(sharded.alpha_ss), float(single.alpha_ss),
+                               rtol=1e-5)
+
+
+def test_vocab_sharded_e_step_parity(problem):
+    corpus, K, log_beta = problem
+    mesh = make_mesh(data=2, model=4)
+    V = corpus.num_terms
+    v_pad = pad_vocab(V, 4)
+    lb_pad = np.pad(log_beta, ((0, 0), (0, v_pad - V)),
+                    constant_values=estep.LOG_ZERO)
+    batches = make_batches(corpus, batch_size=64, min_bucket_len=64)
+    b = batches[0]
+    args = (
+        jnp.asarray(lb_pad, jnp.float32),
+        jnp.float32(2.5),
+        jnp.asarray(b.word_idx),
+        jnp.asarray(b.counts),
+        jnp.asarray(b.doc_mask),
+    )
+    single = estep.e_step(*args, var_max_iters=30, var_tol=1e-7)
+    e_fn, m_fn = make_vocab_sharded_fns(mesh)
+    sharded = jax.jit(
+        lambda *a: e_fn(*a, var_max_iters=30, var_tol=1e-7)
+    )(*args)
+    np.testing.assert_allclose(np.asarray(sharded.gamma), np.asarray(single.gamma),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sharded.suff_stats), np.asarray(single.suff_stats),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(sharded.likelihood), float(single.likelihood),
+                               rtol=1e-5)
+    # sharded m_step matches the dense one
+    lb_single = estep.m_step(single.suff_stats)
+    lb_sharded = jax.jit(m_fn)(sharded.suff_stats)
+    np.testing.assert_allclose(np.asarray(lb_sharded), np.asarray(lb_single),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mesh_shape,vocab_sharded", [
+    ((8, 1), False),
+    ((2, 4), True),
+])
+def test_full_training_parity(problem, mesh_shape, vocab_sharded):
+    corpus, K, log_beta = problem
+    cfg = LDAConfig(num_topics=K, em_max_iters=5, em_tol=0.0, batch_size=64,
+                    min_bucket_len=64, estimate_alpha=True, seed=9)
+    single = train_corpus(corpus, cfg)
+    mesh = make_mesh(data=mesh_shape[0], model=mesh_shape[1])
+    multi = train_corpus(corpus, cfg, mesh=mesh, vocab_sharded=vocab_sharded)
+    np.testing.assert_allclose(
+        [l for l, _ in multi.likelihoods], [l for l, _ in single.likelihoods],
+        rtol=1e-4)
+    np.testing.assert_allclose(np.exp(multi.log_beta), np.exp(single.log_beta),
+                               atol=1e-4)
+    np.testing.assert_allclose(multi.gamma, single.gamma, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(multi.alpha, single.alpha, rtol=1e-4)
+
+
+def test_batch_size_divisibility_guard(problem):
+    corpus, K, _ = problem
+    mesh = make_mesh(data=8, model=1)
+    cfg = LDAConfig(num_topics=K, batch_size=12)  # 12 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        train_corpus(corpus, cfg, mesh=mesh)
