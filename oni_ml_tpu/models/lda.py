@@ -179,19 +179,29 @@ class LDATrainer:
             cfg.alpha_init if initial_alpha is None else initial_alpha, dtype
         )
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+            from ..parallel.mesh import (
+                DATA_AXIS,
+                batch_sharding,
+                beta_sharding,
+                replicated,
+            )
 
-            if self.vocab_sharded:
-                log_beta = jax.device_put(
-                    log_beta, NamedSharding(self.mesh, P(None, MODEL_AXIS))
-                )
+            data_size = self.mesh.shape[DATA_AXIS]
+            for b in batches:
+                if b.word_idx.shape[0] % data_size:
+                    raise ValueError(
+                        f"batch of {b.word_idx.shape[0]} docs not divisible "
+                        f"by data axis {data_size}"
+                    )
+            log_beta = jax.device_put(
+                log_beta,
+                beta_sharding(self.mesh)
+                if self.vocab_sharded
+                else replicated(self.mesh),
+            )
 
             def put(x):
-                spec = P(DATA_AXIS, *(None,) * (np.ndim(x) - 1))
-                return jax.device_put(
-                    jnp.asarray(x), NamedSharding(self.mesh, spec)
-                )
+                return jax.device_put(jnp.asarray(x), batch_sharding(self.mesh))
 
         else:
 
@@ -250,13 +260,22 @@ class LDATrainer:
                 ll_file.close()
 
         # Device->host transfer of gamma once, from the final EM iteration.
+        # Arrays sharded over a multi-host mesh are not fully addressable
+        # from any one process, so gather before np.asarray.
+        def to_host(x):
+            if self.mesh is not None and not x.is_fully_addressable:
+                from jax.experimental import multihost_utils
+
+                x = multihost_utils.process_allgather(x, tiled=True)
+            return np.asarray(x, dtype=np.float64)
+
         for g, di, dm in zip(gammas, doc_index, doc_masks):
-            g = np.asarray(g, dtype=np.float64)
+            g = to_host(g)
             sel = dm == 1
             gamma_out[di[sel]] = g[sel]
 
         return LDAResult(
-            log_beta=np.asarray(log_beta, dtype=np.float64),
+            log_beta=to_host(log_beta),
             gamma=gamma_out,
             alpha=float(alpha),
             likelihoods=likelihoods,
@@ -290,6 +309,8 @@ def train_corpus(
         from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
 
         if config.batch_size % mesh.shape[DATA_AXIS]:
+            # fit() re-checks per batch; failing here gives the clearer
+            # message before any batching work happens.
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by data axis "
                 f"{mesh.shape[DATA_AXIS]}"

@@ -161,11 +161,15 @@ def e_step(
     return EStepResult(gamma, suff, alpha_ss, likelihood, iters)
 
 
-def m_step(suff_stats: jnp.ndarray) -> jnp.ndarray:
+def m_step(suff_stats: jnp.ndarray, topic_total=None) -> jnp.ndarray:
     """MLE beta from accumulated word-topic suff stats [V, K] -> [K, V]
-    log-normalized per topic, with lda-c's -100 floor for zero mass."""
+    log-normalized per topic, with lda-c's -100 floor for zero mass.
+
+    `topic_total` [K, 1] overrides the per-topic normalizer — the vocab-
+    sharded M-step passes the psum over the model axis so each shard
+    normalizes its local slice against the global total."""
     ss = suff_stats.T  # [K, V]
-    total = ss.sum(-1, keepdims=True)
+    total = ss.sum(-1, keepdims=True) if topic_total is None else topic_total
     return jnp.where(
         ss > 0, jnp.log(jnp.maximum(ss, 1e-300)) - jnp.log(total), LOG_ZERO
     )

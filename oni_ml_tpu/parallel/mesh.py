@@ -40,6 +40,14 @@ def make_mesh(data: int = -1, model: int = 1, devices=None) -> Mesh:
         data = n // model
     if data * model > n:
         raise ValueError(f"mesh {data}x{model} needs {data*model} devices, have {n}")
+    if data * model < n:
+        import warnings
+
+        warnings.warn(
+            f"mesh {data}x{model} uses {data*model} of {n} devices; "
+            f"{n - data*model} left idle",
+            stacklevel=2,
+        )
     grid = devices[: data * model].reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 

@@ -133,14 +133,11 @@ def make_vocab_sharded_fns(mesh: Mesh):
         return fn(log_beta, alpha, word_idx, counts, doc_mask)
 
     def local_m_step(ss_l):
-        # ss_l: [V/m, K].  Per-topic totals need the full vocab.
-        ss_t = ss_l.T                                   # [K, V/m]
-        total = jax.lax.psum(ss_t.sum(-1, keepdims=True), MODEL_AXIS)
-        return jnp.where(
-            ss_t > 0,
-            jnp.log(jnp.maximum(ss_t, 1e-300)) - jnp.log(total),
-            estep.LOG_ZERO,
-        )
+        # ss_l: [V/m, K].  Per-topic totals need the full vocab, so psum
+        # the local sums over the model axis and hand the dense m_step
+        # the global normalizer.
+        total = jax.lax.psum(ss_l.T.sum(-1, keepdims=True), MODEL_AXIS)
+        return estep.m_step(ss_l, topic_total=total)
 
     def m_step_fn(suff):
         fn = jax.shard_map(

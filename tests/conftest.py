@@ -7,8 +7,12 @@ backend with 8 virtual devices, mirroring how the driver's
 
 import os
 
-# Hard override: the session environment pins JAX_PLATFORMS=axon (the real
-# TPU tunnel); tests must be hermetic on the virtual CPU mesh.
+# Hard override: the session environment pins JAX_PLATFORMS to the real
+# TPU tunnel and a sitecustomize module imports jax at interpreter start,
+# so plain env-var edits here are too late.  jax.config.update works as
+# long as no device backend has been instantiated yet (nothing queries
+# devices during sitecustomize), so flip the platform through the config
+# API instead.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -17,3 +21,7 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 # Keep test numerics deterministic and f32-stable on CPU.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
