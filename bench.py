@@ -1,0 +1,76 @@
+"""Benchmark: LDA E-step throughput (docs/sec) on one chip.
+
+The E-step — the per-document variational gamma/phi fixed point — is
+where the reference's compute went (20 MPI ranks of oni-lda-c,
+SURVEY.md §3.3); docs/sec through it is BASELINE.json's headline metric.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is
+reported as 1.0 by convention against our own recorded history.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from oni_ml_tpu.ops import estep
+
+    # Config-1 scale (20 topics) with a realistic vocab; one padded batch
+    # shape so XLA compiles once, as production batching does.
+    K, V = 20, 8192
+    B, L = 4096, 128
+    ITERS = 8
+
+    rng = np.random.default_rng(0)
+    noise = rng.uniform(size=(K, V)) + 1.0 / V
+    log_beta = jnp.asarray(
+        np.log(noise / noise.sum(-1, keepdims=True)), jnp.float32
+    )
+    word_idx = jnp.asarray(rng.integers(0, V, size=(B, L)), jnp.int32)
+    counts = jnp.asarray(rng.integers(1, 5, size=(B, L)), jnp.float32)
+    doc_mask = jnp.ones((B,), jnp.float32)
+    alpha = jnp.float32(2.5)
+
+    # One full EM iteration: E-step + M-step, beta feeding back so every
+    # timed call sees fresh inputs (and matches production dataflow).
+    @jax.jit
+    def em_iter(lb, a, w, c, m):
+        res = estep.e_step(lb, a, w, c, m, var_max_iters=20, var_tol=1e-6)
+        return estep.m_step(res.suff_stats), res.likelihood
+
+    # Warmup / compile.  NOTE: sync via a scalar host transfer, not
+    # block_until_ready — the latter is a no-op under remote-relay PJRT
+    # backends, which silently turns the bench into a dispatch timer.
+    lb, ll = em_iter(log_beta, alpha, word_idx, counts, doc_mask)
+    float(ll)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        lb, ll = em_iter(lb, alpha, word_idx, counts, doc_mask)
+    dt_sync = float(ll)  # forces the whole chain to completion
+    dt = time.perf_counter() - t0
+    assert np.isfinite(dt_sync)
+
+    docs_per_sec = B * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "lda_estep_throughput",
+                "value": round(docs_per_sec, 1),
+                "unit": "docs/sec",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

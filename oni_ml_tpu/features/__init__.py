@@ -1,0 +1,40 @@
+"""Feature engineering — the framework's replacement for the reference's
+Spark featurizer scripts (flow_pre_lda.scala, dns_pre_lda.scala).
+
+Unlike the reference, which re-runs the identical featurization in its
+post/scoring stage (flow_post_lda.scala:64-224 duplicates
+flow_pre_lda.scala:102-362; see SURVEY.md §1), features here are computed
+ONCE into a FeatureTable that both the corpus-building and scoring stages
+consume.
+"""
+
+from .quantiles import ecdf_cuts, bin_values
+from .flow import FlowFeatures, featurize_flow, FLOW_COLUMNS
+from .dns import (
+    DnsFeatures,
+    featurize_dns,
+    extract_subdomain,
+    shannon_entropy,
+    load_top_domains,
+    DNS_COLUMNS,
+)
+from .feedback import (
+    read_flow_feedback_rows,
+    read_dns_feedback_rows,
+)
+
+__all__ = [
+    "ecdf_cuts",
+    "bin_values",
+    "FlowFeatures",
+    "featurize_flow",
+    "FLOW_COLUMNS",
+    "DnsFeatures",
+    "featurize_dns",
+    "extract_subdomain",
+    "shannon_entropy",
+    "load_top_domains",
+    "DNS_COLUMNS",
+    "read_flow_feedback_rows",
+    "read_dns_feedback_rows",
+]

@@ -1,0 +1,381 @@
+"""Pipeline runner — replaces ml_ops.sh (SURVEY.md §2.1).
+
+`ml_ops.sh YYYYMMDD {flow|dns} [TOL]` drove five processes across three
+runtimes (Spark/YARN, local Python, a 20-rank MPI binary) glued by HDFS
+copies, scp fan-outs, and sleep-based barriers.  Here the same run is one
+process driving device computations:
+
+    python -m oni_ml_tpu.runner.ml_ops 20160122 flow 1e-20 \
+        --flow-path raw.csv --data-dir /data
+
+Stages (each persists its reference-format outputs into the day directory
+and can be resumed individually — the per-stage checkpointing the
+reference's architecture implies but never implements, SURVEY §5.3-5.4):
+
+    pre     raw events -> FeatureTable (features.pkl) + word_counts.dat
+    corpus  word_counts.dat -> words.dat / doc.dat / model.dat
+    lda     model.dat -> final.beta/.gamma/.other + likelihood.dat
+            -> doc_results.csv / word_results.csv
+    score   features + results -> <dsource>_results.csv
+
+Config comes from flags (duxbay.conf's env-var contract is honored as
+fallback: FLOW_PATH, DNS_PATH, LPATH, TOL, DUPFACTOR).  Per-stage
+wall-clock and row counts stream as JSON lines to stdout and
+metrics.json — the structured observability the reference lacked
+(its diagnostics were bash `time` + println, SURVEY §5.1, §5.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable
+
+from ..config import PipelineConfig, LDAConfig, FeedbackConfig, ScoringConfig
+from ..features import (
+    featurize_dns,
+    featurize_flow,
+    load_top_domains,
+    read_dns_feedback_rows,
+    read_flow_feedback_rows,
+)
+from ..io import Corpus, formats
+from ..models import train_corpus
+from ..scoring import ScoringModel, score_dns, score_flow
+
+
+class Stage(str, Enum):
+    PRE = "pre"
+    CORPUS = "corpus"
+    LDA = "lda"
+    SCORE = "score"
+
+
+STAGE_ORDER = [Stage.PRE, Stage.CORPUS, Stage.LDA, Stage.SCORE]
+
+# Stage -> files that mark it complete (resume contract).
+_STAGE_OUTPUTS = {
+    Stage.PRE: ["features.pkl", "word_counts.dat"],
+    Stage.CORPUS: ["words.dat", "doc.dat", "model.dat"],
+    Stage.LDA: [
+        "final.beta", "final.gamma", "final.other", "likelihood.dat",
+        "doc_results.csv", "word_results.csv",
+    ],
+    Stage.SCORE: [],  # results file name depends on dsource
+}
+
+
+@dataclass
+class RunContext:
+    config: PipelineConfig
+    fdate: str
+    dsource: str  # "flow" | "dns"
+    day_dir: str
+    mesh: object = None
+    vocab_sharded: bool = False
+    metrics: list = field(default_factory=list)
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.day_dir, name)
+
+    def results_name(self) -> str:
+        return f"{self.dsource}_results.csv"
+
+    def emit(self, record: dict) -> None:
+        record = {"fdate": self.fdate, "dsource": self.dsource, **record}
+        print(json.dumps(record), flush=True)
+        self.metrics.append(record)
+
+
+def _stage_done(ctx: RunContext, stage: Stage) -> bool:
+    names = _STAGE_OUTPUTS[stage] or [ctx.results_name()]
+    return all(os.path.exists(ctx.path(n)) for n in names)
+
+
+def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
+    t0 = time.perf_counter()
+    info = fn()
+    ctx.emit(
+        {"stage": stage.value, "wall_s": round(time.perf_counter() - t0, 3), **info}
+    )
+
+
+# ---------------------------------------------------------------------------
+# Stages
+# ---------------------------------------------------------------------------
+
+
+def _read_dns_rows(path: str) -> list[list[str]]:
+    """Read 8-column DNS events.  CSV always works; parquet if pyarrow or
+    pandas happens to be importable (the reference reads Hive parquet,
+    dns_pre_lda.scala:142)."""
+    paths = [p for p in path.split(",") if p]
+    rows: list[list[str]] = []
+    for p in paths:
+        if p.endswith(".parquet"):
+            rows.extend(_read_parquet_rows(p))
+        else:
+            with open(p) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if line:
+                        rows.append(line.split(","))
+    return rows
+
+
+def _read_parquet_rows(path: str) -> list[list[str]]:
+    cols = [
+        "frame_time", "unix_tstamp", "frame_len", "ip_dst", "dns_qry_name",
+        "dns_qry_class", "dns_qry_type", "dns_qry_rcode",
+    ]
+    try:
+        import pyarrow.parquet as pq  # optional in this image
+
+        table = pq.read_table(path, columns=cols)
+        arrays = [table.column(c).to_pylist() for c in cols]
+    except ImportError as e:
+        raise RuntimeError(
+            f"parquet input {path} requires pyarrow, which is unavailable; "
+            "convert to CSV with the 8 DNS columns instead"
+        ) from e
+    return [
+        [str(v) if v is not None else "" for v in row] for row in zip(*arrays)
+    ]
+
+
+def stage_pre(ctx: RunContext) -> dict:
+    cfg = ctx.config
+    fb = cfg.feedback
+    if ctx.dsource == "flow":
+        fb_rows = read_flow_feedback_rows(
+            os.path.join(cfg.data_dir, "flow_scores.csv"),
+            fb.dup_factor,
+            fb.nonthreatening_severity,
+        )
+        with open(cfg.flow_path) as f:
+            features = featurize_flow(
+                (line.rstrip("\n") for line in f), feedback_rows=fb_rows
+            )
+    else:
+        fb_rows = read_dns_feedback_rows(
+            os.path.join(cfg.data_dir, "dns_scores.csv"),
+            fb.dup_factor,
+            fb.nonthreatening_severity,
+        )
+        top = (
+            load_top_domains(cfg.top_domains_path)
+            if cfg.top_domains_path
+            else frozenset()
+        )
+        features = featurize_dns(
+            _read_dns_rows(cfg.dns_path), top_domains=top, feedback_rows=fb_rows
+        )
+    with open(ctx.path("features.pkl"), "wb") as f:
+        pickle.dump(features, f, protocol=pickle.HIGHEST_PROTOCOL)
+    triples = features.word_counts()
+    formats.write_word_counts(ctx.path("word_counts.dat"), triples)
+    return {
+        "events": features.num_events,
+        "word_count_rows": len(triples),
+        "feedback_rows": len(fb_rows),
+    }
+
+
+def stage_corpus(ctx: RunContext) -> dict:
+    corpus = Corpus.from_word_counts_file(ctx.path("word_counts.dat"))
+    corpus.save(ctx.day_dir)
+    return {
+        "docs": corpus.num_docs,
+        "vocab": corpus.num_terms,
+        "tokens": corpus.num_tokens,
+    }
+
+
+def stage_lda(ctx: RunContext) -> dict:
+    corpus = Corpus.from_model_dat(
+        ctx.path("model.dat"), ctx.path("words.dat"), ctx.path("doc.dat")
+    )
+    result = train_corpus(
+        corpus,
+        ctx.config.lda,
+        out_dir=ctx.day_dir,
+        mesh=ctx.mesh,
+        vocab_sharded=ctx.vocab_sharded,
+    )
+    formats.write_doc_results(
+        ctx.path("doc_results.csv"), corpus.doc_names, result.gamma
+    )
+    formats.write_word_results(
+        ctx.path("word_results.csv"), corpus.vocab, result.log_beta
+    )
+    lls = [ll for ll, _ in result.likelihoods]
+    return {
+        "em_iters": result.em_iters,
+        "final_likelihood": lls[-1] if lls else None,
+        "alpha": result.alpha,
+    }
+
+
+def stage_score(ctx: RunContext) -> dict:
+    with open(ctx.path("features.pkl"), "rb") as f:
+        features = pickle.load(f)
+    sc = ctx.config.scoring
+    fallback = sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback
+    model = ScoringModel.from_files(
+        ctx.path("doc_results.csv"), ctx.path("word_results.csv"), fallback
+    )
+    score_fn = score_flow if ctx.dsource == "flow" else score_dns
+    rows, scores = score_fn(features, model, sc.threshold)
+    with open(ctx.path(ctx.results_name()), "w") as f:
+        for row in rows:
+            f.write(row + "\n")
+    return {
+        "scored_events": features.num_raw_events,
+        "flagged": len(rows),
+        "min_score": float(scores[0]) if len(scores) else None,
+    }
+
+
+_STAGE_FNS = {
+    Stage.PRE: stage_pre,
+    Stage.CORPUS: stage_corpus,
+    Stage.LDA: stage_lda,
+    Stage.SCORE: stage_score,
+}
+
+
+# ---------------------------------------------------------------------------
+# Entry
+# ---------------------------------------------------------------------------
+
+
+def run_pipeline(
+    config: PipelineConfig,
+    fdate: str,
+    dsource: str,
+    force: bool = False,
+    stages: list[Stage] | None = None,
+    mesh=None,
+    vocab_sharded: bool = False,
+) -> list[dict]:
+    """Run (or resume) the pipeline for one day.  Completed stages are
+    skipped unless `force`; `stages` restricts to a subset (they still run
+    in pipeline order)."""
+    if dsource not in ("flow", "dns"):
+        raise ValueError(f"dsource must be flow or dns, got {dsource!r}")
+    day_dir = formats.ensure_dir(config.day_dir(fdate))
+    ctx = RunContext(
+        config=config,
+        fdate=fdate,
+        dsource=dsource,
+        day_dir=day_dir,
+        mesh=mesh,
+        vocab_sharded=vocab_sharded,
+    )
+    wanted = stages or STAGE_ORDER
+    for stage in STAGE_ORDER:
+        if stage not in wanted:
+            continue
+        if not force and _stage_done(ctx, stage):
+            ctx.emit({"stage": stage.value, "skipped": "outputs exist"})
+            continue
+        _run_stage(ctx, stage, lambda s=stage: _STAGE_FNS[s](ctx))
+    with open(ctx.path("metrics.json"), "w") as f:
+        json.dump(ctx.metrics, f, indent=1)
+    return ctx.metrics
+
+
+def _build_config(args: argparse.Namespace) -> PipelineConfig:
+    env = os.environ
+    return PipelineConfig(
+        data_dir=args.data_dir or env.get("LPATH", "."),
+        flow_path=args.flow_path or env.get("FLOW_PATH", ""),
+        dns_path=args.dns_path or env.get("DNS_PATH", ""),
+        top_domains_path=args.top_domains or "",
+        lda=LDAConfig(
+            num_topics=args.topics,
+            alpha_init=args.alpha,
+            em_max_iters=args.em_max_iters,
+            batch_size=args.batch_size,
+            seed=args.seed,
+        ),
+        feedback=FeedbackConfig(
+            dup_factor=(
+                args.dup_factor
+                if args.dup_factor is not None
+                else int(env.get("DUPFACTOR", 1000))
+            )
+        ),
+        scoring=ScoringConfig(threshold=args.tol),
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ml_ops",
+        description="oni_ml_tpu suspicious-connects pipeline "
+        "(replaces ml_ops.sh YYYYMMDD {flow|dns} [TOL])",
+    )
+    p.add_argument("fdate", help="day to analyze, YYYYMMDD")
+    p.add_argument("dsource", choices=["flow", "dns"])
+    p.add_argument(
+        "tol", nargs="?", type=float,
+        default=float(os.environ.get("TOL", 1.1)),
+        help="suspicion threshold (ml_ops.sh:17-18 defaults TOL=1.1)",
+    )
+    p.add_argument("--data-dir", default=None, help="working dir (LPATH)")
+    p.add_argument("--flow-path", default=None)
+    p.add_argument("--dns-path", default=None)
+    p.add_argument("--top-domains", default=None, help="top-1m.csv path")
+    p.add_argument("--topics", type=int, default=20)
+    p.add_argument("--alpha", type=float, default=2.5)
+    p.add_argument("--em-max-iters", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--dup-factor", type=int, default=None,
+        help="feedback duplication (default: DUPFACTOR env or 1000)",
+    )
+    p.add_argument("--force", action="store_true", help="re-run all stages")
+    p.add_argument(
+        "--stages", default=None,
+        help="comma-separated subset of pre,corpus,lda,score",
+    )
+    p.add_argument(
+        "--mesh", default=None, metavar="DATA,MODEL",
+        help="device mesh shape; MODEL>1 shards the vocabulary",
+    )
+    args = p.parse_args(argv)
+    if len(args.fdate) != 8 or not args.fdate.isdigit():
+        p.error("fdate must be YYYYMMDD (ml_ops.sh:8-20)")
+
+    mesh = None
+    vocab_sharded = False
+    if args.mesh:
+        from ..parallel import make_mesh
+
+        data, model = (int(x) for x in args.mesh.split(","))
+        mesh = make_mesh(data=data, model=model)
+        vocab_sharded = model > 1
+    stages = (
+        [Stage(s) for s in args.stages.split(",")] if args.stages else None
+    )
+    run_pipeline(
+        _build_config(args),
+        args.fdate,
+        args.dsource,
+        force=args.force,
+        stages=stages,
+        mesh=mesh,
+        vocab_sharded=vocab_sharded,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

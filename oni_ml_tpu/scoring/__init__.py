@@ -1,0 +1,6 @@
+"""Event scoring — the framework's replacement for flow_post_lda.scala /
+dns_post_lda.scala."""
+
+from .score import ScoringModel, score_flow, score_dns
+
+__all__ = ["ScoringModel", "score_flow", "score_dns"]

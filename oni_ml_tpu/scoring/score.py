@@ -1,0 +1,168 @@
+"""Suspicious-connects scoring (flow_post_lda.scala:227-248,
+dns_post_lda.scala:312-331).
+
+p(event) = Σ_k p(topic k | event's IP) · p(event's word | topic k); events
+scoring below a threshold are emitted ascending (most suspicious first).
+
+TPU-native design: the reference broadcasts two driver-side hash maps to
+every Spark executor and loops per event.  Here the model is two dense
+matrices on device — theta [D+1, K] and p [V+1, K], each with its
+fallback vector as the extra final row — and scoring one batch of events
+is two gathers + a row-wise dot, one fused XLA program on the MXU path.
+Unseen IPs/words index the fallback row, preserving the reference's quirky
+asymmetric fallbacks (0.05/topic flow, 0.1/topic dns; a fully-unseen flow
+event scores 20·0.05·0.05 = 0.05, i.e. NOT maximally suspicious —
+SURVEY §2.6).
+
+Scoring reuses the featurization computed by the pre stage (FlowFeatures /
+DnsFeatures) instead of re-running it the way the post scripts do.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..features.flow import FlowFeatures
+from ..features.dns import DnsFeatures
+from ..io import formats
+
+
+@dataclass
+class ScoringModel:
+    """theta/p matrices plus key->row maps, fallback row appended last."""
+
+    ip_index: dict[str, int]
+    theta: np.ndarray            # [D+1, K], row D = fallback
+    word_index: dict[str, int]
+    p: np.ndarray                # [V+1, K], row V = fallback
+
+    @property
+    def num_topics(self) -> int:
+        return self.theta.shape[1]
+
+    @classmethod
+    def from_results(
+        cls,
+        doc_names: list[str],
+        doc_topic: np.ndarray,
+        vocab: list[str],
+        word_topic: np.ndarray,
+        fallback: float,
+    ) -> "ScoringModel":
+        k = doc_topic.shape[1] if doc_topic.size else word_topic.shape[1]
+        theta = np.concatenate(
+            [np.asarray(doc_topic, np.float64), np.full((1, k), fallback)]
+        )
+        p = np.concatenate(
+            [np.asarray(word_topic, np.float64), np.full((1, k), fallback)]
+        )
+        return cls(
+            ip_index={ip: i for i, ip in enumerate(doc_names)},
+            theta=theta,
+            word_index={w: i for i, w in enumerate(vocab)},
+            p=p,
+        )
+
+    @classmethod
+    def from_files(
+        cls, doc_results_path: str, word_results_path: str, fallback: float
+    ) -> "ScoringModel":
+        """Load the lda_post-format CSVs the reference's scorers broadcast
+        (flow_post_lda.scala:101-123)."""
+        doc_names, doc_topic = formats.read_doc_results(doc_results_path)
+        vocab, word_topic = formats.read_word_results(word_results_path)
+        return cls.from_results(doc_names, doc_topic, vocab, word_topic, fallback)
+
+    def ip_rows(self, ips: list[str]) -> np.ndarray:
+        fb = len(self.ip_index)
+        return np.fromiter(
+            (self.ip_index.get(ip, fb) for ip in ips), np.int32, len(ips)
+        )
+
+    def word_rows(self, words: list[str]) -> np.ndarray:
+        fb = len(self.word_index)
+        return np.fromiter(
+            (self.word_index.get(w, fb) for w in words), np.int32, len(words)
+        )
+
+
+@partial(jax.jit, donate_argnums=())
+def _dot_scores(theta, p, ip_idx, word_idx):
+    """score[i] = <theta[ip_idx[i]], p[word_idx[i]]> — two gathers + dot."""
+    return jnp.einsum(
+        "ik,ik->i", jnp.take(theta, ip_idx, axis=0), jnp.take(p, word_idx, axis=0)
+    )
+
+
+def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20):
+    """Score in fixed-size padded chunks so XLA compiles one shape."""
+    n = len(ip_idx)
+    theta = jnp.asarray(model.theta, jnp.float32)
+    p = jnp.asarray(model.p, jnp.float32)
+    out = np.empty(n, dtype=np.float64)
+    for lo in range(0, n, batch):
+        hi = min(lo + batch, n)
+        ii = np.zeros(batch if n > batch else n, dtype=np.int32)
+        wi = np.zeros_like(ii)
+        ii[: hi - lo] = ip_idx[lo:hi]
+        wi[: hi - lo] = word_idx[lo:hi]
+        s = _dot_scores(theta, p, jnp.asarray(ii), jnp.asarray(wi))
+        out[lo:hi] = np.asarray(s[: hi - lo], dtype=np.float64)
+    return out
+
+
+def score_flow(
+    features: FlowFeatures, model: ScoringModel, threshold: float
+) -> tuple[list[str], np.ndarray]:
+    """Flow scoring: score = min(<theta_sip, p_srcword>, <theta_dip,
+    p_destword>); emit rows under threshold sorted ascending by that min
+    (flow_post_lda.scala:227-248).  Returns (csv_rows, min_scores) where
+    each row is the 35 featurized columns + src_score + dest_score.
+
+    Only raw events are scored: the feedback duplicates appended after
+    index num_raw_events train the model but must not reappear in the
+    suspicious-connects output (the reference's post stage re-reads raw
+    data without feedback injection)."""
+    n = features.num_raw_events
+    sips = [features.sip(i) for i in range(n)]
+    dips = [features.dip(i) for i in range(n)]
+    src_scores = _batched_scores(
+        model, model.ip_rows(sips), model.word_rows(features.src_word[:n])
+    )
+    dest_scores = _batched_scores(
+        model, model.ip_rows(dips), model.word_rows(features.dest_word[:n])
+    )
+    min_scores = np.minimum(src_scores, dest_scores)
+    keep = np.where(min_scores < threshold)[0]
+    order = keep[np.argsort(min_scores[keep], kind="stable")]
+    rows = [
+        ",".join(
+            features.featurized_row(i) + [str(src_scores[i]), str(dest_scores[i])]
+        )
+        for i in order
+    ]
+    return rows, min_scores[order]
+
+
+def score_dns(
+    features: DnsFeatures, model: ScoringModel, threshold: float
+) -> tuple[list[str], np.ndarray]:
+    """DNS scoring: single <theta_ip_dst, p_word> per event
+    (dns_post_lda.scala:312-331).  Each emitted row is the 15 featurized
+    columns + score.  Only raw events are scored (see score_flow)."""
+    n = features.num_raw_events
+    ips = [features.client_ip(i) for i in range(n)]
+    scores = _batched_scores(
+        model, model.ip_rows(ips), model.word_rows(features.word[:n])
+    )
+    keep = np.where(scores < threshold)[0]
+    order = keep[np.argsort(scores[keep], kind="stable")]
+    rows = [
+        ",".join(features.featurized_row(i) + [str(scores[i])]) for i in order
+    ]
+    return rows, scores[order]

@@ -1,0 +1,325 @@
+"""Featurizer unit/property tests (SURVEY §4: quantile semantics vs a
+brute-force oracle, adjust_port port cases, extract_subdomain, entropy)."""
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.features import (
+    bin_values,
+    ecdf_cuts,
+    extract_subdomain,
+    featurize_dns,
+    featurize_flow,
+    load_top_domains,
+    read_dns_feedback_rows,
+    read_flow_feedback_rows,
+    shannon_entropy,
+)
+from oni_ml_tpu.features.quantiles import DECILES, QUINTILES
+
+
+# ---------------------------------------------------------------------------
+# quantiles
+# ---------------------------------------------------------------------------
+
+
+def brute_force_cuts(values, quantiles):
+    """Literal transcription of the reference rule: cdf over the multiset,
+    cut = max({v : cdf(v) < q} ∪ {0})."""
+    values = np.asarray(values, dtype=np.float64)
+    uniq = np.unique(values)
+    cdf = {v: np.mean(values <= v) for v in uniq}
+    out = []
+    for q in quantiles:
+        best = 0.0
+        for v in uniq:
+            if cdf[v] < q:
+                best = max(best, v)
+        out.append(best)
+    return np.array(out)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("quant", [DECILES, QUINTILES])
+def test_ecdf_cuts_match_brute_force(seed, quant):
+    rng = np.random.default_rng(seed)
+    # Heavy ties, like binned network data.
+    values = rng.integers(0, 40, size=500).astype(float)
+    np.testing.assert_array_equal(ecdf_cuts(values, quant), brute_force_cuts(values, quant))
+
+
+def test_ecdf_cuts_empty_and_constant():
+    assert ecdf_cuts(np.array([]), DECILES).tolist() == [0.0] * 10
+    # Constant data: cdf(v) = 1.0, never < q, all cuts 0.
+    assert ecdf_cuts(np.full(10, 7.0), DECILES).tolist() == [0.0] * 10
+
+
+def test_ecdf_cuts_negative_floor_at_zero():
+    # The reference's zero-initialised aggregate floors cuts at 0.
+    values = np.array([-5.0, -1.0, 3.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0])
+    cuts = ecdf_cuts(values, DECILES)
+    assert (cuts >= 0).all()
+    np.testing.assert_array_equal(cuts, brute_force_cuts(values, DECILES))
+
+
+def test_bin_values_counts_cuts_strictly_below():
+    cuts = np.array([0.0, 2.0, 4.0])
+    np.testing.assert_array_equal(
+        bin_values(np.array([-1.0, 0.0, 1.0, 2.0, 5.0]), cuts),
+        [0, 0, 1, 1, 3],
+    )
+
+
+# ---------------------------------------------------------------------------
+# flow words
+# ---------------------------------------------------------------------------
+
+
+def flow_row(hour=1, minute=30, second=0, sip="10.0.0.1", dip="10.0.0.2",
+             col10="80", col11="55000", ipkt="5", ibyt="500"):
+    row = ["##"] * 27
+    row[4], row[5], row[6] = str(hour), str(minute), str(second)
+    row[8], row[9] = sip, dip
+    row[10], row[11] = col10, col11
+    row[16], row[17] = ipkt, ibyt
+    return ",".join(row)
+
+
+def featurize_rows(*rows, cuts=None):
+    lines = ["header"] + list(rows)
+    return featurize_flow(lines, precomputed_cuts=cuts)
+
+
+ZERO_CUTS = (np.zeros(10), np.zeros(10), np.zeros(5))
+
+
+def test_flow_port_case_2_service_port():
+    # col10=80 (reference's "dport"), col11=55000 ("sport"): p_case 2,
+    # word_port = min = 80, dport < sport => dest_word gets -1_ prefix.
+    f = featurize_rows(flow_row(col10="80", col11="55000"), cuts=ZERO_CUTS)
+    assert f.word_port[0] == "80.0"
+    assert f.src_word[0] == "80.0_10.0_10.0_5.0"
+    assert f.dest_word[0] == "-1_80.0_10.0_10.0_5.0"
+
+
+def test_flow_port_case_2_other_direction():
+    f = featurize_rows(flow_row(col10="55000", col11="80"), cuts=ZERO_CUTS)
+    assert f.src_word[0].startswith("-1_80.0")
+    assert not f.dest_word[0].startswith("-1_")
+
+
+def test_flow_port_case_3_both_high():
+    f = featurize_rows(flow_row(col10="50000", col11="60000"), cuts=ZERO_CUTS)
+    assert f.word_port[0] == "333333.0"
+    assert f.src_word[0] == f.dest_word[0] == "333333.0_10.0_10.0_5.0"
+
+
+def test_flow_port_case_4_zero_port():
+    # col10 ("dport") == 0, col11 ("sport") != 0: word_port = sport,
+    # src_word marked.
+    f = featurize_rows(flow_row(col10="0", col11="7000"), cuts=ZERO_CUTS)
+    assert f.word_port[0] == "7000.0"
+    assert f.src_word[0].startswith("-1_")
+    assert not f.dest_word[0].startswith("-1_")
+    # Mirrored.
+    f = featurize_rows(flow_row(col10="7000", col11="0"), cuts=ZERO_CUTS)
+    assert f.word_port[0] == "7000.0"
+    assert f.dest_word[0].startswith("-1_")
+
+
+def test_flow_port_case_1_both_low():
+    f = featurize_rows(flow_row(col10="80", col11="443"), cuts=ZERO_CUTS)
+    assert f.word_port[0] == "111111.0"
+    # Both zero -> max(0,0)=0.
+    f = featurize_rows(flow_row(col10="0", col11="0"), cuts=ZERO_CUTS)
+    assert f.word_port[0] == "0.0"
+
+
+def test_flow_time_and_binning():
+    # 1:30:00 -> 1.5 fractional hours; cuts at deciles of a known set.
+    f = featurize_rows(
+        flow_row(hour=1, minute=30, second=0),
+        flow_row(hour=2, minute=0, second=0, ibyt="1000", ipkt="10"),
+    )
+    np.testing.assert_allclose(f.num_time, [1.5, 2.0])
+    # Two distinct values: cdf(1.5)=0.5, cdf(2.0)=1.0.  Deciles 0.6..0.9
+    # pick 1.5; time_bin(1.5)=#{cuts<1.5}=... cuts=[0,0,0,0,0,0,1.5,1.5,1.5,1.5]
+    np.testing.assert_array_equal(f.time_cuts, [0, 0, 0, 0, 0, 0, 1.5, 1.5, 1.5, 1.5])
+    assert f.time_bin.tolist() == [6, 10]
+
+
+def test_flow_header_and_bad_rows_dropped():
+    lines = ["h,e,a,d", "h,e,a,d", "bad,row", flow_row()]
+    f = featurize_flow(lines)
+    assert f.num_events == 1
+
+
+def test_flow_word_counts_two_documents_per_event():
+    f = featurize_rows(
+        flow_row(sip="a", dip="b"), flow_row(sip="a", dip="b"), cuts=ZERO_CUTS
+    )
+    wc = f.word_counts()
+    assert ("a", f.src_word[0], 2) in wc
+    assert ("b", f.dest_word[0], 2) in wc
+    assert len(wc) == 2
+
+
+def test_flow_ip_pair_lexicographic():
+    f = featurize_rows(flow_row(sip="10.9.9.9", dip="10.10.10.10"), cuts=ZERO_CUTS)
+    # "10.10.10.10" < "10.9.9.9" lexicographically is False -> sip<dip False
+    assert f.ip_pair[0] == "10.10.10.10 10.9.9.9"
+
+
+def test_flow_featurized_row_width():
+    f = featurize_rows(flow_row(), cuts=ZERO_CUTS)
+    assert len(f.featurized_row(0)) == 35
+
+
+# ---------------------------------------------------------------------------
+# flow feedback
+# ---------------------------------------------------------------------------
+
+
+def test_flow_feedback_roundtrip(tmp_path):
+    fb = tmp_path / "flow_scores.csv"
+    header = ",".join(f"c{i}" for i in range(22))
+    sev3 = ["3", "2016-04-21 03:58:13", "1.2.3.4", "5.6.7.8", "80", "55000",
+            "TCP", ".AP.", "5", "500"] + ["x"] * 12
+    sev1 = list(sev3)
+    sev1[0] = "1"
+    fb.write_text("\n".join([header, ",".join(sev3), ",".join(sev1)]) + "\n")
+    rows = read_flow_feedback_rows(str(fb), dup_factor=3)
+    assert len(rows) == 3  # only the sev-3 row, duplicated
+    parts = rows[0].split(",")
+    assert len(parts) == 27
+    assert parts[4:7] == ["03", "58", "13"]
+    assert parts[8] == "1.2.3.4" and parts[11] == "55000"
+    # Injected rows must survive featurization (unlike the reference,
+    # whose comma-less converter gets them filtered out).
+    f = featurize_flow(["header"], feedback_rows=rows)
+    assert f.num_events == 3
+
+
+def test_flow_feedback_missing_file():
+    assert read_flow_feedback_rows("/nonexistent/x.csv", 1000) == []
+
+
+def test_flow_feedback_malformed_tstart_skipped(tmp_path):
+    fb = tmp_path / "flow_scores.csv"
+    header = ",".join(f"c{i}" for i in range(22))
+    good = ["3", "2016-04-21 03:58:13", "1.2.3.4", "5.6.7.8", "80", "55000",
+            "TCP", ".AP.", "5", "500"] + ["x"] * 12
+    bad = list(good)
+    bad[1] = "2016-04-21"  # no time part -> must be skipped, not crash
+    fb.write_text("\n".join([header, ",".join(bad), ",".join(good)]) + "\n")
+    rows = read_flow_feedback_rows(str(fb), dup_factor=2)
+    assert len(rows) == 2  # only the well-formed row
+
+
+def test_feedback_events_train_but_are_not_scored():
+    rows = read_flow_feedback_rows("/nonexistent/x.csv", 1)
+    fb = [flow_row(sip="fb", dip="fb2")] * 4
+    f = featurize_flow(["h", flow_row()], feedback_rows=fb)
+    assert f.num_events == 5
+    assert f.num_raw_events == 1
+    # word_counts (training corpus) still sees every event.
+    assert sum(c for _, _, c in f.word_counts()) == 10  # 5 events x 2 docs
+
+
+# ---------------------------------------------------------------------------
+# dns
+# ---------------------------------------------------------------------------
+
+
+def test_extract_subdomain_basic():
+    assert extract_subdomain("mail.google.com") == ("google", "mail", 4, 3)
+    assert extract_subdomain("a.b.mail.google.com") == ("google", "a.b.mail", 8, 5)
+
+
+def test_extract_subdomain_two_parts_unknown():
+    assert extract_subdomain("google.com") == ("None", "None", 0, 2)
+    assert extract_subdomain("localhost") == ("None", "None", 0, 1)
+
+
+def test_extract_subdomain_country_code():
+    # cc TLD shifts domain one label left; 3 parts -> no subdomain.
+    assert extract_subdomain("foo.co.uk") == ("foo", "None", 0, 3)
+    assert extract_subdomain("www.foo.co.uk") == ("foo", "www", 3, 4)
+
+
+def test_extract_subdomain_reverse_dns():
+    d, s, sl, n = extract_subdomain("4.3.2.1.in-addr.arpa")
+    assert (d, s, sl) == ("None", "None", 0)
+    assert n == 6
+
+
+def test_shannon_entropy():
+    assert shannon_entropy("") == 0.0
+    assert shannon_entropy("aaaa") == 0.0
+    assert shannon_entropy("ab") == 1.0
+    # The reference's 'None' placeholder quirk: 4 distinct chars -> 2 bits.
+    assert shannon_entropy("None") == 2.0
+
+
+def test_load_top_domains(tmp_path):
+    p = tmp_path / "top-1m.csv"
+    p.write_text("1,google.com\n2,facebook.com\n3,baidu.cn\n")
+    top = load_top_domains(str(p))
+    assert top == {"google", "facebook", "baidu"}
+
+
+def dns_row(tstamp="1454000000", flen="60", ip="10.0.0.9",
+            qname="mail.google.com", qtype="1", rcode="0"):
+    return ["t", tstamp, flen, ip, qname, "1", qtype, rcode]
+
+
+def test_dns_word_structure():
+    f = featurize_dns([dns_row()], top_domains=frozenset({"google"}))
+    # Single event: every cdf is 1.0 -> all cuts 0 -> every positive value
+    # bins to the full cut count.
+    # top=1, frame_len 60>0 ten cuts -> 10; tstamp -> 10; sub_len 4>0 -> 5;
+    # entropy>0 -> 5; num_periods 3>0 -> 5; qtype 1; rcode 0
+    assert f.word[0] == "1_10_10_5_5_5_1_0"
+
+
+def test_dns_intel_whitelist():
+    f = featurize_dns([dns_row(qname="x.intel.com")])
+    assert f.top_domain[0] == 2
+    assert f.word[0].startswith("2_")
+
+
+def test_dns_none_subdomain_entropy_quirk():
+    # domain-only query: subdomain 'None' -> entropy 2.0 feeds the ECDF.
+    f = featurize_dns([dns_row(qname="google.com")])
+    assert f.subdomain[0] == "None"
+    assert f.subdomain_entropy[0] == 2.0
+    assert (f.entropy_cuts >= 0).all()
+
+
+def test_dns_word_counts_by_client():
+    rows = [dns_row(ip="a"), dns_row(ip="a"), dns_row(ip="b")]
+    f = featurize_dns(rows)
+    wc = f.word_counts()
+    assert ("a", f.word[0], 2) in wc
+    assert ("b", f.word[2], 1) in wc
+
+
+def test_dns_featurized_row_width():
+    f = featurize_dns([dns_row()])
+    assert len(f.featurized_row(0)) == 15
+
+
+def test_dns_feedback_roundtrip(tmp_path):
+    fb = tmp_path / "dns_scores.csv"
+    header = ",".join(f"c{i}" for i in range(24))
+    row = ["ft", "60", "9.9.9.9", "evil.example.com", "1", "1", "0"] + \
+        ["x"] * 11 + ["3"] + ["x"] * 4 + ["1454000000"]
+    bad = list(row)
+    bad[18] = "1"
+    fb.write_text("\n".join([header, ",".join(row), ",".join(bad)]) + "\n")
+    rows = read_dns_feedback_rows(str(fb), dup_factor=2)
+    assert len(rows) == 2
+    assert rows[0] == ["ft", "1454000000", "60", "9.9.9.9",
+                      "evil.example.com", "1", "1", "0"]
+    f = featurize_dns([], feedback_rows=rows)
+    assert f.num_events == 2

@@ -1,0 +1,176 @@
+"""End-to-end pipeline tests: the runner reproduces ml_ops.sh's
+stage sequence and file contract on a synthetic day, with per-stage
+resume (SURVEY §5.3-5.4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.config import (
+    FeedbackConfig,
+    LDAConfig,
+    PipelineConfig,
+    ScoringConfig,
+)
+from oni_ml_tpu.io import formats
+from oni_ml_tpu.runner import Stage, run_pipeline
+
+from test_features import dns_row, flow_row
+
+
+@pytest.fixture()
+def flow_day(tmp_path):
+    rng = np.random.default_rng(7)
+    lines = ["dummy,header"]
+    for i in range(60):
+        lines.append(
+            flow_row(
+                hour=int(rng.integers(0, 24)),
+                minute=int(rng.integers(0, 60)),
+                second=int(rng.integers(0, 60)),
+                sip=f"10.0.0.{rng.integers(1, 9)}",
+                dip=f"172.16.0.{rng.integers(1, 9)}",
+                col10=str(rng.choice([80, 443, 55000, 0])),
+                col11=str(rng.choice([80, 6000, 70000])),
+                ipkt=str(rng.integers(1, 100)),
+                ibyt=str(rng.integers(40, 10000)),
+            )
+        )
+    raw = tmp_path / "flow.csv"
+    raw.write_text("\n".join(lines) + "\n")
+    cfg = PipelineConfig(
+        data_dir=str(tmp_path),
+        flow_path=str(raw),
+        lda=LDAConfig(num_topics=4, em_max_iters=6, batch_size=32,
+                      min_bucket_len=16, seed=3),
+        feedback=FeedbackConfig(dup_factor=5),
+        scoring=ScoringConfig(threshold=1.1),
+    )
+    return cfg, tmp_path
+
+
+def test_flow_pipeline_end_to_end(flow_day):
+    cfg, tmp_path = flow_day
+    metrics = run_pipeline(cfg, "20160122", "flow")
+    day = tmp_path / "20160122"
+    for name in ["features.pkl", "word_counts.dat", "words.dat", "doc.dat",
+                 "model.dat", "final.beta", "final.gamma", "final.other",
+                 "likelihood.dat", "doc_results.csv", "word_results.csv",
+                 "flow_results.csv", "metrics.json"]:
+        assert (day / name).exists(), name
+    # Stage metrics observable and complete.
+    stages = [m["stage"] for m in metrics]
+    assert stages == ["pre", "corpus", "lda", "score"]
+    # likelihood.dat: monotone non-decreasing likelihood.
+    ll = formats.read_likelihood(str(day / "likelihood.dat"))
+    assert ll.shape[1] == 2
+    lls = ll[:, 0]
+    assert all(b >= a - 1e-3 * abs(a) for a, b in zip(lls, lls[1:]))
+    # threshold 1.1 > any probability -> every event flagged, ascending.
+    results = (day / "flow_results.csv").read_text().splitlines()
+    assert len(results) == 60
+    mins = [min(float(r.split(",")[-2]), float(r.split(",")[-1])) for r in results]
+    assert mins == sorted(mins)
+    # final.other carries the centralized config (k, V, alpha).
+    other = formats.read_other(str(day / "final.other"))
+    assert other["num_topics"] == 4
+
+
+def test_flow_pipeline_resume_skips_done_stages(flow_day):
+    cfg, tmp_path = flow_day
+    run_pipeline(cfg, "20160122", "flow")
+    metrics2 = run_pipeline(cfg, "20160122", "flow")
+    assert all(m.get("skipped") for m in metrics2)
+    # Forcing a single stage re-runs exactly that stage.
+    metrics3 = run_pipeline(cfg, "20160122", "flow", force=True,
+                            stages=[Stage.SCORE])
+    assert [m["stage"] for m in metrics3] == ["score"]
+    assert not metrics3[0].get("skipped")
+
+
+def test_flow_pipeline_with_feedback(flow_day):
+    cfg, tmp_path = flow_day
+    header = ",".join(f"c{i}" for i in range(22))
+    fb_row = ["3", "2016-01-22 10:00:00", "10.0.0.1", "172.16.0.1", "80",
+              "55000", "TCP", ".AP.", "5", "500"] + ["x"] * 12
+    (tmp_path / "flow_scores.csv").write_text(
+        header + "\n" + ",".join(fb_row) + "\n"
+    )
+    metrics = run_pipeline(cfg, "20160123", "flow")
+    pre = metrics[0]
+    assert pre["feedback_rows"] == 5  # dup_factor
+    assert pre["events"] == 65
+    # Feedback duplicates train the model but are NOT scored: the results
+    # hold exactly the 60 raw events.
+    score = metrics[-1]
+    assert score["scored_events"] == 60
+    results = (tmp_path / "20160123" / "flow_results.csv").read_text().splitlines()
+    assert len(results) == 60
+
+
+def test_dns_pipeline_end_to_end(tmp_path):
+    rng = np.random.default_rng(11)
+    names = ["mail.google.com", "x.intel.com", "a.b.evil-dga-q7.biz",
+             "google.com", "4.3.2.1.in-addr.arpa"]
+    rows = [
+        ",".join(
+            dns_row(
+                tstamp=str(1454000000 + int(rng.integers(0, 86400))),
+                flen=str(rng.integers(40, 500)),
+                ip=f"10.0.1.{rng.integers(1, 6)}",
+                qname=str(rng.choice(names)),
+                qtype=str(rng.choice([1, 28])),
+                rcode="0",
+            )
+        )
+        for _ in range(50)
+    ]
+    raw = tmp_path / "dns.csv"
+    raw.write_text("\n".join(rows) + "\n")
+    top = tmp_path / "top-1m.csv"
+    top.write_text("1,google.com\n2,intel.com\n")
+    cfg = PipelineConfig(
+        data_dir=str(tmp_path),
+        dns_path=str(raw),
+        top_domains_path=str(top),
+        lda=LDAConfig(num_topics=3, em_max_iters=5, batch_size=16,
+                      min_bucket_len=16, seed=5),
+        scoring=ScoringConfig(threshold=1.1),
+    )
+    run_pipeline(cfg, "20160122", "dns")
+    day = tmp_path / "20160122"
+    results = (day / "dns_results.csv").read_text().splitlines()
+    assert len(results) == 50
+    scores = [float(r.split(",")[-1]) for r in results]
+    assert scores == sorted(scores)
+    # Sanity: every result row carries the word column and the scores are
+    # real probabilities.
+    assert all(0 <= s <= 1 for s in scores)
+    metrics_path = json.loads((day / "metrics.json").read_text())
+    assert [m["stage"] for m in metrics_path] == ["pre", "corpus", "lda", "score"]
+
+
+def test_runner_cli_smoke(flow_day, capsys):
+    cfg, tmp_path = flow_day
+    from oni_ml_tpu.runner.ml_ops import main
+
+    rc = main([
+        "20160122", "flow", "1.1",
+        "--data-dir", str(tmp_path),
+        "--flow-path", cfg.flow_path,
+        "--topics", "4", "--em-max-iters", "3", "--batch-size", "32",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    records = [json.loads(l) for l in out]
+    assert [r["stage"] for r in records] == ["pre", "corpus", "lda", "score"]
+    assert (tmp_path / "20160122" / "flow_results.csv").exists()
+
+
+def test_runner_rejects_bad_date():
+    from oni_ml_tpu.runner.ml_ops import main
+
+    with pytest.raises(SystemExit):
+        main(["2016", "flow"])

@@ -1,0 +1,98 @@
+"""Scoring tests: dot-product semantics, the reference's fallback quirks
+(0.05 flow / 0.1 dns, SURVEY §2.6), threshold filter + ascending sort."""
+
+import numpy as np
+
+from oni_ml_tpu.features import featurize_dns, featurize_flow
+from oni_ml_tpu.io import formats
+from oni_ml_tpu.scoring import ScoringModel, score_dns, score_flow
+
+from test_features import ZERO_CUTS, dns_row, flow_row
+
+
+def make_model(doc_names, vocab, k=4, fallback=0.05, seed=0):
+    rng = np.random.default_rng(seed)
+    theta = rng.dirichlet(np.ones(k), size=len(doc_names))
+    p = rng.dirichlet(np.ones(len(vocab)), size=k).T if vocab else np.zeros((0, k))
+    return ScoringModel.from_results(doc_names, theta, vocab, p, fallback)
+
+
+def test_unseen_flow_event_scores_fallback_squared():
+    # Fully-unseen IP and word: score = K * 0.05 * 0.05 = 0.05 at K=20 —
+    # the reference's "unseen traffic is NOT maximally suspicious" quirk.
+    model = ScoringModel.from_results([], np.zeros((0, 20)), [], np.zeros((0, 20)), 0.05)
+    f = featurize_flow(["h", flow_row()], precomputed_cuts=ZERO_CUTS)
+    rows, scores = score_flow(f, model, threshold=1.0)
+    assert len(rows) == 1
+    np.testing.assert_allclose(scores[0], 20 * 0.05 * 0.05, rtol=1e-6)
+
+
+def test_flow_score_is_min_of_src_dest():
+    f = featurize_flow(["h", flow_row(sip="a", dip="b")], precomputed_cuts=ZERO_CUTS)
+    k = 3
+    theta_a = np.array([1.0, 0.0, 0.0])
+    theta_b = np.array([0.0, 1.0, 0.0])
+    p_src = np.array([0.9, 0.1, 0.0])   # <theta_a, p_src> = 0.9
+    p_dst = np.array([0.2, 0.3, 0.5])   # <theta_b, p_dst> = 0.3
+    model = ScoringModel.from_results(
+        ["a", "b"], np.stack([theta_a, theta_b]),
+        [f.src_word[0], f.dest_word[0]], np.stack([p_src, p_dst]), 0.05,
+    )
+    rows, scores = score_flow(f, model, threshold=1.0)
+    np.testing.assert_allclose(scores[0], 0.3)
+    cols = rows[0].split(",")
+    # row = 35 featurized cols + src_score + dest_score
+    assert len(cols) == 37
+    np.testing.assert_allclose(float(cols[-2]), 0.9)
+    np.testing.assert_allclose(float(cols[-1]), 0.3)
+
+
+def test_threshold_filters_and_sorts_ascending():
+    events = [flow_row(sip=f"ip{i}", dip="d") for i in range(5)]
+    f = featurize_flow(["h"] + events, precomputed_cuts=ZERO_CUTS)
+    k = 2
+    # Give each sip a distinct score via theta[0]; word prob fixed.
+    theta = np.array([[0.5, 0.5], [0.1, 0.9], [0.9, 0.1], [0.3, 0.7], [0.7, 0.3]])
+    vocab = [f.src_word[0], f.dest_word[0]]
+    p = np.array([[1.0, 0.0], [1.0, 0.0]])  # score = theta[0]
+    model = ScoringModel.from_results(
+        [f"ip{i}" for i in range(5)] + ["d"],
+        np.concatenate([theta, [[1.0, 0.0]]]), vocab, p, 0.05,
+    )
+    rows, scores = score_flow(f, model, threshold=0.6)
+    # dest score = <theta_d, p_dest> = 1.0 -> min = src score
+    assert list(scores) == sorted(scores)
+    assert all(s < 0.6 for s in scores)
+    assert len(rows) == 3  # 0.5, 0.1, 0.3 survive
+
+
+def test_dns_scoring_fallback_and_row_shape():
+    f = featurize_dns([dns_row(ip="known"), dns_row(ip="unknown")])
+    theta = np.full((1, 20), 1 / 20)
+    p = np.full((1, 20), 1 / 20)
+    model = ScoringModel.from_results(["known"], theta, [f.word[0]], p, 0.1)
+    rows, scores = score_dns(f, model, threshold=1.0)
+    assert len(rows) == 2
+    # unknown ip: 20 * 0.1 * (1/20) = 0.1; known: 20 * (1/20)^2 = 0.05
+    np.testing.assert_allclose(sorted(scores), [0.05, 0.1], rtol=1e-6)
+    assert all(len(r.split(",")) == 16 for r in rows)
+
+
+def test_model_roundtrip_through_result_files(tmp_path):
+    rng = np.random.default_rng(3)
+    gamma = rng.uniform(size=(4, 5))
+    log_beta = np.log(rng.dirichlet(np.ones(7), size=5))
+    doc_names = [f"10.0.0.{i}" for i in range(4)]
+    vocab = [f"w{i}" for i in range(7)]
+    dpath, wpath = str(tmp_path / "d.csv"), str(tmp_path / "w.csv")
+    formats.write_doc_results(dpath, doc_names, gamma)
+    formats.write_word_results(wpath, vocab, log_beta)
+    model = ScoringModel.from_files(dpath, wpath, fallback=0.1)
+    # theta rows normalized; p columns come from exp-normalized beta.
+    np.testing.assert_allclose(model.theta[:4].sum(axis=1), 1.0, rtol=1e-12)
+    np.testing.assert_allclose(
+        model.p[:7], np.exp(log_beta).T, rtol=1e-10, atol=1e-12
+    )
+    assert model.ip_index["10.0.0.2"] == 2
+    assert model.word_index["w6"] == 6
+    np.testing.assert_allclose(model.theta[4], 0.1)  # fallback row
