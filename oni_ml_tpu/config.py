@@ -49,6 +49,25 @@ class LDAConfig:
 
 
 @dataclass(frozen=True)
+class OnlineLDAConfig:
+    """Streaming (stochastic variational) LDA hyperparameters —
+    BASELINE.json config 5.  tau0/kappa defaults follow Hoffman et al.
+    (NIPS 2010); eta is the symmetric topic-word Dirichlet prior."""
+
+    num_topics: int = 20
+    alpha: float = 2.5           # doc-topic prior (fixed in SVI)
+    eta: float = 0.01            # topic-word prior
+    tau0: float = 64.0           # learning-rate delay
+    kappa: float = 0.7           # learning-rate decay in (0.5, 1]
+    var_max_iters: int = 20
+    var_tol: float = 1e-6
+    batch_size: int = 1024       # docs per micro-batch
+    min_bucket_len: int = 16
+    compute_dtype: str = "float32"
+    seed: int = 0
+
+
+@dataclass(frozen=True)
 class FeedbackConfig:
     """Analyst feedback loop: non-threatening rows are replicated DUPFACTOR
     times into the corpus so their probability rises above the threshold
@@ -80,6 +99,7 @@ class PipelineConfig:
     dns_path: str = ""             # raw DNS CSV/parquet paths (DNS_PATH)
     top_domains_path: str = ""     # Alexa top-1m.csv (dns_pre_lda.scala:62)
     lda: LDAConfig = field(default_factory=LDAConfig)
+    online_lda: OnlineLDAConfig = field(default_factory=OnlineLDAConfig)
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     # Mesh shape: (data, model). data shards documents, model shards the

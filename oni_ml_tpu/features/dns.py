@@ -201,11 +201,15 @@ def featurize_dns(
         n_parts[i] = np_
         entropy[i] = shannon_entropy(s)
 
+    # NaN-defaulting like the flow featurizer: a single malformed field
+    # (e.g. a null parquet cell surfaced as "") must not abort the day.
+    from .flow import _to_double
+
     tstamp = np.array(
-        [float(r[c["unix_tstamp"]]) for r in rows], dtype=np.float64
+        [_to_double(r[c["unix_tstamp"]]) for r in rows], dtype=np.float64
     ) if rows else np.zeros(0)
     frame_len = np.array(
-        [float(r[c["frame_len"]]) for r in rows], dtype=np.float64
+        [_to_double(r[c["frame_len"]]) for r in rows], dtype=np.float64
     ) if rows else np.zeros(0)
 
     time_cuts = ecdf_cuts(tstamp, DECILES)

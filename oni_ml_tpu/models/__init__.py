@@ -1,3 +1,10 @@
 from .lda import LDAResult, LDATrainer, train_corpus
+from .online_lda import OnlineLDATrainer, train_corpus_online
 
-__all__ = ["LDAResult", "LDATrainer", "train_corpus"]
+__all__ = [
+    "LDAResult",
+    "LDATrainer",
+    "OnlineLDATrainer",
+    "train_corpus",
+    "train_corpus_online",
+]

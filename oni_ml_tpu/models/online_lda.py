@@ -1,0 +1,272 @@
+"""Online (streaming) variational LDA — BASELINE.json config 5.
+
+The reference engine is strictly batch: one day of netflow becomes one
+corpus, EM runs to convergence, done (ml_ops.sh:80; SURVEY.md §2.8).  For
+hourly micro-batches that design re-trains from scratch every hour.  This
+module adds the streaming alternative: stochastic variational inference
+(Hoffman, Blei, Bach, "Online Learning for Latent Dirichlet Allocation",
+NIPS 2010 — see PAPERS.md), where each micro-batch performs one
+natural-gradient step on a variational Dirichlet posterior lambda [K, V]
+over the topics:
+
+    rho_t   = (tau0 + t)^(-kappa)
+    lambda <- (1 - rho_t) lambda + rho_t (eta + D/|S_t| * suff_stats_t)
+
+The per-document local step is *identical math* to the batch E-step
+(ops/estep.py): Hoffman's update uses exp(E_q[log beta]) everywhere the
+batch algorithm uses beta, so we simply feed ``E_q[log beta]`` (digamma
+form) to ``e_step`` — no duplicated inner loop, and the same Pallas/
+sharded substitutions apply.
+
+TPU notes: the whole update (E-step fixed point + scatter + blend) is one
+jitted program per (B, L) shape; lambda lives on device across the stream
+so each micro-batch moves only its own tokens over PCIe/ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import OnlineLDAConfig
+from ..io import Batch
+from ..ops import estep
+from ..ops.estep import e_log_dirichlet as expected_log_beta
+from .lda import LDAResult
+
+
+@dataclass
+class StreamStepInfo:
+    step: int
+    rho: float
+    batch_docs: int
+    likelihood: float          # ELBO local term over the micro-batch
+    tokens: int
+
+    @property
+    def per_token_ll(self) -> float:
+        return self.likelihood / max(self.tokens, 1)
+
+
+class OnlineLDATrainer:
+    """Streaming natural-gradient LDA over padded micro-batches.
+
+    ``total_docs`` is the population size D the stream is drawn from (for
+    the reference pipelines: the expected number of active IPs in the
+    window being modeled).  It scales each micro-batch's sufficient
+    statistics to a full-corpus estimate; a too-small D under-weights new
+    evidence but never destabilizes the update.
+
+    With a ``mesh``, micro-batches shard over its `data` axis and the
+    suff-stats psum over ICI (the shard_map'd E-step from
+    oni_ml_tpu/parallel); lambda replicates.  Vocab sharding is a batch-
+    only feature for now — the natural-gradient blend wants the full
+    lambda row normalizer every step.  The ``e_step_fn`` hook still
+    allows arbitrary substitution, exactly as in the batch trainer.
+    """
+
+    def __init__(
+        self,
+        config: OnlineLDAConfig,
+        num_terms: int,
+        total_docs: int,
+        e_step_fn: Callable | None = None,
+        mesh=None,
+    ):
+        self.config = config
+        self.num_terms = num_terms
+        self.total_docs = total_docs
+        self.mesh = mesh
+        self.step_count = 0
+        self.history: list[StreamStepInfo] = []
+        dtype = jnp.dtype(config.compute_dtype)
+
+        if mesh is not None and e_step_fn is None:
+            from ..parallel.mesh import MODEL_AXIS
+            from ..parallel.sharded import make_data_parallel_e_step
+
+            if mesh.shape[MODEL_AXIS] > 1:
+                raise ValueError(
+                    "online LDA supports data-parallel meshes only; "
+                    f"got model axis {mesh.shape[MODEL_AXIS]}"
+                )
+            e_step_fn = make_data_parallel_e_step(mesh)
+
+        # Hoffman's init: lambda ~ Gamma(100, 1/100) per entry.
+        key = jax.random.PRNGKey(config.seed)
+        self._lam = jax.random.gamma(
+            key, 100.0, (config.num_topics, num_terms), dtype
+        ) / 100.0
+        self._alpha = jnp.asarray(config.alpha, dtype)
+        if mesh is not None:
+            from ..parallel.mesh import replicated
+
+            self._lam = jax.device_put(self._lam, replicated(mesh))
+
+        base = e_step_fn or estep.e_step
+        self._e_fn = partial(
+            base, var_max_iters=config.var_max_iters, var_tol=config.var_tol
+        )
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(lam, rho, word_idx, counts, doc_mask):
+            res = self._e_fn(expected_log_beta(lam), self._alpha, word_idx,
+                             counts, doc_mask)
+            batch_docs = jnp.maximum(doc_mask.sum(), 1.0)
+            lam_hat = config.eta + (total_docs / batch_docs) * res.suff_stats.T
+            new_lam = (1.0 - rho) * lam + rho * lam_hat
+            return new_lam, res.likelihood, res.gamma
+
+        self._update = update
+
+    @property
+    def lam(self) -> jnp.ndarray:
+        return self._lam
+
+    def _put_batch(self, batch: Batch):
+        """Device placement for one micro-batch (data-axis sharded when a
+        mesh is active, plain transfer otherwise)."""
+        dtype = jnp.dtype(self.config.compute_dtype)
+        arrays = (
+            jnp.asarray(batch.word_idx),
+            jnp.asarray(batch.counts, dtype),
+            jnp.asarray(batch.doc_mask, dtype),
+        )
+        if self.mesh is None:
+            return arrays
+        from ..parallel.mesh import DATA_AXIS, batch_sharding
+
+        data_size = self.mesh.shape[DATA_AXIS]
+        if batch.word_idx.shape[0] % data_size:
+            raise ValueError(
+                f"micro-batch of {batch.word_idx.shape[0]} docs not "
+                f"divisible by data axis {data_size}"
+            )
+        sh = batch_sharding(self.mesh)
+        return tuple(jax.device_put(a, sh) for a in arrays)
+
+    def step(self, batch: Batch) -> StreamStepInfo:
+        """One natural-gradient update from one micro-batch."""
+        cfg = self.config
+        t = self.step_count
+        rho = float((cfg.tau0 + t) ** (-cfg.kappa))
+        dtype = jnp.dtype(cfg.compute_dtype)
+        widx, cnts, mask = self._put_batch(batch)
+        self._lam, ll, _ = self._update(
+            self._lam, jnp.asarray(rho, dtype), widx, cnts, mask
+        )
+        self.step_count += 1
+        info = StreamStepInfo(
+            step=self.step_count,
+            rho=rho,
+            batch_docs=int(batch.doc_mask.sum()),
+            likelihood=float(ll),
+            tokens=int(batch.counts.sum()),
+        )
+        self.history.append(info)
+        return info
+
+    def fit_stream(
+        self,
+        batches: Iterable[Batch],
+        progress: Callable[[StreamStepInfo], None] | None = None,
+    ) -> "OnlineLDATrainer":
+        for b in batches:
+            info = self.step(b)
+            if progress:
+                progress(info)
+        return self
+
+    # -- model extraction ---------------------------------------------------
+
+    def _to_host(self, x) -> np.ndarray:
+        if self.mesh is not None and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x, np.float64)
+
+    def log_beta(self) -> np.ndarray:
+        """Point-estimate topics: log E_q[beta] = log(lambda / sum lambda),
+        with the batch engine's LOG_ZERO floor so downstream file contracts
+        (final.beta, word_results.csv) behave identically."""
+        lam = self._to_host(self._lam)
+        beta = lam / lam.sum(-1, keepdims=True)
+        return np.where(beta > 0, np.log(np.maximum(beta, 1e-300)),
+                        estep.LOG_ZERO)
+
+    def infer_gamma(self, batches: Sequence[Batch], num_docs: int) -> np.ndarray:
+        """Final inference pass: doc-topic posteriors for ``num_docs`` docs
+        under the current (frozen) topics — produces final.gamma for the
+        scoring stage just like the batch trainer's last E-step.  Runs
+        through the same (possibly shard_map'd) E-step as training."""
+        cfg = self.config
+        e_fn = jax.jit(self._e_fn)
+        log_b = expected_log_beta(self._lam)
+        gamma_out = np.zeros((num_docs, cfg.num_topics), np.float64)
+        for b in batches:
+            widx, cnts, mask = self._put_batch(b)
+            res = e_fn(log_b, self._alpha, widx, cnts, mask)
+            g = self._to_host(res.gamma)
+            sel = b.doc_mask == 1
+            gamma_out[b.doc_index[sel]] = g[sel]
+        return gamma_out
+
+    def result(
+        self, batches: Sequence[Batch] | None = None, num_docs: int = 0
+    ) -> LDAResult:
+        gamma = (
+            self.infer_gamma(batches, num_docs)
+            if batches is not None
+            else np.zeros((0, self.config.num_topics))
+        )
+        lls = [(h.likelihood, h.rho) for h in self.history]
+        return LDAResult(
+            log_beta=self.log_beta(),
+            gamma=gamma,
+            alpha=float(self._alpha),
+            likelihoods=lls,
+            em_iters=self.step_count,
+        )
+
+
+def train_corpus_online(
+    corpus,
+    config: OnlineLDAConfig,
+    out_dir: str | None = None,
+    epochs: int = 1,
+    progress: Callable[[StreamStepInfo], None] | None = None,
+    mesh=None,
+) -> LDAResult:
+    """Stream an in-memory corpus through the online trainer, micro-batch
+    by micro-batch, then write the reference-format outputs.
+
+    This is the drop-in path for `ml_ops --online`: the day's corpus is
+    consumed as a stream (each bucketed batch = one micro-batch), which on
+    hourly data extends naturally to feeding each hour's batches as they
+    arrive without retraining from scratch.
+    """
+    from ..io import make_batches
+
+    batches = make_batches(
+        corpus, batch_size=config.batch_size, min_bucket_len=config.min_bucket_len
+    )
+    trainer = OnlineLDATrainer(
+        config,
+        num_terms=corpus.num_terms,
+        total_docs=corpus.num_docs,
+        mesh=mesh,
+    )
+    rng = np.random.default_rng(config.seed)
+    for _ in range(epochs):
+        order = rng.permutation(len(batches))
+        trainer.fit_stream((batches[i] for i in order), progress=progress)
+    result = trainer.result(batches, corpus.num_docs)
+    if out_dir:
+        result.save(out_dir, num_terms=corpus.num_terms)
+    return result

@@ -50,9 +50,15 @@ class EStepResult(NamedTuple):
     vi_iters: jnp.ndarray     # scalar: fixed-point iterations used
 
 
-def _e_log_theta(gamma: jnp.ndarray) -> jnp.ndarray:
-    """E_q[log theta] = digamma(gamma_k) - digamma(sum_k gamma_k)."""
-    return digamma(gamma) - digamma(gamma.sum(-1, keepdims=True))
+def e_log_dirichlet(param: jnp.ndarray) -> jnp.ndarray:
+    """Dirichlet expectation E_q[log x] = digamma(p_i) - digamma(sum p)
+    over the last axis.  Used for both E[log theta] (gamma rows) and the
+    online trainer's E[log beta] (lambda rows)."""
+    return digamma(param) - digamma(param.sum(-1, keepdims=True))
+
+
+# Internal alias: gamma-flavoured call sites read better with this name.
+_e_log_theta = e_log_dirichlet
 
 
 def gather_beta(log_beta: jnp.ndarray, word_idx: jnp.ndarray) -> jnp.ndarray:

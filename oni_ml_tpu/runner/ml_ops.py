@@ -36,7 +36,13 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable
 
-from ..config import PipelineConfig, LDAConfig, FeedbackConfig, ScoringConfig
+from ..config import (
+    FeedbackConfig,
+    LDAConfig,
+    OnlineLDAConfig,
+    PipelineConfig,
+    ScoringConfig,
+)
 from ..features import (
     featurize_dns,
     featurize_flow,
@@ -45,7 +51,7 @@ from ..features import (
     read_flow_feedback_rows,
 )
 from ..io import Corpus, formats
-from ..models import train_corpus
+from ..models import train_corpus, train_corpus_online
 from ..scoring import ScoringModel, score_dns, score_flow
 
 
@@ -78,6 +84,7 @@ class RunContext:
     day_dir: str
     mesh: object = None
     vocab_sharded: bool = False
+    online: bool = False
     metrics: list = field(default_factory=list)
 
     def path(self, name: str) -> str:
@@ -200,13 +207,23 @@ def stage_lda(ctx: RunContext) -> dict:
     corpus = Corpus.from_model_dat(
         ctx.path("model.dat"), ctx.path("words.dat"), ctx.path("doc.dat")
     )
-    result = train_corpus(
-        corpus,
-        ctx.config.lda,
-        out_dir=ctx.day_dir,
-        mesh=ctx.mesh,
-        vocab_sharded=ctx.vocab_sharded,
-    )
+    if ctx.online:
+        if ctx.vocab_sharded:
+            raise ValueError(
+                "--online supports data-parallel meshes only "
+                "(vocab sharding is batch-mode)"
+            )
+        result = train_corpus_online(
+            corpus, ctx.config.online_lda, out_dir=ctx.day_dir, mesh=ctx.mesh
+        )
+    else:
+        result = train_corpus(
+            corpus,
+            ctx.config.lda,
+            out_dir=ctx.day_dir,
+            mesh=ctx.mesh,
+            vocab_sharded=ctx.vocab_sharded,
+        )
     formats.write_doc_results(
         ctx.path("doc_results.csv"), corpus.doc_names, result.gamma
     )
@@ -262,6 +279,7 @@ def run_pipeline(
     stages: list[Stage] | None = None,
     mesh=None,
     vocab_sharded: bool = False,
+    online: bool = False,
 ) -> list[dict]:
     """Run (or resume) the pipeline for one day.  Completed stages are
     skipped unless `force`; `stages` restricts to a subset (they still run
@@ -276,6 +294,7 @@ def run_pipeline(
         day_dir=day_dir,
         mesh=mesh,
         vocab_sharded=vocab_sharded,
+        online=online,
     )
     wanted = stages or STAGE_ORDER
     for stage in STAGE_ORDER:
@@ -301,6 +320,15 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
             num_topics=args.topics,
             alpha_init=args.alpha,
             em_max_iters=args.em_max_iters,
+            batch_size=args.batch_size,
+            seed=args.seed,
+        ),
+        online_lda=OnlineLDAConfig(
+            num_topics=args.topics,
+            alpha=args.alpha,
+            eta=args.eta,
+            tau0=args.tau0,
+            kappa=args.kappa,
             batch_size=args.batch_size,
             seed=args.seed,
         ),
@@ -341,6 +369,16 @@ def main(argv: list[str] | None = None) -> int:
         "--dup-factor", type=int, default=None,
         help="feedback duplication (default: DUPFACTOR env or 1000)",
     )
+    p.add_argument(
+        "--online", action="store_true",
+        help="streaming (stochastic variational) LDA instead of batch EM",
+    )
+    p.add_argument("--eta", type=float, default=0.01,
+                   help="online: topic-word Dirichlet prior")
+    p.add_argument("--tau0", type=float, default=64.0,
+                   help="online: learning-rate delay")
+    p.add_argument("--kappa", type=float, default=0.7,
+                   help="online: learning-rate decay exponent")
     p.add_argument("--force", action="store_true", help="re-run all stages")
     p.add_argument(
         "--stages", default=None,
@@ -373,6 +411,7 @@ def main(argv: list[str] | None = None) -> int:
         stages=stages,
         mesh=mesh,
         vocab_sharded=vocab_sharded,
+        online=args.online,
     )
     return 0
 

@@ -1,0 +1,158 @@
+"""Online (streaming SVI) LDA: invariants, learning progress, agreement
+with the batch engine, and reference file contracts."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from oni_ml_tpu.config import LDAConfig, OnlineLDAConfig
+from oni_ml_tpu.io import make_batches
+from oni_ml_tpu.models import (
+    OnlineLDATrainer,
+    train_corpus,
+    train_corpus_online,
+)
+from oni_ml_tpu.ops import estep
+
+import reference_lda as ref
+from test_lda import corpus_from_docs
+
+
+def _full_corpus_ll(corpus, log_beta, alpha=2.5):
+    """ELBO of the whole corpus under frozen topics (one batch E-step)."""
+    batches = make_batches(corpus, batch_size=256, min_bucket_len=64)
+    total = 0.0
+    for b in batches:
+        res = estep.e_step(
+            jnp.asarray(log_beta, jnp.float32),
+            jnp.float32(alpha),
+            jnp.asarray(b.word_idx),
+            jnp.asarray(b.counts),
+            jnp.asarray(b.doc_mask),
+            var_max_iters=30,
+            var_tol=1e-7,
+        )
+        total += float(res.likelihood)
+    return total
+
+
+def test_online_learns_topics():
+    docs, _ = ref.make_synthetic_corpus(num_docs=120, num_terms=40,
+                                        num_topics=3, seed=11)
+    V, K = 40, 4
+    corpus = corpus_from_docs(docs, V)
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          tau0=8.0, kappa=0.7, seed=1)
+
+    trainer = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs)
+    ll_init = _full_corpus_ll(corpus, trainer.log_beta())
+    batches = make_batches(corpus, cfg.batch_size, cfg.min_bucket_len)
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        for i in rng.permutation(len(batches)):
+            trainer.step(batches[i])
+    ll_final = _full_corpus_ll(corpus, trainer.log_beta())
+    assert ll_final > ll_init + 0.05 * abs(ll_init), (ll_init, ll_final)
+
+    # topics normalized in probability space
+    np.testing.assert_allclose(
+        np.exp(trainer.log_beta()).sum(-1), np.ones(K), rtol=1e-6)
+    # learning rate follows the Robbins-Monro schedule, strictly decreasing
+    rhos = [h.rho for h in trainer.history]
+    assert all(a > b for a, b in zip(rhos, rhos[1:]))
+
+
+def test_online_approaches_batch_quality():
+    docs, _ = ref.make_synthetic_corpus(num_docs=150, num_terms=30,
+                                        num_topics=3, seed=5)
+    V, K = 30, 3
+    corpus = corpus_from_docs(docs, V)
+
+    batch_cfg = LDAConfig(num_topics=K, em_max_iters=30, em_tol=1e-6,
+                          batch_size=256, min_bucket_len=64, seed=2)
+    batch_res = train_corpus(corpus, batch_cfg)
+    ll_batch = _full_corpus_ll(corpus, batch_res.log_beta,
+                               alpha=batch_res.alpha)
+
+    online_cfg = OnlineLDAConfig(num_topics=K, batch_size=16,
+                                 min_bucket_len=64, tau0=8.0, seed=2)
+    online_res = train_corpus_online(corpus, online_cfg, epochs=8)
+    ll_online = _full_corpus_ll(corpus, online_res.log_beta)
+
+    # online should land within a few percent of the batch optimum
+    assert ll_online > ll_batch - 0.05 * abs(ll_batch), (ll_batch, ll_online)
+
+
+def test_online_writes_reference_files(tmp_path):
+    docs, _ = ref.make_synthetic_corpus(num_docs=40, num_terms=25,
+                                        num_topics=2, seed=3)
+    V, K = 25, 3
+    corpus = corpus_from_docs(docs, V)
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=32)
+    result = train_corpus_online(corpus, cfg, out_dir=str(tmp_path), epochs=2)
+
+    from oni_ml_tpu.io import formats
+    lb = formats.read_beta(str(tmp_path / "final.beta"))
+    gm = formats.read_gamma(str(tmp_path / "final.gamma"))
+    other = formats.read_other(str(tmp_path / "final.other"))
+    assert lb.shape == (K, V)
+    assert gm.shape == (corpus.num_docs, K)
+    assert other["num_topics"] == K and other["num_terms"] == V
+    # gamma rows cover every document and stay positive
+    assert (gm > 0).all()
+    np.testing.assert_allclose(lb, result.log_beta, atol=1e-9)
+
+
+def test_online_sharded_matches_single_device():
+    """Data-parallel online steps (suff-stats psum over the mesh) produce
+    the same lambda as a single device."""
+    import jax
+    from oni_ml_tpu.parallel import make_mesh
+
+    docs, _ = ref.make_synthetic_corpus(num_docs=64, num_terms=20,
+                                        num_topics=2, seed=4)
+    V, K = 20, 3
+    corpus = corpus_from_docs(docs, V)
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          tau0=8.0, seed=6)
+    batches = make_batches(corpus, cfg.batch_size, cfg.min_bucket_len)
+
+    single = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs)
+    mesh = make_mesh(data=4, model=1, devices=jax.devices()[:4])
+    sharded = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs,
+                               mesh=mesh)
+    for b in batches:
+        single.step(b)
+        sharded.step(b)
+    np.testing.assert_allclose(np.asarray(single.lam),
+                               np.asarray(sharded.lam), rtol=2e-4, atol=2e-4)
+    # vocab sharding is explicitly rejected for online mode
+    bad_mesh = make_mesh(data=2, model=2, devices=jax.devices()[:4])
+    import pytest
+    with pytest.raises(ValueError, match="data-parallel"):
+        OnlineLDATrainer(cfg, num_terms=V, total_docs=10, mesh=bad_mesh)
+
+
+def test_stream_extends_without_restart():
+    """New micro-batches keep refining the same model state — the streaming
+    property the batch reference lacks (retrain-from-scratch per day)."""
+    docs, _ = ref.make_synthetic_corpus(num_docs=80, num_terms=30,
+                                        num_topics=3, seed=9)
+    V, K = 30, 3
+    corpus = corpus_from_docs(docs, V)
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          tau0=8.0)
+    batches = make_batches(corpus, cfg.batch_size, cfg.min_bucket_len)
+    trainer = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs)
+
+    # "hour 1": first half of the stream
+    half = len(batches) // 2
+    for b in batches[:half]:
+        trainer.step(b)
+    steps_after_h1 = trainer.step_count
+    lam_h1 = np.asarray(trainer.lam).copy()
+
+    # "hour 2" arrives: continues from the same state
+    for b in batches[half:]:
+        trainer.step(b)
+    assert trainer.step_count == steps_after_h1 + (len(batches) - half)
+    assert not np.allclose(np.asarray(trainer.lam), lam_h1)

@@ -152,6 +152,24 @@ def test_dns_pipeline_end_to_end(tmp_path):
     assert [m["stage"] for m in metrics_path] == ["pre", "corpus", "lda", "score"]
 
 
+def test_flow_pipeline_online_lda(flow_day):
+    """--online swaps the batch EM engine for streaming SVI; every file
+    contract downstream (final.*, results CSV ordering) is unchanged."""
+    cfg, tmp_path = flow_day
+    run_pipeline(cfg, "20160124", "flow", online=True)
+    day = tmp_path / "20160124"
+    for name in ["final.beta", "final.gamma", "final.other",
+                 "flow_results.csv"]:
+        assert (day / name).exists(), name
+    gm = formats.read_gamma(str(day / "final.gamma"))
+    assert (gm > 0).all()
+    results = (day / "flow_results.csv").read_text().splitlines()
+    assert len(results) == 60
+    mins = [min(float(r.split(",")[-2]), float(r.split(",")[-1]))
+            for r in results]
+    assert mins == sorted(mins)
+
+
 def test_runner_cli_smoke(flow_day, capsys):
     cfg, tmp_path = flow_day
     from oni_ml_tpu.runner.ml_ops import main
