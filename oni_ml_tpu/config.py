@@ -65,6 +65,8 @@ class OnlineLDAConfig:
     min_bucket_len: int = 16
     compute_dtype: str = "float32"
     seed: int = 0
+    # Checkpoint (lambda, step) every N micro-batch steps (0 = disabled).
+    checkpoint_every: int = 0
 
 
 @dataclass(frozen=True)
@@ -98,6 +100,7 @@ class PipelineConfig:
     flow_path: str = ""            # raw netflow CSV file/dir (FLOW_PATH)
     dns_path: str = ""             # raw DNS CSV/parquet paths (DNS_PATH)
     top_domains_path: str = ""     # Alexa top-1m.csv (dns_pre_lda.scala:62)
+    qtiles_path: str = ""          # precomputed flow cuts (SURVEY §2.7)
     lda: LDAConfig = field(default_factory=LDAConfig)
     online_lda: OnlineLDAConfig = field(default_factory=OnlineLDAConfig)
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)

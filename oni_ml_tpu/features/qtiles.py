@@ -1,0 +1,111 @@
+"""Precomputed quantile cuts — the reference's offline qtiles side-path
+(SURVEY §2.7: gen_qtiles.sh + qtiles.py + flow_qtiles).
+
+The reference's intended optimization: compute the flow binning cuts once
+(offline, via Hive ntile() over a TABLESAMPLE) instead of full-data ECDF
+shuffles every run, stored as one line
+
+    <ibyt cuts>,<ipkt cuts>,<time cuts>
+
+with each list space-separated (consumption contract: the commented-out
+`CUT` path at flow_pre_lda.scala:95-98 / ml_ops.sh:48-49; field order
+ibyt, ipkt, time).  Here the generator is exact (same ecdf_cuts as the
+online path, not a 100-row sample) and the runner consumes the file via
+``--qtiles``, which also pins word identity across days — the reference's
+per-run recomputation meant the same event could map to different words
+on different days (SURVEY §1 nondeterminism note).
+
+CLI:  python -m oni_ml_tpu.features.qtiles raw_flow.csv flow_qtiles
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable
+
+import numpy as np
+
+from .flow import FLOW_COLUMNS, NUM_FLOW_COLUMNS, _to_double
+from .quantiles import DECILES, QUINTILES, ecdf_cuts
+
+
+def write_flow_qtiles(
+    path: str,
+    time_cuts: np.ndarray,
+    ibyt_cuts: np.ndarray,
+    ipkt_cuts: np.ndarray,
+) -> None:
+    def fmt(xs):
+        return " ".join(repr(float(x)) for x in xs)
+
+    with open(path, "w") as f:
+        f.write(f"{fmt(ibyt_cuts)},{fmt(ipkt_cuts)},{fmt(time_cuts)}\n")
+
+
+def read_flow_qtiles(path: str):
+    """Returns (time_cuts, ibyt_cuts, ipkt_cuts) — the argument order of
+    featurize_flow's `precomputed_cuts`."""
+    with open(path) as f:
+        line = f.read().strip()
+    parts = line.split(",")
+    if len(parts) != 3:
+        raise ValueError(
+            f"{path}: expected 3 comma-separated cut lists, got {len(parts)}"
+        )
+    ibyt, ipkt, time = (
+        np.array([float(x) for x in p.split()], dtype=np.float64)
+        for p in parts
+    )
+    return time, ibyt, ipkt
+
+
+def compute_flow_qtiles(lines: Iterable[str], skip_header: bool = True):
+    """One pass over raw flow CSV -> (time_cuts, ibyt_cuts, ipkt_cuts),
+    identical semantics to the in-run ECDF (features/quantiles.py)."""
+    c = FLOW_COLUMNS
+    times, ibyts, ipkts = [], [], []
+    header = None
+    for line in lines:
+        if skip_header:
+            if header is None:
+                header = line
+                continue
+            if line == header:
+                continue
+        parts = line.strip().split(",")
+        if len(parts) != NUM_FLOW_COLUMNS:
+            continue
+        times.append(
+            _to_double(parts[c["hour"]])
+            + _to_double(parts[c["minute"]]) / 60.0
+            + _to_double(parts[c["second"]]) / 3600.0
+        )
+        ibyts.append(_to_double(parts[c["ibyt"]]))
+        ipkts.append(_to_double(parts[c["ipkt"]]))
+    return (
+        ecdf_cuts(np.array(times), DECILES),
+        ecdf_cuts(np.array(ibyts), DECILES),
+        ecdf_cuts(np.array(ipkts), QUINTILES),
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = sys.argv[1:] if argv is None else argv
+    if len(args) != 2:
+        print(
+            "usage: python -m oni_ml_tpu.features.qtiles "
+            "<raw_flow.csv> <out_qtiles>",
+            file=sys.stderr,
+        )
+        return 2
+    with open(args[0]) as f:
+        time_cuts, ibyt_cuts, ipkt_cuts = compute_flow_qtiles(
+            line.rstrip("\n") for line in f
+        )
+    write_flow_qtiles(args[1], time_cuts, ibyt_cuts, ipkt_cuts)
+    print(f"wrote {args[1]}: time={time_cuts} ibyt={ibyt_cuts} ipkt={ipkt_cuts}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

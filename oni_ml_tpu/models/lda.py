@@ -113,6 +113,55 @@ class LDAResult:
                     formats.append_likelihood(f, ll, conv)
 
 
+def to_host(x, mesh=None) -> np.ndarray:
+    """Device->host as float64.  Arrays sharded over a multi-host mesh are
+    not fully addressable from any one process, so gather first."""
+    if mesh is not None and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x, dtype=np.float64)
+
+
+def _is_coordinator() -> bool:
+    """True on the single process that owns shared-filesystem writes."""
+    return jax.process_index() == 0
+
+
+def save_checkpoint(
+    path: str,
+    log_beta: np.ndarray,
+    alpha: float,
+    em_iter: int,
+    likelihoods: list[tuple[float, float]],
+) -> None:
+    """Atomic in-training checkpoint: (beta, alpha, EM iteration, likelihood
+    history).  The reference has no in-training resume at all — a crashed
+    20-rank MPI run restarts from scratch (SURVEY §5.3-5.4).
+
+    Call only from the coordinator process in multi-host runs (the
+    trainers gate on it); day_dir is a shared filesystem there."""
+    tmp = path + ".tmp.npz"  # savez appends nothing to an .npz name
+    np.savez(
+        tmp,
+        log_beta=np.asarray(log_beta),
+        alpha=np.float64(alpha),
+        em_iter=np.int64(em_iter),
+        likelihoods=np.asarray(likelihoods, np.float64).reshape(-1, 2),
+    )
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> dict:
+    with np.load(path) as z:
+        return {
+            "log_beta": z["log_beta"],
+            "alpha": float(z["alpha"]),
+            "em_iter": int(z["em_iter"]),
+            "likelihoods": [tuple(row) for row in z["likelihoods"]],
+        }
+
+
 def init_log_beta(key: jax.Array, k: int, v: int, dtype=jnp.float32) -> jnp.ndarray:
     """`random` initialization per the reference CLI (ml_ops.sh:80):
     uniform noise + 1/V, log-normalized per topic (lda-c random_initialize_ss)."""
@@ -164,13 +213,36 @@ class LDATrainer:
         progress: Callable[[int, float, float], None] | None = None,
         initial_log_beta: np.ndarray | None = None,
         initial_alpha: float | None = None,
+        checkpoint_path: str | None = None,
     ) -> LDAResult:
         """Run EM to convergence.  `initial_log_beta`/`initial_alpha` warm-
         start the model (checkpoint resume, tests pinning the init); by
-        default beta gets the reference's `random` initialization."""
+        default beta gets the reference's `random` initialization.
+
+        With `checkpoint_path`, training state (beta, alpha, iteration,
+        likelihood history) is persisted every `config.checkpoint_every`
+        EM iterations and, if the file already exists, training resumes
+        from it instead of reinitializing."""
         cfg = self.config
         k, v = cfg.num_topics, self.num_terms
         dtype = jnp.dtype(cfg.compute_dtype)
+
+        restored: list[tuple[float, float]] = []
+        start_it = 0
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            ckpt = load_checkpoint(checkpoint_path)
+            if ckpt["log_beta"].shape != (k, v):
+                raise ValueError(
+                    f"checkpoint beta shape {ckpt['log_beta'].shape} does "
+                    f"not match config ({k}, {v})"
+                )
+            initial_log_beta = ckpt["log_beta"]
+            initial_alpha = ckpt["alpha"]
+            restored = ckpt["likelihoods"]
+            # Resuming a run checkpointed at (or past) the last iteration
+            # re-runs one iteration: gamma comes from the final E-step.
+            start_it = min(ckpt["em_iter"], cfg.em_max_iters - 1)
+
         if initial_log_beta is not None:
             log_beta = jnp.asarray(initial_log_beta, dtype)
         else:
@@ -220,12 +292,15 @@ class LDATrainer:
         doc_masks = [b.doc_mask for b in batches]
 
         gamma_out = np.zeros((num_docs, k), dtype=np.float64)
-        likelihoods: list[tuple[float, float]] = []
+        likelihoods: list[tuple[float, float]] = list(restored[:start_it])
         ll_file = open(likelihood_file, "w") if likelihood_file else None
-        ll_prev = None
-        it = 0
+        if ll_file:
+            for ll_r, conv_r in likelihoods:
+                formats.append_likelihood(ll_file, ll_r, conv_r)
+        ll_prev = likelihoods[-1][0] if likelihoods else None
+        it = start_it
         try:
-            for it in range(1, cfg.em_max_iters + 1):
+            for it in range(start_it + 1, cfg.em_max_iters + 1):
                 total_ss = jnp.zeros((v, k), dtype)
                 total_ll = jnp.zeros((), dtype)
                 total_ass = jnp.zeros((), dtype)
@@ -251,6 +326,16 @@ class LDATrainer:
                     ll_file.flush()
                 if progress:
                     progress(it, ll, conv)
+                if (
+                    checkpoint_path
+                    and cfg.checkpoint_every
+                    and it % cfg.checkpoint_every == 0
+                    and _is_coordinator()
+                ):
+                    save_checkpoint(
+                        checkpoint_path, to_host(log_beta, self.mesh),
+                        float(alpha), it, likelihoods,
+                    )
 
                 if ll_prev is not None and conv < cfg.em_tol:
                     break
@@ -258,24 +343,20 @@ class LDATrainer:
         finally:
             if ll_file:
                 ll_file.close()
-
-        # Device->host transfer of gamma once, from the final EM iteration.
-        # Arrays sharded over a multi-host mesh are not fully addressable
-        # from any one process, so gather before np.asarray.
-        def to_host(x):
-            if self.mesh is not None and not x.is_fully_addressable:
-                from jax.experimental import multihost_utils
-
-                x = multihost_utils.process_allgather(x, tiled=True)
-            return np.asarray(x, dtype=np.float64)
+        if (
+            checkpoint_path
+            and _is_coordinator()
+            and os.path.exists(checkpoint_path)
+        ):
+            os.remove(checkpoint_path)  # run completed; day dir stays clean
 
         for g, di, dm in zip(gammas, doc_index, doc_masks):
-            g = to_host(g)
+            g = to_host(g, self.mesh)
             sel = dm == 1
             gamma_out[di[sel]] = g[sel]
 
         return LDAResult(
-            log_beta=to_host(log_beta),
+            log_beta=to_host(log_beta, self.mesh),
             gamma=gamma_out,
             alpha=float(alpha),
             likelihoods=likelihoods,
@@ -355,12 +436,18 @@ def train_corpus(
         vocab_sharded=vocab_sharded,
     )
     ll_path = os.path.join(out_dir, "likelihood.dat") if out_dir else None
+    ckpt_path = (
+        os.path.join(out_dir, "checkpoint.npz")
+        if out_dir and config.checkpoint_every
+        else None
+    )
     result = trainer.fit(
         batches,
         corpus.num_docs,
         likelihood_file=ll_path,
         progress=progress,
         initial_log_beta=initial_log_beta,
+        checkpoint_path=ckpt_path,
     )
     if num_terms != corpus.num_terms:
         result.log_beta = result.log_beta[:, : corpus.num_terms]

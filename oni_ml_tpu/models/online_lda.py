@@ -25,6 +25,7 @@ so each micro-batch moves only its own tokens over PCIe/ICI.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Callable, Iterable, Sequence
@@ -45,12 +46,15 @@ class StreamStepInfo:
     step: int
     rho: float
     batch_docs: int
-    likelihood: float          # ELBO local term over the micro-batch
+    # ELBO local term over the micro-batch.  Kept as a DEVICE scalar so the
+    # streaming hot path never blocks on a host sync between micro-batches;
+    # float(info.likelihood) materializes it on demand.
+    likelihood: "jnp.ndarray"
     tokens: int
 
     @property
     def per_token_ll(self) -> float:
-        return self.likelihood / max(self.tokens, 1)
+        return float(self.likelihood) / max(self.tokens, 1)
 
 
 class OnlineLDATrainer:
@@ -77,11 +81,13 @@ class OnlineLDATrainer:
         total_docs: int,
         e_step_fn: Callable | None = None,
         mesh=None,
+        checkpoint_path: str | None = None,
     ):
         self.config = config
         self.num_terms = num_terms
         self.total_docs = total_docs
         self.mesh = mesh
+        self.checkpoint_path = checkpoint_path
         self.step_count = 0
         self.history: list[StreamStepInfo] = []
         dtype = jnp.dtype(config.compute_dtype)
@@ -103,6 +109,22 @@ class OnlineLDATrainer:
             key, 100.0, (config.num_topics, num_terms), dtype
         ) / 100.0
         self._alpha = jnp.asarray(config.alpha, dtype)
+        if checkpoint_path is not None and os.path.exists(checkpoint_path):
+            from .lda import load_checkpoint
+
+            ckpt = load_checkpoint(checkpoint_path)
+            if ckpt["log_beta"].shape != self._lam.shape:
+                raise ValueError(
+                    f"checkpoint lambda shape {ckpt['log_beta'].shape} does "
+                    f"not match ({config.num_topics}, {num_terms})"
+                )
+            self._lam = jnp.asarray(ckpt["log_beta"], dtype)  # holds lambda
+            self.step_count = ckpt["em_iter"]
+            self.history = [
+                StreamStepInfo(step=i + 1, rho=rho, batch_docs=0,
+                               likelihood=jnp.asarray(ll, dtype), tokens=0)
+                for i, (ll, rho) in enumerate(ckpt["likelihoods"])
+            ]
         if mesh is not None:
             from ..parallel.mesh import replicated
 
@@ -165,10 +187,25 @@ class OnlineLDATrainer:
             step=self.step_count,
             rho=rho,
             batch_docs=int(batch.doc_mask.sum()),
-            likelihood=float(ll),
+            likelihood=ll,  # device scalar; no sync on the hot path
             tokens=int(batch.counts.sum()),
         )
         self.history.append(info)
+        if (
+            self.checkpoint_path
+            and cfg.checkpoint_every
+            and self.step_count % cfg.checkpoint_every == 0
+        ):
+            from .lda import _is_coordinator, save_checkpoint
+
+            if _is_coordinator():
+                save_checkpoint(
+                    self.checkpoint_path,
+                    self._to_host(self._lam),
+                    float(self._alpha),
+                    self.step_count,
+                    [(float(h.likelihood), h.rho) for h in self.history],
+                )
         return info
 
     def fit_stream(
@@ -185,11 +222,9 @@ class OnlineLDATrainer:
     # -- model extraction ---------------------------------------------------
 
     def _to_host(self, x) -> np.ndarray:
-        if self.mesh is not None and not x.is_fully_addressable:
-            from jax.experimental import multihost_utils
+        from .lda import to_host
 
-            x = multihost_utils.process_allgather(x, tiled=True)
-        return np.asarray(x, np.float64)
+        return to_host(x, self.mesh)
 
     def log_beta(self) -> np.ndarray:
         """Point-estimate topics: log E_q[beta] = log(lambda / sum lambda),
@@ -225,7 +260,14 @@ class OnlineLDATrainer:
             if batches is not None
             else np.zeros((0, self.config.num_topics))
         )
-        lls = [(h.likelihood, h.rho) for h in self.history]
+        # likelihood.dat contract: column 2 is the relative change between
+        # consecutive entries (README.md:119), here between micro-batch
+        # ELBOs — NOT the learning rate, which lives in history[i].rho.
+        raw = [float(h.likelihood) for h in self.history]
+        lls = [
+            (ll, abs((raw[i - 1] - ll) / raw[i - 1]) if i else 1.0)
+            for i, ll in enumerate(raw)
+        ]
         return LDAResult(
             log_beta=self.log_beta(),
             gamma=gamma,
@@ -256,17 +298,34 @@ def train_corpus_online(
     batches = make_batches(
         corpus, batch_size=config.batch_size, min_bucket_len=config.min_bucket_len
     )
+    ckpt_path = (
+        os.path.join(out_dir, "checkpoint.npz")
+        if out_dir and config.checkpoint_every
+        else None
+    )
     trainer = OnlineLDATrainer(
         config,
         num_terms=corpus.num_terms,
         total_docs=corpus.num_docs,
         mesh=mesh,
+        checkpoint_path=ckpt_path,
     )
+    # The epoch-shuffled stream order is deterministic in the seed, so a
+    # resumed run fast-forwards past the first `step_count` micro-batches.
+    done = trainer.step_count
     rng = np.random.default_rng(config.seed)
     for _ in range(epochs):
         order = rng.permutation(len(batches))
-        trainer.fit_stream((batches[i] for i in order), progress=progress)
+        skip, done = min(done, len(order)), max(done - len(order), 0)
+        trainer.fit_stream(
+            (batches[i] for i in order[skip:]), progress=progress
+        )
     result = trainer.result(batches, corpus.num_docs)
+    if ckpt_path and os.path.exists(ckpt_path):
+        from .lda import _is_coordinator
+
+        if _is_coordinator():
+            os.remove(ckpt_path)
     if out_dir:
         result.save(out_dir, num_terms=corpus.num_terms)
     return result

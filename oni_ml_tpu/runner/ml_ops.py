@@ -164,9 +164,16 @@ def stage_pre(ctx: RunContext) -> dict:
             fb.dup_factor,
             fb.nonthreatening_severity,
         )
+        cuts = None
+        if cfg.qtiles_path:
+            from ..features.qtiles import read_flow_qtiles
+
+            cuts = read_flow_qtiles(cfg.qtiles_path)
         with open(cfg.flow_path) as f:
             features = featurize_flow(
-                (line.rstrip("\n") for line in f), feedback_rows=fb_rows
+                (line.rstrip("\n") for line in f),
+                feedback_rows=fb_rows,
+                precomputed_cuts=cuts,
             )
     else:
         fb_rows = read_dns_feedback_rows(
@@ -316,12 +323,14 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
         flow_path=args.flow_path or env.get("FLOW_PATH", ""),
         dns_path=args.dns_path or env.get("DNS_PATH", ""),
         top_domains_path=args.top_domains or "",
+        qtiles_path=args.qtiles or "",
         lda=LDAConfig(
             num_topics=args.topics,
             alpha_init=args.alpha,
             em_max_iters=args.em_max_iters,
             batch_size=args.batch_size,
             seed=args.seed,
+            checkpoint_every=args.checkpoint_every,
         ),
         online_lda=OnlineLDAConfig(
             num_topics=args.topics,
@@ -331,6 +340,7 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
             kappa=args.kappa,
             batch_size=args.batch_size,
             seed=args.seed,
+            checkpoint_every=args.checkpoint_every,
         ),
         feedback=FeedbackConfig(
             dup_factor=(
@@ -360,11 +370,21 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--flow-path", default=None)
     p.add_argument("--dns-path", default=None)
     p.add_argument("--top-domains", default=None, help="top-1m.csv path")
+    p.add_argument(
+        "--qtiles", default=None,
+        help="precomputed flow quantile cuts file (flow_qtiles format); "
+        "skips the in-run ECDF pass and pins word identity across days",
+    )
     p.add_argument("--topics", type=int, default=20)
     p.add_argument("--alpha", type=float, default=2.5)
     p.add_argument("--em-max-iters", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=1024)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--checkpoint-every", type=int, default=0,
+        help="persist (beta, alpha, iter) every N EM iterations; an "
+        "interrupted lda stage resumes from the checkpoint (0=off)",
+    )
     p.add_argument(
         "--dup-factor", type=int, default=None,
         help="feedback duplication (default: DUPFACTOR env or 1000)",
@@ -388,9 +408,27 @@ def main(argv: list[str] | None = None) -> int:
         "--mesh", default=None, metavar="DATA,MODEL",
         help="device mesh shape; MODEL>1 shards the vocabulary",
     )
+    p.add_argument(
+        "--multihost", action="store_true",
+        help="initialize jax.distributed (one controller process per host; "
+        "coordinator/process env via JAX_COORDINATOR_ADDRESS etc.) so the "
+        "mesh spans all hosts' devices over ICI/DCN — the reference's "
+        "mpiexec -f machinefile fan-out (ml_ops.sh:80), minus MPI",
+    )
+    p.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of the whole run into DIR "
+        "(view with TensorBoard); replaces the reference's bash `time` "
+        "stage timing (SURVEY §5.1)",
+    )
     args = p.parse_args(argv)
     if len(args.fdate) != 8 or not args.fdate.isdigit():
         p.error("fdate must be YYYYMMDD (ml_ops.sh:8-20)")
+
+    if args.multihost:
+        import jax
+
+        jax.distributed.initialize()
 
     mesh = None
     vocab_sharded = False
@@ -403,16 +441,27 @@ def main(argv: list[str] | None = None) -> int:
     stages = (
         [Stage(s) for s in args.stages.split(",")] if args.stages else None
     )
-    run_pipeline(
-        _build_config(args),
-        args.fdate,
-        args.dsource,
-        force=args.force,
-        stages=stages,
-        mesh=mesh,
-        vocab_sharded=vocab_sharded,
-        online=args.online,
-    )
+
+    import contextlib
+
+    profile_ctx = contextlib.nullcontext()
+    if args.profile:
+        import jax
+
+        profile_ctx = jax.profiler.trace(
+            args.profile, create_perfetto_trace=True
+        )
+    with profile_ctx:
+        run_pipeline(
+            _build_config(args),
+            args.fdate,
+            args.dsource,
+            force=args.force,
+            stages=stages,
+            mesh=mesh,
+            vocab_sharded=vocab_sharded,
+            online=args.online,
+        )
     return 0
 
 

@@ -1,0 +1,94 @@
+"""Precomputed quantile cuts side-tool (SURVEY §2.7): file format
+roundtrip, exactness vs the in-run ECDF, and runner integration."""
+
+import numpy as np
+
+from oni_ml_tpu.features import featurize_flow
+from oni_ml_tpu.features.qtiles import (
+    compute_flow_qtiles,
+    main as qtiles_main,
+    read_flow_qtiles,
+    write_flow_qtiles,
+)
+
+from test_features import flow_row
+
+
+def _day_lines(n=50, seed=3):
+    rng = np.random.default_rng(seed)
+    lines = ["header,line"]
+    for _ in range(n):
+        lines.append(
+            flow_row(
+                hour=int(rng.integers(0, 24)),
+                minute=int(rng.integers(0, 60)),
+                second=int(rng.integers(0, 60)),
+                sip=f"10.0.0.{rng.integers(1, 9)}",
+                dip=f"172.16.0.{rng.integers(1, 9)}",
+                col10=str(rng.choice([80, 443, 55000])),
+                col11=str(rng.choice([80, 6000, 70000])),
+                ipkt=str(rng.integers(1, 100)),
+                ibyt=str(rng.integers(40, 10000)),
+            )
+        )
+    return lines
+
+
+def test_roundtrip(tmp_path):
+    t = np.array([0.0, 1.5, 2.25, 7.416666666666667])
+    b = np.array([0.0, 52.0, 3569.0])
+    p = np.array([0.0, 1.0, 14.0])
+    path = str(tmp_path / "flow_qtiles")
+    write_flow_qtiles(path, t, b, p)
+    t2, b2, p2 = read_flow_qtiles(path)
+    np.testing.assert_array_equal(t, t2)
+    np.testing.assert_array_equal(b, b2)
+    np.testing.assert_array_equal(p, p2)
+
+
+def test_parses_reference_shaped_line(tmp_path):
+    # Same shape as the reference's checked-in flow_qtiles: three
+    # space-separated lists (ibyt, ipkt, time) joined by commas.
+    line = "0 52 76 104,0 1 1 1 1 2,0 2.3 4.783333333333333\n"
+    path = tmp_path / "q"
+    path.write_text(line)
+    time, ibyt, ipkt = read_flow_qtiles(str(path))
+    assert ibyt.tolist() == [0, 52, 76, 104]
+    assert ipkt.tolist() == [0, 1, 1, 1, 1, 2]
+    assert time[1] == 2.3
+
+
+def test_precomputed_cuts_reproduce_inline_words():
+    lines = _day_lines()
+    inline = featurize_flow(lines)
+    cuts = compute_flow_qtiles(lines)
+    np.testing.assert_array_equal(cuts[0], inline.time_cuts)
+    np.testing.assert_array_equal(cuts[1], inline.ibyt_cuts)
+    np.testing.assert_array_equal(cuts[2], inline.ipkt_cuts)
+    pre = featurize_flow(lines, precomputed_cuts=cuts)
+    assert pre.src_word == inline.src_word
+    assert pre.dest_word == inline.dest_word
+
+
+def test_cli_and_runner_integration(tmp_path):
+    lines = _day_lines()
+    raw = tmp_path / "flow.csv"
+    raw.write_text("\n".join(lines) + "\n")
+    qfile = str(tmp_path / "flow_qtiles")
+    assert qtiles_main([str(raw), qfile]) == 0
+
+    from oni_ml_tpu.config import LDAConfig, PipelineConfig, ScoringConfig
+    from oni_ml_tpu.runner import run_pipeline
+
+    base = dict(
+        data_dir=str(tmp_path),
+        flow_path=str(raw),
+        lda=LDAConfig(num_topics=3, em_max_iters=3, batch_size=32,
+                      min_bucket_len=16, seed=1),
+        scoring=ScoringConfig(threshold=1.1),
+    )
+    run_pipeline(PipelineConfig(**base), "20160122", "flow")
+    run_pipeline(PipelineConfig(**base, qtiles_path=qfile), "20160123", "flow")
+    wc1 = (tmp_path / "20160122" / "word_counts.dat").read_text()
+    wc2 = (tmp_path / "20160123" / "word_counts.dat").read_text()
+    assert wc1 == wc2  # cuts from the same day -> identical corpus

@@ -187,6 +187,25 @@ def test_runner_cli_smoke(flow_day, capsys):
     assert (tmp_path / "20160122" / "flow_results.csv").exists()
 
 
+def test_runner_profile_flag(flow_day, tmp_path_factory):
+    cfg, tmp_path = flow_day
+    from oni_ml_tpu.runner.ml_ops import main
+
+    prof_dir = str(tmp_path_factory.mktemp("prof"))
+    rc = main([
+        "20160125", "flow", "1.1",
+        "--data-dir", str(tmp_path), "--flow-path", cfg.flow_path,
+        "--topics", "3", "--em-max-iters", "2", "--batch-size", "32",
+        "--profile", prof_dir,
+    ])
+    assert rc == 0
+    import os
+    captured = [
+        os.path.join(r, f) for r, _, fs in os.walk(prof_dir) for f in fs
+    ]
+    assert captured, "profiler produced no trace files"
+
+
 def test_runner_rejects_bad_date():
     from oni_ml_tpu.runner.ml_ops import main
 
