@@ -87,6 +87,13 @@ class Corpus:
 
     @classmethod
     def from_word_counts_file(cls, path: str) -> "Corpus":
+        """Build from a word_counts file, preferring the native (C++)
+        ingest when available — identical output, one buffered pass
+        (io/native.py); set ONI_ML_TPU_NO_NATIVE=1 to force Python."""
+        from . import native
+
+        if native.available():
+            return native.load_corpus(path)
         return cls.from_word_counts(formats.read_word_counts(path))
 
     @classmethod
