@@ -42,6 +42,10 @@ class LDAConfig:
     seed: int = 0
     # Checkpoint every N EM iterations (0 = disabled).
     checkpoint_every: int = 0
+    # Run up to this many EM iterations per device program (models/fused.py):
+    # the convergence check happens on device and the host syncs only at
+    # chunk boundaries.  0 or 1 falls back to one dispatch per iteration.
+    fused_em_chunk: int = 8
 
     @property
     def k(self) -> int:

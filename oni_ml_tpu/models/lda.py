@@ -33,6 +33,7 @@ from jax.scipy.special import digamma, polygamma
 from ..config import LDAConfig
 from ..io import Batch, Corpus, formats, make_batches
 from ..ops import estep
+from . import fused
 
 
 # ---------------------------------------------------------------------------
@@ -196,6 +197,8 @@ class LDATrainer:
         self.mesh = mesh
         self.vocab_sharded = vocab_sharded
         base = e_step_fn or estep.e_step
+        self._e_base = base
+        self._m_base = m_step_fn or estep.m_step
         self._e_step = jax.jit(
             partial(
                 base,
@@ -203,7 +206,7 @@ class LDATrainer:
                 var_tol=config.var_tol,
             )
         )
-        self._m_step = jax.jit(m_step_fn or estep.m_step)
+        self._m_step = jax.jit(self._m_base)
 
     def fit(
         self,
@@ -280,17 +283,6 @@ class LDATrainer:
             def put(x):
                 return jnp.asarray(x)
 
-        dev_batches = [
-            (
-                put(b.word_idx),
-                put(b.counts.astype(dtype)),
-                put(b.doc_mask.astype(dtype)),
-            )
-            for b in batches
-        ]
-        doc_index = [b.doc_index for b in batches]
-        doc_masks = [b.doc_mask for b in batches]
-
         gamma_out = np.zeros((num_docs, k), dtype=np.float64)
         likelihoods: list[tuple[float, float]] = list(restored[:start_it])
         ll_file = open(likelihood_file, "w") if likelihood_file else None
@@ -298,48 +290,14 @@ class LDATrainer:
             for ll_r, conv_r in likelihoods:
                 formats.append_likelihood(ll_file, ll_r, conv_r)
         ll_prev = likelihoods[-1][0] if likelihoods else None
-        it = start_it
+        loop = (
+            self._fused_loop if cfg.fused_em_chunk > 1 else self._stepwise_loop
+        )
         try:
-            for it in range(start_it + 1, cfg.em_max_iters + 1):
-                total_ss = jnp.zeros((v, k), dtype)
-                total_ll = jnp.zeros((), dtype)
-                total_ass = jnp.zeros((), dtype)
-                gammas = []
-                for widx, cnts, mask in dev_batches:
-                    res = self._e_step(log_beta, alpha, widx, cnts, mask)
-                    total_ss = total_ss + res.suff_stats
-                    total_ll = total_ll + res.likelihood
-                    total_ass = total_ass + res.alpha_ss
-                    gammas.append(res.gamma)
-
-                log_beta = self._m_step(total_ss)
-                if cfg.estimate_alpha:
-                    alpha = update_alpha(total_ass, alpha, num_docs, k)
-
-                ll = float(total_ll)
-                conv = (
-                    abs((ll_prev - ll) / ll_prev) if ll_prev is not None else 1.0
-                )
-                likelihoods.append((ll, conv))
-                if ll_file:
-                    formats.append_likelihood(ll_file, ll, conv)
-                    ll_file.flush()
-                if progress:
-                    progress(it, ll, conv)
-                if (
-                    checkpoint_path
-                    and cfg.checkpoint_every
-                    and it % cfg.checkpoint_every == 0
-                    and _is_coordinator()
-                ):
-                    save_checkpoint(
-                        checkpoint_path, to_host(log_beta, self.mesh),
-                        float(alpha), it, likelihoods,
-                    )
-
-                if ll_prev is not None and conv < cfg.em_tol:
-                    break
-                ll_prev = ll
+            log_beta, alpha, it = loop(
+                batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
+                likelihoods, ll_file, progress, checkpoint_path, gamma_out,
+            )
         finally:
             if ll_file:
                 ll_file.close()
@@ -350,11 +308,6 @@ class LDATrainer:
         ):
             os.remove(checkpoint_path)  # run completed; day dir stays clean
 
-        for g, di, dm in zip(gammas, doc_index, doc_masks):
-            g = to_host(g, self.mesh)
-            sel = dm == 1
-            gamma_out[di[sel]] = g[sel]
-
         return LDAResult(
             log_beta=to_host(log_beta, self.mesh),
             gamma=gamma_out,
@@ -362,6 +315,173 @@ class LDATrainer:
             likelihoods=likelihoods,
             em_iters=it,
         )
+
+    # -- EM drivers ---------------------------------------------------------
+    #
+    # Both share the fit() contract: advance (log_beta, alpha) from
+    # `start_it` until convergence or em_max_iters, appending to
+    # `likelihoods`, streaming `ll_file`/`progress`/checkpoints, and
+    # scattering the final E-step's gammas into `gamma_out`.
+
+    def _log_iteration(
+        self, it, ll, ll_prev, likelihoods, ll_file, progress
+    ) -> float:
+        """Record one EM iteration host-side; returns its convergence."""
+        conv = abs((ll_prev - ll) / ll_prev) if ll_prev is not None else 1.0
+        likelihoods.append((ll, conv))
+        if ll_file:
+            formats.append_likelihood(ll_file, ll, conv)
+            ll_file.flush()
+        if progress:
+            progress(it, ll, conv)
+        return conv
+
+    def _maybe_checkpoint(self, checkpoint_path, log_beta, alpha, it,
+                          likelihoods) -> None:
+        cfg = self.config
+        if (
+            checkpoint_path
+            and cfg.checkpoint_every
+            and it % cfg.checkpoint_every == 0
+        ):
+            # to_host is collective on multi-host meshes (process_allgather)
+            # — every process must reach it; only the coordinator writes.
+            beta_host = to_host(log_beta, self.mesh)
+            if _is_coordinator():
+                save_checkpoint(
+                    checkpoint_path, beta_host, float(alpha), it, likelihoods,
+                )
+
+    def _stepwise_loop(
+        self, batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
+        likelihoods, ll_file, progress, checkpoint_path, gamma_out,
+    ):
+        """One device dispatch per batch per EM iteration; the likelihood
+        syncs to the host every iteration (convergence decided in float64).
+        Kept for fused_em_chunk <= 1 and as the numerical cross-check for
+        the fused driver."""
+        cfg = self.config
+        k, v = cfg.num_topics, self.num_terms
+        dtype = jnp.dtype(cfg.compute_dtype)
+        dev_batches = [
+            (
+                put(b.word_idx),
+                put(b.counts.astype(dtype)),
+                put(b.doc_mask.astype(dtype)),
+            )
+            for b in batches
+        ]
+        gammas = []
+        it = start_it
+        for it in range(start_it + 1, cfg.em_max_iters + 1):
+            total_ss = jnp.zeros((v, k), dtype)
+            total_ll = jnp.zeros((), dtype)
+            total_ass = jnp.zeros((), dtype)
+            gammas = []
+            for widx, cnts, mask in dev_batches:
+                res = self._e_step(log_beta, alpha, widx, cnts, mask)
+                total_ss = total_ss + res.suff_stats
+                total_ll = total_ll + res.likelihood
+                total_ass = total_ass + res.alpha_ss
+                gammas.append(res.gamma)
+
+            log_beta = self._m_step(total_ss)
+            if cfg.estimate_alpha:
+                alpha = update_alpha(total_ass, alpha, num_docs, k)
+
+            ll = float(total_ll)
+            conv = self._log_iteration(
+                it, ll, ll_prev, likelihoods, ll_file, progress
+            )
+            self._maybe_checkpoint(
+                checkpoint_path, log_beta, alpha, it, likelihoods
+            )
+            if ll_prev is not None and conv < cfg.em_tol:
+                break
+            ll_prev = ll
+
+        for g, b in zip(gammas, batches):
+            g = to_host(g, self.mesh)
+            sel = b.doc_mask == 1
+            gamma_out[b.doc_index[sel]] = g[sel]
+        return log_beta, alpha, it
+
+    def _fused_loop(
+        self, batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
+        likelihoods, ll_file, progress, checkpoint_path, gamma_out,
+    ):
+        """Device-resident EM (models/fused.py): up to fused_em_chunk
+        iterations per compiled call, convergence checked on device in
+        compute dtype; the host logs / checkpoints at chunk boundaries."""
+        cfg = self.config
+        k = cfg.num_topics
+        dtype = jnp.dtype(cfg.compute_dtype)
+
+        put_stacked = put
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS
+
+            stacked_sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+
+            def put_stacked(x):
+                return jax.device_put(jnp.asarray(x), stacked_sh)
+
+        groups = fused.stack_batches(
+            batches, np.dtype(cfg.compute_dtype), put_stacked
+        )
+        run_chunk = fused.make_chunk_runner(
+            num_docs=num_docs,
+            num_topics=k,
+            num_terms=self.num_terms,
+            chunk=cfg.fused_em_chunk,
+            var_max_iters=cfg.var_max_iters,
+            var_tol=cfg.var_tol,
+            em_tol=cfg.em_tol,
+            estimate_alpha=cfg.estimate_alpha,
+            e_step_fn=self._e_base,
+            m_step_fn=self._m_base,
+        )
+
+        ll_prev_dev = jnp.asarray(
+            np.nan if ll_prev is None else ll_prev, dtype
+        )
+        it = start_it
+        res = None
+        while it < cfg.em_max_iters:
+            stop = min(it + cfg.fused_em_chunk, cfg.em_max_iters)
+            if checkpoint_path and cfg.checkpoint_every:
+                next_ckpt = (
+                    it // cfg.checkpoint_every + 1
+                ) * cfg.checkpoint_every
+                stop = min(stop, next_ckpt)
+            res = run_chunk(
+                log_beta, alpha, ll_prev_dev, groups.arrays, stop - it
+            )
+            log_beta, alpha, ll_prev_dev = res.log_beta, res.alpha, res.ll_prev
+            steps = int(res.steps_done)
+            for ll in np.asarray(res.lls[:steps], np.float64):
+                it += 1
+                ll = float(ll)
+                self._log_iteration(
+                    it, ll, ll_prev, likelihoods, ll_file, progress
+                )
+                ll_prev = ll
+            self._maybe_checkpoint(
+                checkpoint_path, log_beta, alpha, it, likelihoods
+            )
+            if bool(res.converged) or steps == 0:
+                break
+
+        if res is not None and int(res.steps_done) > 0:
+            for g_arr, slots in zip(res.gammas, groups.batch_slots):
+                g_group = to_host(g_arr, self.mesh)  # one transfer per group
+                for j, bi in enumerate(slots):
+                    b = batches[bi]
+                    sel = b.doc_mask == 1
+                    gamma_out[b.doc_index[sel]] = g_group[j][sel]
+        return log_beta, alpha, it
 
 
 def train_corpus(

@@ -1,0 +1,92 @@
+"""Fused (device-resident chunked) EM vs the stepwise driver.
+
+The two drivers must produce the same training trajectory: the fused loop
+only changes WHERE the loop control runs (device vs host), not the math.
+"""
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.config import LDAConfig
+from oni_ml_tpu.io import make_batches
+from oni_ml_tpu.models import LDATrainer, train_corpus
+
+import reference_lda as ref
+from test_lda import corpus_from_docs
+
+
+@pytest.fixture(scope="module")
+def problem():
+    docs, _ = ref.make_synthetic_corpus(
+        num_docs=48, num_terms=40, num_topics=3, seed=11
+    )
+    return corpus_from_docs(docs, 40)
+
+
+def run(corpus, **cfg_kw):
+    cfg = LDAConfig(
+        num_topics=4, alpha_init=2.5, batch_size=16, min_bucket_len=4,
+        seed=3, **cfg_kw
+    )
+    return train_corpus(corpus, cfg)
+
+
+def test_fused_matches_stepwise_fixed_iters(problem):
+    # em_tol=0 pins the iteration count; small batch/bucket sizes force
+    # multiple shape groups and multiple batches per group.
+    a = run(problem, em_max_iters=6, em_tol=0.0, fused_em_chunk=0)
+    b = run(problem, em_max_iters=6, em_tol=0.0, fused_em_chunk=4)
+    assert a.em_iters == b.em_iters == 6
+    np.testing.assert_allclose(
+        [l for l, _ in a.likelihoods], [l for l, _ in b.likelihoods],
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.exp(a.log_beta), np.exp(b.log_beta), atol=1e-4
+    )
+    np.testing.assert_allclose(a.gamma, b.gamma, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(a.alpha, b.alpha, rtol=1e-4)
+
+
+def test_fused_convergence_stop(problem):
+    # A loose tolerance converges well before em_max_iters; the on-device
+    # check must stop at the same iteration as the host-side check.
+    a = run(problem, em_max_iters=50, em_tol=1e-3, fused_em_chunk=0)
+    b = run(problem, em_max_iters=50, em_tol=1e-3, fused_em_chunk=8)
+    assert a.em_iters < 50  # the tolerance actually fired
+    assert b.em_iters == a.em_iters
+    assert len(b.likelihoods) == b.em_iters
+    assert b.likelihoods[-1][1] < 1e-3  # logged conv reflects the stop
+
+
+def test_fused_chunk_boundaries_do_not_matter(problem):
+    # chunk=1..3 slice the same 5 iterations differently; results agree.
+    runs = [
+        run(problem, em_max_iters=5, em_tol=0.0, fused_em_chunk=c)
+        for c in (2, 3, 5)
+    ]
+    for r in runs[1:]:
+        np.testing.assert_allclose(
+            [l for l, _ in runs[0].likelihoods],
+            [l for l, _ in r.likelihoods],
+            rtol=1e-5,
+        )
+
+
+def test_fused_progress_and_likelihood_stream(problem, tmp_path):
+    seen = []
+    cfg = LDAConfig(
+        num_topics=4, em_max_iters=5, em_tol=0.0, batch_size=16,
+        min_bucket_len=4, fused_em_chunk=2, seed=3,
+    )
+    out = tmp_path / "day"
+    out.mkdir()
+    res = train_corpus(
+        problem, cfg, out_dir=str(out),
+        progress=lambda it, ll, conv: seen.append((it, ll, conv)),
+    )
+    assert [it for it, _, _ in seen] == [1, 2, 3, 4, 5]
+    lines = (out / "likelihood.dat").read_text().strip().splitlines()
+    assert len(lines) == 5
+    ll0 = float(lines[0].split("\t")[0])
+    np.testing.assert_allclose(ll0, res.likelihoods[0][0], rtol=1e-6)
