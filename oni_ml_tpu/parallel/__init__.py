@@ -5,6 +5,7 @@ from .mesh import (
     beta_sharding,
     initialize_distributed,
     make_mesh,
+    mesh_from_spec,
     replicated,
     vocab_sharding,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "make_mesh",
+    "mesh_from_spec",
     "initialize_distributed",
     "batch_sharding",
     "beta_sharding",

@@ -52,6 +52,23 @@ def make_mesh(data: int = -1, model: int = 1, devices=None) -> Mesh:
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
+def mesh_from_spec(spec: str) -> tuple[Mesh, bool]:
+    """Parse a "DATA,MODEL" mesh spec (CLI flag / env var) into a mesh
+    plus whether the vocabulary should shard (model axis > 1)."""
+    parts = spec.split(",")
+    if len(parts) != 2:
+        raise ValueError(
+            f"mesh spec must be 'DATA,MODEL' (e.g. '8,1'), got {spec!r}"
+        )
+    try:
+        data, model = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"mesh spec must be two integers 'DATA,MODEL', got {spec!r}"
+        ) from None
+    return make_mesh(data=data, model=model), model > 1
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

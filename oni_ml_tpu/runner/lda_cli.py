@@ -1,0 +1,119 @@
+"""Drop-in CLI for the reference's `lda` binary (oni-lda-c).
+
+The reference orchestrator invokes its MPI LDA engine as
+
+    mpiexec -n 20 -f machinefile ./lda est 2.5 20 settings.txt 20 \
+        ../FDATE/model.dat random ../FDATE
+
+(ml_ops.sh:80; argument meanings reconstructed in SURVEY.md §2.8).  This
+module accepts the same argument vector so an existing deployment can
+swap `mpiexec ... ./lda` for `python -m oni_ml_tpu.runner.lda_cli` and
+get the TPU engine with unchanged scripts:
+
+    python -m oni_ml_tpu.runner.lda_cli est 2.5 20 settings.txt 20 \
+        ../FDATE/model.dat random ../FDATE
+
+Differences from the reference, by design:
+- `<nproc>` is accepted and ignored — device parallelism comes from the
+  mesh (all local devices by default; ONI_ML_TPU_MESH="data,model" to
+  override), not from a rank count.
+- `random` is the only supported init (the reference's only used mode);
+  `seeded`/`manual` from stock lda-c are not reproduced.
+- per-rank `<i>.beta`/`<i>.gamma` shard files are not written — they
+  were an MPI implementation artifact; `final.*` and `likelihood.dat`
+  are the real contract (README.md:116-121).
+
+settings.txt uses Blei lda-c's key-value format:
+
+    var max iter 20
+    var convergence 1e-6
+    em max iter 100
+    em convergence 1e-4
+    alpha estimate
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from ..config import LDAConfig
+
+
+def read_settings(path: str) -> dict:
+    """Parse lda-c settings.txt: 'key words value' lines, last token the
+    value; `alpha estimate|fixed` is a bare flag."""
+    out: dict = {}
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip().lower()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if parts[:2] == ["alpha", "estimate"]:
+                out["estimate_alpha"] = True
+            elif parts[:2] == ["alpha", "fixed"]:
+                out["estimate_alpha"] = False
+            elif parts[:3] == ["var", "max", "iter"]:
+                n = int(float(parts[3]))
+                # lda-c treats -1 as "iterate until converged"; our loop
+                # bound is finite, so map it to a cap no real doc reaches.
+                out["var_max_iters"] = 10_000 if n == -1 else n
+            elif parts[:2] == ["var", "convergence"]:
+                out["var_tol"] = float(parts[2])
+            elif parts[:3] == ["em", "max", "iter"]:
+                out["em_max_iters"] = int(float(parts[3]))
+            elif parts[:2] == ["em", "convergence"]:
+                out["em_tol"] = float(parts[2])
+            # Unknown keys are ignored, like lda-c's fscanf-based reader.
+    return out
+
+
+def config_from_settings(path: str, alpha: float, k: int) -> LDAConfig:
+    return LDAConfig(num_topics=k, alpha_init=alpha, **read_settings(path))
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 8 or argv[0] != "est":
+        print(
+            "usage: python -m oni_ml_tpu.runner.lda_cli est <alpha> "
+            "<num_topics> <settings.txt> <nproc-ignored> <model.dat> "
+            "random <out_dir>",
+            file=sys.stderr,
+        )
+        return 2
+    _, alpha_s, k_s, settings_path, _nproc, corpus_path, init, out_dir = argv
+    if init != "random":
+        print(f"only 'random' init is supported, got {init!r}", file=sys.stderr)
+        return 2
+
+    from ..io import Corpus
+    from ..models import train_corpus
+
+    cfg = config_from_settings(settings_path, float(alpha_s), int(k_s))
+    corpus = Corpus.from_model_dat(corpus_path)
+
+    mesh = None
+    vocab_sharded = False
+    mesh_env = os.environ.get("ONI_ML_TPU_MESH", "")
+    if mesh_env:
+        from ..parallel.mesh import mesh_from_spec
+
+        mesh, vocab_sharded = mesh_from_spec(mesh_env)
+
+    os.makedirs(out_dir, exist_ok=True)
+    result = train_corpus(
+        corpus, cfg, out_dir=out_dir, mesh=mesh, vocab_sharded=vocab_sharded
+    )
+    final_ll = result.likelihoods[-1][0] if result.likelihoods else float("nan")
+    print(
+        f"em iterations: {result.em_iters}  "
+        f"final likelihood: {final_ll:.6f}  "
+        f"alpha: {result.alpha:.6f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -433,11 +433,12 @@ def main(argv: list[str] | None = None) -> int:
     mesh = None
     vocab_sharded = False
     if args.mesh:
-        from ..parallel import make_mesh
+        from ..parallel.mesh import mesh_from_spec
 
-        data, model = (int(x) for x in args.mesh.split(","))
-        mesh = make_mesh(data=data, model=model)
-        vocab_sharded = model > 1
+        try:
+            mesh, vocab_sharded = mesh_from_spec(args.mesh)
+        except ValueError as e:
+            p.error(str(e))
     stages = (
         [Stage(s) for s in args.stages.split(",")] if args.stages else None
     )

@@ -1,0 +1,98 @@
+"""lda-c-compatible CLI: settings.txt parsing + the reference argument
+vector producing the final.* / likelihood.dat contract."""
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.io import Corpus, formats
+from oni_ml_tpu.runner import lda_cli
+
+import reference_lda as ref
+from test_lda import corpus_from_docs
+
+
+def test_read_settings(tmp_path):
+    p = tmp_path / "settings.txt"
+    p.write_text(
+        "var max iter 30\n"
+        "var convergence 1e-7\n"
+        "em max iter 12\n"
+        "em convergence 1e-5\n"
+        "alpha estimate\n"
+    )
+    s = lda_cli.read_settings(str(p))
+    assert s == {
+        "var_max_iters": 30,
+        "var_tol": 1e-7,
+        "em_max_iters": 12,
+        "em_tol": 1e-5,
+        "estimate_alpha": True,
+    }
+
+
+def test_read_settings_alpha_fixed_and_unknown_keys(tmp_path):
+    p = tmp_path / "settings.txt"
+    p.write_text("alpha fixed\nsome future knob 3\nem max iter 5\n")
+    s = lda_cli.read_settings(str(p))
+    assert s == {"estimate_alpha": False, "em_max_iters": 5}
+
+
+def test_read_settings_unbounded_var_iter_sentinel(tmp_path):
+    # lda-c's inf-settings.txt uses -1 for "iterate until converged".
+    p = tmp_path / "settings.txt"
+    p.write_text("var max iter -1\n")
+    s = lda_cli.read_settings(str(p))
+    assert s["var_max_iters"] >= 10_000
+
+
+def test_mesh_from_spec():
+    from oni_ml_tpu.parallel import mesh_from_spec
+
+    mesh, vocab_sharded = mesh_from_spec("4,2")
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    assert vocab_sharded
+    mesh, vocab_sharded = mesh_from_spec("8,1")
+    assert not vocab_sharded
+    for bad in ("8", "a,b", "1,2,3"):
+        with pytest.raises(ValueError, match="DATA,MODEL"):
+            mesh_from_spec(bad)
+
+
+def test_cli_reference_argv_end_to_end(tmp_path):
+    docs, _ = ref.make_synthetic_corpus(
+        num_docs=20, num_terms=25, num_topics=3, seed=1
+    )
+    corpus = corpus_from_docs(docs, 25)
+    day = tmp_path / "day"
+    day.mkdir()
+    corpus.save(str(day))
+    settings = tmp_path / "settings.txt"
+    settings.write_text(
+        "var max iter 20\nvar convergence 1e-6\n"
+        "em max iter 8\nem convergence 0\nalpha estimate\n"
+    )
+
+    rc = lda_cli.main([
+        "est", "2.5", "4", str(settings), "20",
+        str(day / "model.dat"), "random", str(day),
+    ])
+    assert rc == 0
+
+    beta = formats.read_beta(str(day / "final.beta"))
+    gamma = formats.read_gamma(str(day / "final.gamma"))
+    assert beta.shape == (4, 25)
+    assert gamma.shape == (corpus.num_docs, 4)
+    np.testing.assert_allclose(np.exp(beta).sum(-1), np.ones(4), rtol=1e-4)
+    lls = [
+        float(line.split("\t")[0])
+        for line in (day / "likelihood.dat").read_text().splitlines()
+    ]
+    assert len(lls) == 8
+    assert lls[-1] > lls[0]  # training improved the likelihood
+
+
+def test_cli_rejects_bad_argv(capsys):
+    assert lda_cli.main(["est", "2.5"]) == 2
+    assert lda_cli.main([
+        "est", "2.5", "4", "s.txt", "20", "m.dat", "seeded", "out",
+    ]) == 2
