@@ -155,8 +155,28 @@ def e_step(
     doc_mask: jnp.ndarray,   # [B] f32, 1 for real docs
     var_max_iters: int,
     var_tol: float,
+    backend: str = "auto",
 ) -> EStepResult:
-    """Run the per-document fixed point to convergence for one batch."""
+    """Run the per-document fixed point to convergence for one batch.
+
+    backend: "auto" uses the Pallas VMEM-resident fixed point on TPU when
+    the shapes admit it (ops/pallas_estep.py), else pure XLA; "xla" /
+    "pallas" force a path (ONI_ML_TPU_ESTEP env var overrides "auto").
+    """
+    import os
+
+    if backend == "auto":
+        backend = os.environ.get("ONI_ML_TPU_ESTEP", "auto")
+    if backend != "xla":
+        from . import pallas_estep
+
+        b, l = word_idx.shape
+        eligible = pallas_estep.available(b, l, log_beta.shape[0])
+        if backend == "pallas" or eligible:
+            return pallas_estep.e_step(
+                log_beta, alpha, word_idx, counts, doc_mask,
+                var_max_iters, var_tol,
+            )
     V = log_beta.shape[1]
     beta_bt = gather_beta(log_beta, word_idx)
     gamma, iters = fixed_point(beta_bt, alpha, counts, doc_mask,

@@ -1,0 +1,221 @@
+"""Pallas TPU kernel for the E-step fixed point.
+
+The XLA path (ops/estep.py) re-reads the gathered beta slab from HBM on
+every variational iteration: ~20 iterations x 2 contractions over a
+[B, L, K] slab is the dominant HBM traffic of the whole EM loop.  This
+kernel blocks documents into VMEM-sized chunks and runs the ENTIRE
+gamma fixed point — digamma, phinorm, gamma update, convergence check —
+with the chunk's slab resident in VMEM, so the slab crosses HBM exactly
+once per EM iteration instead of once per variational iteration.
+
+Layout: the slab rides as [K, B, L] (documents and tokens on the two
+minor, tiled dimensions).  With K=20 topics a [B, L, K] block would pad
+the 128-lane axis 6.4x; [K, BB, L] blocks pad nothing and make the two
+per-iteration contractions K-unrolled VPU reductions over [BB, L] tiles.
+
+digamma is not a Mosaic primitive, so the kernel carries its own:
+the standard recurrence psi(x) = psi(x+1) - 1/x pushed until x >= 6
+(branchless, 7 steps covers any positive f32 gamma) followed by the
+asymptotic series ln x - 1/2x - 1/12x^2 + 1/120x^4 - 1/252x^6, whose
+truncation error at x >= 6 (~1e-9) is below f32 resolution.
+
+Semantics match estep.fixed_point except that convergence is decided
+per document block rather than over the full batch (each block stops
+iterating when ITS docs converge — the same per-shard independence the
+distributed layer already has), so converged gammas agree to var_tol.
+
+Reference anchor: this is the inner loop of oni-lda-c's doc E-step
+(SURVEY.md §2.8, §3.3) — the hot loop of the whole reference system.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import estep
+
+# Per-block VMEM budget for the slab (bytes), and a hard cap on docs per
+# block.  The kernel's working set is dominated not by the slab but by
+# the K-unrolled [BB, 1] column temporaries, which the lane tiling pads
+# to [BB, 128] each: at bb=512 those alone exceeded the 16MB scoped-VMEM
+# limit (by a bb-independent-looking 88KB, at several L) while bb=256
+# compiles with room to spare at every L we ship.
+_SLAB_VMEM_BUDGET = 2 * 1024 * 1024
+_MAX_BLOCK_DOCS = 256
+
+
+def digamma_pos(x: jnp.ndarray) -> jnp.ndarray:
+    """digamma for strictly positive x, f32-accurate.  Works inside
+    Pallas kernels (elementwise VPU ops only)."""
+    acc = jnp.zeros_like(x)
+    for _ in range(7):
+        small = x < 6.0
+        acc = acc - jnp.where(small, 1.0 / x, 0.0)
+        x = x + jnp.where(small, 1.0, 0.0)
+    inv = 1.0 / x
+    inv2 = inv * inv
+    series = (
+        jnp.log(x)
+        - 0.5 * inv
+        - inv2 * (1.0 / 12.0 - inv2 * (1.0 / 120.0 - inv2 / 252.0))
+    )
+    return series + acc
+
+
+def _fixed_point_kernel(
+    alpha_ref, slab_ref, counts_ref, mask_ref, gamma_ref, iters_ref,
+    *, var_max_iters: int, var_tol: float,
+):
+    """One grid step = one block of BB documents, slab block [K, BB, L]
+    in VMEM for the whole variational loop."""
+    k_topics = slab_ref.shape[0]
+    alpha = alpha_ref[0, 0]
+    counts = counts_ref[:]                      # [BB, L]
+    mask = mask_ref[:]                          # [BB, 1]
+    n_d = jnp.sum(counts, axis=1, keepdims=True)
+
+    def e_log_theta(gamma):
+        return digamma_pos(gamma) - digamma_pos(
+            jnp.sum(gamma, axis=1, keepdims=True)
+        )
+
+    def body(state):
+        gamma, it, _ = state
+        exp_et = jnp.exp(e_log_theta(gamma))    # [BB, K]
+        phinorm = jnp.zeros_like(counts)
+        for k in range(k_topics):               # K-unrolled VPU reduction
+            phinorm = phinorm + slab_ref[k] * exp_et[:, k : k + 1]
+        ratio = counts / (phinorm + 1e-30)
+        cols = []
+        for k in range(k_topics):
+            t = jnp.sum(ratio * slab_ref[k], axis=1, keepdims=True)
+            cols.append(alpha + exp_et[:, k : k + 1] * t)
+        gamma_new = jnp.concatenate(cols, axis=1)
+        delta = jnp.max(
+            jnp.mean(jnp.abs(gamma_new - gamma), axis=1, keepdims=True) * mask
+        )
+        return gamma_new, it + 1, delta
+
+    def cond(state):
+        _, it, delta = state
+        return jnp.logical_and(it < var_max_iters, delta > var_tol)
+
+    gamma0 = (alpha + n_d / k_topics) + jnp.zeros(
+        (counts.shape[0], k_topics), counts.dtype
+    )
+    gamma, iters, _ = jax.lax.while_loop(
+        cond,
+        body,
+        (gamma0, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, counts.dtype)),
+    )
+    gamma_ref[:] = gamma
+    iters_ref[pl.program_id(0), 0] = iters
+
+
+def pick_block(b: int, l: int, k: int) -> int | None:
+    """Largest power-of-two doc block whose slab fits the VMEM budget.
+    None if no valid block exists (fall back to the XLA path)."""
+    bb = 8
+    best = None
+    while bb <= min(b, _MAX_BLOCK_DOCS) and b % bb == 0:
+        if k * bb * l * 4 > _SLAB_VMEM_BUDGET:
+            break
+        best = bb
+        bb *= 2
+    return best
+
+
+def fixed_point(
+    slab_kbl: jnp.ndarray,   # [K, B, L] gathered beta, f32
+    alpha: jnp.ndarray,
+    counts: jnp.ndarray,     # [B, L]
+    doc_mask: jnp.ndarray,   # [B]
+    var_max_iters: int,
+    var_tol: float,
+    block: int | None = None,
+    interpret: bool = False,
+):
+    """Pallas gamma fixed point.  Returns (gamma [B, K], iters scalar)."""
+    k_topics, b, l = slab_kbl.shape
+    bb = block or pick_block(b, l, k_topics)
+    if bb is None:
+        raise ValueError(
+            f"no VMEM-feasible doc block for B={b}, L={l}, K={k_topics}"
+        )
+    grid = b // bb
+    kernel = functools.partial(
+        _fixed_point_kernel, var_max_iters=var_max_iters, var_tol=var_tol
+    )
+    gamma, iters = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (k_topics, bb, l), lambda i: (0, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((bb, l), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, k_topics), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            # Whole-array SMEM buffer; each grid step writes its own row.
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k_topics), slab_kbl.dtype),
+            jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.reshape(jnp.asarray(alpha, slab_kbl.dtype), (1, 1)),
+        slab_kbl,
+        counts,
+        jnp.reshape(doc_mask, (b, 1)),
+    )
+    return gamma, iters.max()
+
+
+def e_step(
+    log_beta: jnp.ndarray,   # [K, V]
+    alpha: jnp.ndarray,
+    word_idx: jnp.ndarray,   # [B, L]
+    counts: jnp.ndarray,     # [B, L]
+    doc_mask: jnp.ndarray,   # [B]
+    var_max_iters: int,
+    var_tol: float,
+    interpret: bool = False,
+) -> estep.EStepResult:
+    """Drop-in for estep.e_step with the fixed point in Pallas.
+
+    The slab is gathered once in [K, B, L] layout (zero tile padding),
+    the kernel converges gamma block-wise in VMEM, and the remaining
+    single-pass terms (phi, suff-stats scatter, ELBO) stay in XLA.
+    """
+    v = log_beta.shape[1]
+    slab_kbl = jnp.exp(log_beta)[:, word_idx]           # [K, B, L]
+    gamma, iters = fixed_point(
+        slab_kbl, alpha, counts, doc_mask, var_max_iters, var_tol,
+        interpret=interpret,
+    )
+    # Single-pass tail terms: same code as the XLA backend (XLA fuses the
+    # layout transpose into the consumers).
+    beta_bt = slab_kbl.transpose(1, 2, 0)               # [B, L, K]
+    phi_c, phinorm = estep.phi_weighted(beta_bt, gamma, counts, doc_mask)
+    suff = estep.suff_stats(phi_c, word_idx, v)
+    likelihood, alpha_ss = estep.batch_likelihood(
+        gamma, phinorm, counts, alpha, doc_mask
+    )
+    return estep.EStepResult(gamma, suff, alpha_ss, likelihood, iters)
+
+
+def available(b: int, l: int, k: int) -> bool:
+    """True when shapes admit a VMEM-feasible block and we're on TPU."""
+    return jax.default_backend() == "tpu" and pick_block(b, l, k) is not None

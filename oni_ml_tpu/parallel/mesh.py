@@ -66,6 +66,10 @@ def mesh_from_spec(spec: str) -> tuple[Mesh, bool]:
         raise ValueError(
             f"mesh spec must be two integers 'DATA,MODEL', got {spec!r}"
         ) from None
+    if data < 1 or model < 1:
+        raise ValueError(
+            f"mesh spec 'DATA,MODEL' axes must be >= 1, got {spec!r}"
+        )
     return make_mesh(data=data, model=model), model > 1
 
 

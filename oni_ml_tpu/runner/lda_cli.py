@@ -54,18 +54,19 @@ def read_settings(path: str) -> dict:
                 out["estimate_alpha"] = True
             elif parts[:2] == ["alpha", "fixed"]:
                 out["estimate_alpha"] = False
-            elif parts[:3] == ["var", "max", "iter"]:
+            elif parts[:3] == ["var", "max", "iter"] and len(parts) > 3:
                 n = int(float(parts[3]))
                 # lda-c treats -1 as "iterate until converged"; our loop
                 # bound is finite, so map it to a cap no real doc reaches.
                 out["var_max_iters"] = 10_000 if n == -1 else n
-            elif parts[:2] == ["var", "convergence"]:
+            elif parts[:2] == ["var", "convergence"] and len(parts) > 2:
                 out["var_tol"] = float(parts[2])
-            elif parts[:3] == ["em", "max", "iter"]:
+            elif parts[:3] == ["em", "max", "iter"] and len(parts) > 3:
                 out["em_max_iters"] = int(float(parts[3]))
-            elif parts[:2] == ["em", "convergence"]:
+            elif parts[:2] == ["em", "convergence"] and len(parts) > 2:
                 out["em_tol"] = float(parts[2])
-            # Unknown keys are ignored, like lda-c's fscanf-based reader.
+            # Unknown keys and truncated lines are ignored, like lda-c's
+            # fscanf-based reader.
     return out
 
 
@@ -100,7 +101,11 @@ def main(argv: list[str] | None = None) -> int:
     if mesh_env:
         from ..parallel.mesh import mesh_from_spec
 
-        mesh, vocab_sharded = mesh_from_spec(mesh_env)
+        try:
+            mesh, vocab_sharded = mesh_from_spec(mesh_env)
+        except ValueError as e:
+            print(f"ONI_ML_TPU_MESH: {e}", file=sys.stderr)
+            return 2
 
     os.makedirs(out_dir, exist_ok=True)
     result = train_corpus(
