@@ -1,0 +1,463 @@
+// Native netflow featurizer — the C++ fast path for the flow "pre"
+// stage (flow_pre_lda.scala featurization, reimplemented in
+// oni_ml_tpu/features/flow.py).  The Python path runs ~140k rows/s;
+// a 30-day corpus (BASELINE config 3) needs millions of rows/s, which
+// is exactly the scale the reference threw a Spark/YARN cluster at
+// (SURVEY.md §2.2).  This does the same work in one process: parse +
+// filter, numeric extraction, binning, word construction, and per-IP
+// word-count aggregation.
+//
+// Split of responsibilities with Python (oni_ml_tpu/features/native_flow.py):
+//   pass A (ingest_*): line filtering (removeHeader + 27-field check),
+//     numeric columns (fractional time, ibyt, ipkt, the swapped
+//     port columns), IP interning, raw-line retention.
+//   cuts: Python computes ECDF cuts from pass-A arrays with the SAME
+//     quantiles.ecdf_cuts used by the Python path — one semantics, one
+//     implementation (SURVEY §7 hard part (b)).
+//   pass B (finish): bin by cuts, adjust_port word construction with
+//     JVM-double formatting, word interning, first-seen-order word
+//     counts (src docs then dest docs, flow_pre_lda.scala:366-373).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).  All
+// pointers returned by getters stay valid until ffz_destroy.
+//
+// Known deliberate divergences from Python float():  underscored
+// numerals ("1_0") and unusual unicode whitespace are rejected (NaN) —
+// neither occurs in netflow CSVs.
+
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Interner {
+  std::unordered_map<std::string_view, int32_t> ids;
+  std::deque<std::string> arena;
+  // Export cache (blob + offsets), built lazily.
+  std::string blob;
+  std::vector<int64_t> offsets;
+
+  std::pair<int32_t, bool> intern(std::string_view s) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return {it->second, false};
+    arena.emplace_back(s);
+    int32_t id = (int32_t)ids.size();
+    ids.emplace(std::string_view(arena.back()), id);
+    return {id, true};
+  }
+
+  void build_export() {
+    if (!offsets.empty()) return;
+    offsets.reserve(arena.size() + 1);
+    offsets.push_back(0);
+    size_t total = 0;
+    for (const auto& s : arena) total += s.size();
+    blob.reserve(total);
+    for (const auto& s : arena) {
+      blob += s;
+      offsets.push_back((int64_t)blob.size());
+    }
+  }
+};
+
+// Python float(): trimmed token, optional sign, decimal/exponent/inf/nan;
+// anything else (or empty) -> NaN.  std::from_chars handles inf/nan but
+// not a leading '+'.
+double to_double(std::string_view s) {
+  size_t b = 0, e = s.size();
+  while (b < e && std::isspace((unsigned char)s[b])) b++;
+  while (e > b && std::isspace((unsigned char)s[e - 1])) e--;
+  if (b == e) return NAN;
+  std::string_view t = s.substr(b, e - b);
+  if (t[0] == '+') t.remove_prefix(1);
+  if (t.empty()) return NAN;
+  double v;
+  auto [p, ec] = std::from_chars(t.data(), t.data() + t.size(), v);
+  if (ec != std::errc() || p != t.data() + t.size()) return NAN;
+  return v;
+}
+
+// str(float) / JVM Double.toString for the values that occur here:
+// shortest round-trip repr with a ".0" suffix for integral values.
+std::string jvm_double(double v) {
+  char buf[64];
+  auto [p, ec] = std::to_chars(buf, buf + sizeof(buf), v);
+  (void)ec;
+  std::string s(buf, p);
+  if (s.find_first_of(".eEnNiI") == std::string::npos) s += ".0";
+  return s;
+}
+
+constexpr int NCOLS = 27;
+// Column indices (flow_pre_lda.scala:46-72); 10/11 keep the reference's
+// swapped dport/sport naming (oni_ml_tpu/features/flow.py docstring).
+constexpr int C_HOUR = 4, C_MIN = 5, C_SEC = 6, C_SIP = 8, C_DIP = 9;
+constexpr int C_10 = 10, C_11 = 11, C_IPKT = 16, C_IBYT = 17;
+
+struct Ffz {
+  bool skip_header;
+  bool have_header = false;
+  std::string header;
+
+  std::string lines;                   // stripped kept rows, concatenated
+  std::vector<int64_t> line_off{0};
+  std::vector<double> time_, ibyt_, ipkt_, c10_, c11_;
+  Interner ips;
+  std::vector<int32_t> sip_id, dip_id;
+  int64_t num_raw = -1;
+
+  // finish() outputs
+  std::vector<int32_t> tbin, bbin, pbin;
+  Interner words;
+  std::vector<int32_t> wp_id, sw_id, dw_id;
+  std::vector<int32_t> wc_ip, wc_word;
+  std::vector<int64_t> wc_cnt;
+
+  std::string error;
+
+  void add_line(std::string_view raw) {
+    // Mirror the Python path: lines are compared for removeHeader
+    // before strip, then stripped and split.
+    if (skip_header) {
+      if (!have_header) {
+        header.assign(raw);
+        have_header = true;
+        return;
+      }
+      if (raw == header) return;
+    }
+    size_t b = 0, e = raw.size();
+    while (b < e && std::isspace((unsigned char)raw[b])) b++;
+    while (e > b && std::isspace((unsigned char)raw[e - 1])) e--;
+    std::string_view line = raw.substr(b, e - b);
+
+    std::string_view f[NCOLS];
+    int nf = 0;
+    size_t start = 0;
+    for (size_t i = 0; i <= line.size(); i++) {
+      if (i == line.size() || line[i] == ',') {
+        if (nf < NCOLS) f[nf] = line.substr(start, i - start);
+        nf++;
+        start = i + 1;
+      }
+    }
+    if (nf != NCOLS) return;
+
+    lines.append(line.data(), line.size());
+    line_off.push_back((int64_t)lines.size());
+    double h = to_double(f[C_HOUR]), m = to_double(f[C_MIN]),
+           s = to_double(f[C_SEC]);
+    time_.push_back(h + m / 60.0 + s / 3600.0);
+    ibyt_.push_back(to_double(f[C_IBYT]));
+    ipkt_.push_back(to_double(f[C_IPKT]));
+    c10_.push_back(to_double(f[C_10]));
+    c11_.push_back(to_double(f[C_11]));
+    sip_id.push_back(ips.intern(f[C_SIP]).first);
+    dip_id.push_back(ips.intern(f[C_DIP]).first);
+  }
+
+  void ingest_buffer(const char* buf, int64_t len) {
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+      const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+      const char* stop = nl ? nl : end;
+      // Drop one trailing '\r' (text files from Windows exports).
+      const char* s2 = stop;
+      if (s2 > p && s2[-1] == '\r') s2--;
+      add_line(std::string_view(p, (size_t)(s2 - p)));
+      p = nl ? nl + 1 : end;
+    }
+  }
+};
+
+int bin_of(double v, const double* cuts, int n) {
+  int b = 0;
+  for (int i = 0; i < n; i++) b += v > cuts[i];  // NaN > c is false
+  return b;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ffz_create(int skip_header) {
+  Ffz* h = new Ffz();
+  h->skip_header = skip_header != 0;
+  return h;
+}
+void ffz_destroy(void* h) { delete (Ffz*)h; }
+const char* ffz_error(void* h) { return ((Ffz*)h)->error.c_str(); }
+
+int64_t ffz_ingest_file(void* hv, const char* path) {
+  Ffz* h = (Ffz*)hv;
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    h->error = std::string("cannot open ") + path;
+    return -1;
+  }
+  std::string pending;
+  std::vector<char> buf(1 << 22);
+  size_t got;
+  while ((got = fread(buf.data(), 1, buf.size(), f)) > 0) {
+    size_t start = 0;
+    // Find the last newline; carry the tail over to the next chunk.
+    size_t last_nl = got;
+    while (last_nl > 0 && buf[last_nl - 1] != '\n') last_nl--;
+    if (last_nl == 0) {
+      pending.append(buf.data(), got);
+      continue;
+    }
+    if (!pending.empty()) {
+      const char* nl = (const char*)memchr(buf.data(), '\n', got);
+      pending.append(buf.data(), (size_t)(nl - buf.data() + 1));
+      h->ingest_buffer(pending.data(), (int64_t)pending.size());
+      pending.clear();
+      start = (size_t)(nl - buf.data() + 1);
+    }
+    h->ingest_buffer(buf.data() + start, (int64_t)(last_nl - start));
+    if (last_nl < got) pending.assign(buf.data() + last_nl, got - last_nl);
+  }
+  if (!pending.empty())
+    h->ingest_buffer(pending.data(), (int64_t)pending.size());
+  // fread returns 0 both at EOF and on error (e.g. path is a directory,
+  // or a disk error mid-file): only ferror distinguishes a truncated
+  // read from a complete one.
+  if (ferror(f)) {
+    h->error = std::string("read error on ") + path;
+    fclose(f);
+    return -1;
+  }
+  fclose(f);
+  return (int64_t)h->time_.size();
+}
+
+int64_t ffz_ingest_buffer(void* hv, const char* buf, int64_t len) {
+  Ffz* h = (Ffz*)hv;
+  h->ingest_buffer(buf, len);
+  return (int64_t)h->time_.size();
+}
+
+void ffz_mark_raw(void* hv) {
+  Ffz* h = (Ffz*)hv;
+  h->num_raw = (int64_t)h->time_.size();
+}
+int64_t ffz_num_raw(void* hv) {
+  Ffz* h = (Ffz*)hv;
+  return h->num_raw >= 0 ? h->num_raw : (int64_t)h->time_.size();
+}
+int64_t ffz_num_events(void* hv) { return (int64_t)((Ffz*)hv)->time_.size(); }
+
+const double* ffz_num_time(void* h) { return ((Ffz*)h)->time_.data(); }
+const double* ffz_ibyt(void* h) { return ((Ffz*)h)->ibyt_.data(); }
+const double* ffz_ipkt(void* h) { return ((Ffz*)h)->ipkt_.data(); }
+
+int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
+               int nbc, const double* pc, int npc) {
+  Ffz* h = (Ffz*)hv;
+  // Bin values are at most the cut count; the word-cache key packs each
+  // into 12 bits and wp_id into 28.
+  if (ntc > 4095 || nbc > 4095 || npc > 4095) {
+    h->error = "cut lists longer than 4095 are not supported";
+    return -1;
+  }
+  size_t n = h->time_.size();
+  h->tbin.resize(n);
+  h->bbin.resize(n);
+  h->pbin.resize(n);
+  h->wp_id.resize(n);
+  h->sw_id.resize(n);
+  h->dw_id.resize(n);
+
+  // First-seen-order (doc, word) counts; src map emitted before dest
+  // (flow_pre_lda.scala:366-373 union order).
+  std::unordered_map<uint64_t, int64_t> src_pos, dst_pos;
+  src_pos.reserve(n);
+  dst_pos.reserve(n);
+  std::vector<int32_t> s_ip, s_w, d_ip, d_w;
+  std::vector<int64_t> s_c, d_c;
+
+  // Words are a function of (word_port, time_bin, ibyt_bin, ipkt_bin):
+  // the unique combinations number in the thousands while rows number in
+  // the millions, so cache (wp_id, bins) -> (base, prefixed) word ids and
+  // skip the string building on the hot path.  Port doubles are keyed by
+  // bit pattern (our NaNs are the single NAN constant from to_double).
+  std::unordered_map<uint64_t, int32_t> wp_cache;   // port bits -> wp_id
+  struct WordIds { int32_t base, prefixed; };
+  std::unordered_map<uint64_t, WordIds> word_cache; // wp_id+bins -> ids
+
+  std::string word;
+  for (size_t i = 0; i < n; i++) {
+    int tb = bin_of(h->time_[i], tc, ntc);
+    int bb = bin_of(h->ibyt_[i], bc, nbc);
+    int pb = bin_of(h->ipkt_[i], pc, npc);
+    h->tbin[i] = tb;
+    h->bbin[i] = bb;
+    h->pbin[i] = pb;
+
+    // adjust_port (flow_pre_lda.scala:317-359; see features/flow.py for
+    // the case table).  dport := col10, sport := col11 (reference swap).
+    double dport = h->c10_[i], sport = h->c11_[i];
+    double lo = (sport < dport) ? sport : dport;   // std::min semantics
+    double hi = (dport < sport) ? sport : dport;   // std::max semantics
+    int p_case;
+    double word_port;
+    if ((dport <= 1024 || sport <= 1024) && (dport > 1024 || sport > 1024) &&
+        lo != 0) {
+      p_case = 2;
+      word_port = lo;
+    } else if (dport > 1024 && sport > 1024) {
+      p_case = 3;
+      word_port = 333333.0;
+    } else if (dport == 0 && sport != 0) {
+      p_case = 4;
+      word_port = sport;
+    } else if (sport == 0 && dport != 0) {
+      p_case = 4;
+      word_port = dport;
+    } else {
+      p_case = 1;
+      word_port = (lo == 0) ? hi : 111111.0;
+    }
+
+    uint64_t wp_bits;
+    memcpy(&wp_bits, &word_port, 8);
+    auto wpit = wp_cache.find(wp_bits);
+    int32_t wp_id;
+    if (wpit != wp_cache.end()) {
+      wp_id = wpit->second;
+    } else {
+      wp_id = h->words.intern(jvm_double(word_port)).first;
+      wp_cache.emplace(wp_bits, wp_id);
+    }
+
+    bool src_prefixed =
+        (p_case == 2 && sport < dport) || (p_case == 4 && dport == 0);
+    bool dst_prefixed =
+        (p_case == 2 && dport < sport) || (p_case == 4 && sport == 0);
+
+    // Bins are bounded by the cut counts; ffz_finish rejects cut lists
+    // that would overflow the 12-bit fields.  A wp_id past 28 bits
+    // (>268M distinct port strings) skips the cache instead of aliasing.
+    bool cacheable = (uint32_t)wp_id < (1u << 28);
+    uint64_t wkey = ((uint64_t)(uint32_t)wp_id << 36) |
+                    ((uint64_t)tb << 24) | ((uint64_t)bb << 12) | (uint64_t)pb;
+    auto wit = cacheable ? word_cache.find(wkey) : word_cache.end();
+    WordIds wi;
+    if (wit != word_cache.end()) {
+      wi = wit->second;
+    } else {
+      word.clear();
+      word += h->words.arena[(size_t)wp_id];
+      word += '_';
+      word += jvm_double((double)tb);
+      word += '_';
+      word += jvm_double((double)bb);
+      word += '_';
+      word += jvm_double((double)pb);
+      wi.base = h->words.intern(word).first;
+      wi.prefixed = h->words.intern("-1_" + word).first;
+      if (cacheable) word_cache.emplace(wkey, wi);
+    }
+    int32_t src_wid = src_prefixed ? wi.prefixed : wi.base;
+    int32_t dst_wid = dst_prefixed ? wi.prefixed : wi.base;
+    h->wp_id[i] = wp_id;
+    h->sw_id[i] = src_wid;
+    h->dw_id[i] = dst_wid;
+
+    uint64_t ks = ((uint64_t)(uint32_t)h->sip_id[i] << 32) |
+                  (uint32_t)src_wid;
+    auto its = src_pos.emplace(ks, (int64_t)s_c.size());
+    if (its.second) {
+      s_ip.push_back(h->sip_id[i]);
+      s_w.push_back(src_wid);
+      s_c.push_back(1);
+    } else {
+      s_c[(size_t)its.first->second]++;
+    }
+    uint64_t kd = ((uint64_t)(uint32_t)h->dip_id[i] << 32) |
+                  (uint32_t)dst_wid;
+    auto itd = dst_pos.emplace(kd, (int64_t)d_c.size());
+    if (itd.second) {
+      d_ip.push_back(h->dip_id[i]);
+      d_w.push_back(dst_wid);
+      d_c.push_back(1);
+    } else {
+      d_c[(size_t)itd.first->second]++;
+    }
+  }
+
+  h->wc_ip = std::move(s_ip);
+  h->wc_ip.insert(h->wc_ip.end(), d_ip.begin(), d_ip.end());
+  h->wc_word = std::move(s_w);
+  h->wc_word.insert(h->wc_word.end(), d_w.begin(), d_w.end());
+  h->wc_cnt = std::move(s_c);
+  h->wc_cnt.insert(h->wc_cnt.end(), d_c.begin(), d_c.end());
+  return 0;
+}
+
+const int32_t* ffz_bins(void* hv, int which) {
+  Ffz* h = (Ffz*)hv;
+  switch (which) {
+    case 0: return h->tbin.data();
+    case 1: return h->bbin.data();
+    default: return h->pbin.data();
+  }
+}
+
+const int32_t* ffz_ids(void* hv, int which) {
+  Ffz* h = (Ffz*)hv;
+  switch (which) {
+    case 0: return h->sip_id.data();
+    case 1: return h->dip_id.data();
+    case 2: return h->wp_id.data();
+    case 3: return h->sw_id.data();
+    default: return h->dw_id.data();
+  }
+}
+
+static Interner& table_of(void* hv, int which) {
+  Ffz* h = (Ffz*)hv;
+  return which == 0 ? h->ips : h->words;
+}
+int64_t ffz_table_count(void* hv, int which) {
+  return (int64_t)table_of(hv, which).arena.size();
+}
+const char* ffz_table_blob(void* hv, int which) {
+  Interner& t = table_of(hv, which);
+  t.build_export();
+  return t.blob.data();
+}
+int64_t ffz_table_blob_len(void* hv, int which) {
+  Interner& t = table_of(hv, which);
+  t.build_export();
+  return (int64_t)t.blob.size();
+}
+const int64_t* ffz_table_offsets(void* hv, int which) {
+  Interner& t = table_of(hv, which);
+  t.build_export();
+  return t.offsets.data();
+}
+
+const char* ffz_lines_blob(void* hv) { return ((Ffz*)hv)->lines.data(); }
+int64_t ffz_lines_blob_len(void* hv) {
+  return (int64_t)((Ffz*)hv)->lines.size();
+}
+const int64_t* ffz_line_offsets(void* hv) {
+  return ((Ffz*)hv)->line_off.data();
+}
+
+int64_t ffz_wc_len(void* hv) { return (int64_t)((Ffz*)hv)->wc_cnt.size(); }
+const int32_t* ffz_wc_ip(void* hv) { return ((Ffz*)hv)->wc_ip.data(); }
+const int32_t* ffz_wc_word(void* hv) { return ((Ffz*)hv)->wc_word.data(); }
+const int64_t* ffz_wc_count(void* hv) { return ((Ffz*)hv)->wc_cnt.data(); }
+
+}  // extern "C"

@@ -10,6 +10,7 @@ consume.
 
 from .quantiles import ecdf_cuts, bin_values
 from .flow import FlowFeatures, featurize_flow, FLOW_COLUMNS
+from .native_flow import featurize_flow_file
 from .dns import (
     DnsFeatures,
     featurize_dns,
@@ -28,6 +29,7 @@ __all__ = [
     "bin_values",
     "FlowFeatures",
     "featurize_flow",
+    "featurize_flow_file",
     "FLOW_COLUMNS",
     "DnsFeatures",
     "featurize_dns",

@@ -1,0 +1,314 @@
+"""ctypes binding for the native flow featurizer (native/flow_featurize.cpp).
+
+``featurize_flow_file`` is the production entry point for the flow pre
+stage: it runs the parse/word-build/word-count passes in C++ when the
+library is available (~20x the pure-Python throughput) and falls back to
+``features.flow.featurize_flow`` otherwise.  Both produce objects with
+the same API surface (the scoring stage and the runner duck-type it) and
+identical featurization output — parity is pinned by
+tests/test_native_flow.py.
+
+The ECDF cuts are deliberately computed in Python from the native pass's
+numeric arrays using quantiles.ecdf_cuts — the reference's quantile rule
+has exactly one implementation in this codebase (SURVEY §7 hard part b).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Sequence
+
+import numpy as np
+
+from ..native_build import NativeLib
+from .flow import FLOW_COLUMNS, FlowFeatures, _jvm_double, featurize_flow
+from .quantiles import DECILES, QUINTILES, ecdf_cuts
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F64P = ctypes.POINTER(ctypes.c_double)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.ffz_create.restype = ctypes.c_void_p
+    lib.ffz_create.argtypes = [ctypes.c_int]
+    lib.ffz_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffz_error.restype = ctypes.c_char_p
+    lib.ffz_error.argtypes = [ctypes.c_void_p]
+    lib.ffz_ingest_file.restype = ctypes.c_int64
+    lib.ffz_ingest_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ffz_ingest_buffer.restype = ctypes.c_int64
+    lib.ffz_ingest_buffer.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.ffz_mark_raw.argtypes = [ctypes.c_void_p]
+    for fn, res in [
+        ("ffz_num_raw", ctypes.c_int64),
+        ("ffz_num_events", ctypes.c_int64),
+        ("ffz_lines_blob_len", ctypes.c_int64),
+        ("ffz_wc_len", ctypes.c_int64),
+    ]:
+        getattr(lib, fn).restype = res
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    for fn in ("ffz_num_time", "ffz_ibyt", "ffz_ipkt"):
+        getattr(lib, fn).restype = _F64P
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.ffz_finish.restype = ctypes.c_int
+    lib.ffz_finish.argtypes = [
+        ctypes.c_void_p, _F64P, ctypes.c_int, _F64P, ctypes.c_int, _F64P,
+        ctypes.c_int,
+    ]
+    for fn in ("ffz_bins", "ffz_ids"):
+        getattr(lib, fn).restype = _I32P
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ffz_table_count.restype = ctypes.c_int64
+    lib.ffz_table_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ffz_table_blob.restype = ctypes.c_void_p
+    lib.ffz_table_blob.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ffz_table_blob_len.restype = ctypes.c_int64
+    lib.ffz_table_blob_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ffz_table_offsets.restype = _I64P
+    lib.ffz_table_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ffz_lines_blob.restype = ctypes.c_void_p
+    lib.ffz_lines_blob.argtypes = [ctypes.c_void_p]
+    lib.ffz_line_offsets.restype = _I64P
+    lib.ffz_line_offsets.argtypes = [ctypes.c_void_p]
+    for fn, res in [
+        ("ffz_wc_ip", _I32P), ("ffz_wc_word", _I32P), ("ffz_wc_count", _I64P),
+    ]:
+        getattr(lib, fn).restype = res
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+
+
+_LIB = NativeLib(
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "flow_featurize.cpp"
+    ),
+    os.path.join(os.path.dirname(__file__), "_native", "liboni_flow.so"),
+    _configure,
+)
+
+
+def available() -> bool:
+    return _LIB.available()
+
+
+def _copy(ptr, n, dtype):
+    if n == 0:
+        return np.zeros(0, dtype=dtype)
+    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+def _table(lib, h, which: int) -> list[str]:
+    cnt = lib.ffz_table_count(h, which)
+    blob_len = lib.ffz_table_blob_len(h, which)
+    blob = ctypes.string_at(lib.ffz_table_blob(h, which), blob_len)
+    off = _copy(lib.ffz_table_offsets(h, which), cnt + 1, np.int64)
+    return [
+        blob[off[i]:off[i + 1]].decode("utf-8") for i in range(cnt)
+    ]
+
+
+class NativeFlowFeatures:
+    """FlowFeatures-compatible container backed by native arrays.
+
+    Raw rows live in one bytes blob + offsets and are split lazily
+    (``featurized_row`` is only called for rows under the scoring
+    threshold); IPs and words are interned string tables with per-event
+    id arrays.  Pickles without the native library present.
+    """
+
+    def __init__(self, *, lines_blob, line_off, ip_table, word_table,
+                 sip_id, dip_id, wp_id, sw_id, dw_id, num_time, ibyt_bin,
+                 ipkt_bin, time_bin, wc_ip, wc_word, wc_count,
+                 num_raw_events, time_cuts, ibyt_cuts, ipkt_cuts):
+        self.lines_blob = lines_blob
+        self.line_off = line_off
+        self.ip_table = ip_table
+        self.word_table = word_table
+        self.sip_id = sip_id
+        self.dip_id = dip_id
+        self.wp_id = wp_id
+        self.sw_id = sw_id
+        self.dw_id = dw_id
+        self.num_time = num_time
+        self.ibyt_bin = ibyt_bin
+        self.ipkt_bin = ipkt_bin
+        self.time_bin = time_bin
+        self.wc_ip = wc_ip
+        self.wc_word = wc_word
+        self.wc_count = wc_count
+        self.num_raw_events = num_raw_events
+        self.time_cuts = time_cuts
+        self.ibyt_cuts = ibyt_cuts
+        self.ipkt_cuts = ipkt_cuts
+        self._word_lists: dict[str, list[str]] = {}
+
+    # -- FlowFeatures API ---------------------------------------------------
+
+    @property
+    def num_events(self) -> int:
+        return len(self.sip_id)
+
+    def row(self, i: int) -> list[str]:
+        raw = self.lines_blob[self.line_off[i]:self.line_off[i + 1]]
+        return raw.decode("utf-8").split(",")
+
+    def sip(self, i: int) -> str:
+        return self.ip_table[self.sip_id[i]]
+
+    def dip(self, i: int) -> str:
+        return self.ip_table[self.dip_id[i]]
+
+    def _words(self, which: str) -> list[str]:
+        if which not in self._word_lists:
+            ids = {"wp": self.wp_id, "src": self.sw_id, "dst": self.dw_id}[
+                which
+            ]
+            t = self.word_table
+            self._word_lists[which] = [t[j] for j in ids]
+        return self._word_lists[which]
+
+    @property
+    def word_port(self) -> list[str]:
+        return self._words("wp")
+
+    @property
+    def src_word(self) -> list[str]:
+        return self._words("src")
+
+    @property
+    def dest_word(self) -> list[str]:
+        return self._words("dst")
+
+    @property
+    def ip_pair(self) -> list[str]:
+        # Derived, not stored: pair = "min max" lexicographically
+        # (features/flow.py ip_pair semantics).
+        out = []
+        for s_id, d_id in zip(self.sip_id, self.dip_id):
+            s, d = self.ip_table[s_id], self.ip_table[d_id]
+            out.append(f"{s} {d}" if s < d else f"{d} {s}")
+        return out
+
+    @property
+    def rows(self) -> list[list[str]]:
+        return [self.row(i) for i in range(self.num_events)]
+
+    def featurized_row(self, i: int) -> list[str]:
+        s, d = self.sip(i), self.dip(i)
+        pair = f"{s} {d}" if s < d else f"{d} {s}"
+        return self.row(i) + [
+            _jvm_double(self.num_time[i]),
+            str(int(self.ibyt_bin[i])),
+            str(int(self.ipkt_bin[i])),
+            str(int(self.time_bin[i])),
+            self.word_table[self.wp_id[i]],
+            pair,
+            self.word_table[self.sw_id[i]],
+            self.word_table[self.dw_id[i]],
+        ]
+
+    def word_counts(self) -> list[tuple[str, str, int]]:
+        ips, words = self.ip_table, self.word_table
+        return [
+            (ips[i], words[w], int(c))
+            for i, w, c in zip(self.wc_ip, self.wc_word, self.wc_count)
+        ]
+
+    # -- pickling (features.pkl survives without the native lib) ------------
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_word_lists")
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._word_lists = {}
+
+
+def _featurize_native(
+    lib,
+    path: str,
+    feedback_rows: Sequence[str],
+    precomputed_cuts=None,
+) -> NativeFlowFeatures:
+    h = lib.ffz_create(1)
+    try:
+        if lib.ffz_ingest_file(h, os.fsencode(path)) < 0:
+            raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
+        lib.ffz_mark_raw(h)
+        if feedback_rows:
+            blob = ("\n".join(feedback_rows) + "\n").encode("utf-8")
+            lib.ffz_ingest_buffer(h, blob, len(blob))
+        n = lib.ffz_num_events(h)
+        num_time = _copy(lib.ffz_num_time(h), n, np.float64)
+        ibyt = _copy(lib.ffz_ibyt(h), n, np.float64)
+        ipkt = _copy(lib.ffz_ipkt(h), n, np.float64)
+        if precomputed_cuts is not None:
+            time_cuts, ibyt_cuts, ipkt_cuts = (
+                np.ascontiguousarray(x, dtype=np.float64)
+                for x in precomputed_cuts
+            )
+        else:
+            time_cuts = ecdf_cuts(num_time, DECILES)
+            ibyt_cuts = ecdf_cuts(ibyt, DECILES)
+            ipkt_cuts = ecdf_cuts(ipkt, QUINTILES)
+
+        def fp(a):
+            return a.ctypes.data_as(_F64P)
+
+        if (
+            lib.ffz_finish(
+                h, fp(time_cuts), len(time_cuts), fp(ibyt_cuts),
+                len(ibyt_cuts), fp(ipkt_cuts), len(ipkt_cuts),
+            )
+            < 0
+        ):
+            raise ValueError(lib.ffz_error(h).decode("utf-8", "replace"))
+        nwc = lib.ffz_wc_len(h)
+        return NativeFlowFeatures(
+            lines_blob=ctypes.string_at(
+                lib.ffz_lines_blob(h), lib.ffz_lines_blob_len(h)
+            ),
+            line_off=_copy(lib.ffz_line_offsets(h), n + 1, np.int64),
+            ip_table=_table(lib, h, 0),
+            word_table=_table(lib, h, 1),
+            sip_id=_copy(lib.ffz_ids(h, 0), n, np.int32),
+            dip_id=_copy(lib.ffz_ids(h, 1), n, np.int32),
+            wp_id=_copy(lib.ffz_ids(h, 2), n, np.int32),
+            sw_id=_copy(lib.ffz_ids(h, 3), n, np.int32),
+            dw_id=_copy(lib.ffz_ids(h, 4), n, np.int32),
+            num_time=num_time,
+            ibyt_bin=_copy(lib.ffz_bins(h, 1), n, np.int64),
+            ipkt_bin=_copy(lib.ffz_bins(h, 2), n, np.int64),
+            time_bin=_copy(lib.ffz_bins(h, 0), n, np.int64),
+            wc_ip=_copy(lib.ffz_wc_ip(h), nwc, np.int32),
+            wc_word=_copy(lib.ffz_wc_word(h), nwc, np.int32),
+            wc_count=_copy(lib.ffz_wc_count(h), nwc, np.int64),
+            num_raw_events=int(lib.ffz_num_raw(h)),
+            time_cuts=time_cuts,
+            ibyt_cuts=ibyt_cuts,
+            ipkt_cuts=ipkt_cuts,
+        )
+    finally:
+        lib.ffz_destroy(ctypes.c_void_p(h))
+
+
+def featurize_flow_file(
+    path: str,
+    feedback_rows: Sequence[str] = (),
+    precomputed_cuts=None,
+) -> "NativeFlowFeatures | FlowFeatures":
+    """Featurize a raw netflow CSV file, native when possible."""
+    lib = _LIB.load()
+    if lib is not None:
+        return _featurize_native(lib, path, feedback_rows, precomputed_cuts)
+    with open(path) as f:
+        return featurize_flow(
+            (line.rstrip("\n") for line in f),
+            feedback_rows=feedback_rows,
+            precomputed_cuts=precomputed_cuts,
+        )

@@ -7,106 +7,62 @@ CSR arrays + id maps with semantics identical to the pure-Python
 ``Corpus.from_word_counts`` (first-seen-order ids, per-doc token
 grouping), so callers can use whichever is available.
 
-Loading strategy: use the prebuilt ``_native/liboni_ingest.so`` (built by
-``make -C native``); if missing, compile it once on demand with g++ into
-the same location.  If neither works (no compiler), ``available()`` is
-False and callers fall back to Python.  Set ``ONI_ML_TPU_NO_NATIVE=1`` to
-force the Python path.
+Loading strategy (oni_ml_tpu/native_build.py, shared with the native flow
+featurizer): use the prebuilt ``_native/liboni_ingest.so`` (built by
+``make -C native``); if missing or stale, compile it once on demand with
+g++ into the same location.  If neither works (no compiler),
+``available()`` is False and callers fall back to Python.  Set
+``ONI_ML_TPU_NO_NATIVE=1`` to force the Python path.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
 import numpy as np
 
-_LIB_DIR = os.path.join(os.path.dirname(__file__), "_native")
-_LIB_PATH = os.path.join(_LIB_DIR, "liboni_ingest.so")
-_SRC_PATH = os.path.join(
-    os.path.dirname(__file__), "..", "..", "native", "corpus_ingest.cpp"
+from ..native_build import NativeLib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.oni_ingest_create.restype = ctypes.c_void_p
+    lib.oni_ingest_destroy.argtypes = [ctypes.c_void_p]
+    lib.oni_ingest_file.restype = ctypes.c_int64
+    lib.oni_ingest_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.oni_last_error.restype = ctypes.c_char_p
+    lib.oni_last_error.argtypes = [ctypes.c_void_p]
+    for fn in ("oni_num_docs", "oni_num_terms", "oni_nnz"):
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.oni_fill_csr.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
+    lib.oni_names_bytes.restype = ctypes.c_int64
+    lib.oni_names_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.oni_fill_names.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p
+    ]
+
+
+_LIB = NativeLib(
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "corpus_ingest.cpp"
+    ),
+    os.path.join(os.path.dirname(__file__), "_native", "liboni_ingest.so"),
+    _configure,
 )
-
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_load_failed = False
-
-
-def _try_build() -> bool:
-    src = os.path.abspath(_SRC_PATH)
-    if not os.path.exists(src):
-        return False
-    os.makedirs(_LIB_DIR, exist_ok=True)
-    tmp = _LIB_PATH + f".build{os.getpid()}"
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp, src]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB_PATH)  # atomic: concurrent builders don't collide
-    except (OSError, subprocess.SubprocessError):
-        if os.path.exists(tmp):
-            os.remove(tmp)
-        return False
-    return True
-
-
-def _lib_is_stale() -> bool:
-    """True when the source is newer than the built .so (same dependency
-    rule as the Makefile) — rebuild so source edits are never ignored."""
-    try:
-        return os.path.getmtime(os.path.abspath(_SRC_PATH)) > os.path.getmtime(
-            _LIB_PATH
-        )
-    except OSError:
-        return False
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed
-    if _lib is not None or _load_failed:
-        return _lib
-    with _lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        if os.environ.get("ONI_ML_TPU_NO_NATIVE"):
-            _load_failed = True
-            return None
-        if not os.path.exists(_LIB_PATH) or _lib_is_stale():
-            if not _try_build() and not os.path.exists(_LIB_PATH):
-                _load_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            _load_failed = True
-            return None
-        lib.oni_ingest_create.restype = ctypes.c_void_p
-        lib.oni_ingest_destroy.argtypes = [ctypes.c_void_p]
-        lib.oni_ingest_file.restype = ctypes.c_int64
-        lib.oni_ingest_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.oni_last_error.restype = ctypes.c_char_p
-        lib.oni_last_error.argtypes = [ctypes.c_void_p]
-        for fn in ("oni_num_docs", "oni_num_terms", "oni_nnz"):
-            getattr(lib, fn).restype = ctypes.c_int64
-            getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        lib.oni_fill_csr.argtypes = [
-            ctypes.c_void_p,
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-        ]
-        lib.oni_names_bytes.restype = ctypes.c_int64
-        lib.oni_names_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.oni_fill_names.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p
-        ]
-        _lib = lib
-        return _lib
+    return _LIB.load()
 
 
 def available() -> bool:
-    return _load() is not None
+    return _LIB.available()
 
 
 def load_corpus(paths: str | list[str]):

@@ -1,0 +1,86 @@
+"""Shared build/load machinery for the native (C++) components.
+
+Each native module is one translation unit under ``native/`` compiled to
+its own .so beside the Python wrapper that binds it.  Loading strategy
+(shared by io/native.py and features/native_flow.py): use the prebuilt
+.so (``make -C native``); if missing or older than its source, compile
+once on demand with g++; if neither works the caller falls back to pure
+Python.  ``ONI_ML_TPU_NO_NATIVE=1`` forces the Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable
+
+
+class NativeLib:
+    """Lazy, thread-safe loader for one native .so."""
+
+    def __init__(
+        self,
+        src_path: str,
+        lib_path: str,
+        configure: Callable[[ctypes.CDLL], None],
+    ):
+        self._src = os.path.abspath(src_path)
+        self._lib_path = lib_path
+        self._configure = configure
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._failed = False
+
+    def _stale(self) -> bool:
+        try:
+            return os.path.getmtime(self._src) > os.path.getmtime(
+                self._lib_path
+            )
+        except OSError:
+            return False
+
+    def _build(self) -> bool:
+        if not os.path.exists(self._src):
+            return False
+        os.makedirs(os.path.dirname(self._lib_path), exist_ok=True)
+        tmp = self._lib_path + f".build{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp,
+            self._src,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            # Atomic: concurrent builders don't collide.
+            os.replace(tmp, self._lib_path)
+        except (OSError, subprocess.SubprocessError):
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            return False
+        return True
+
+    def load(self) -> ctypes.CDLL | None:
+        if self._lib is not None or self._failed:
+            return self._lib
+        with self._lock:
+            if self._lib is not None or self._failed:
+                return self._lib
+            if os.environ.get("ONI_ML_TPU_NO_NATIVE"):
+                self._failed = True
+                return None
+            if not os.path.exists(self._lib_path) or self._stale():
+                if not self._build() and not os.path.exists(self._lib_path):
+                    self._failed = True
+                    return None
+            try:
+                lib = ctypes.CDLL(self._lib_path)
+            except OSError:
+                self._failed = True
+                return None
+            self._configure(lib)
+            self._lib = lib
+            return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None

@@ -45,7 +45,6 @@ from ..config import (
 )
 from ..features import (
     featurize_dns,
-    featurize_flow,
     load_top_domains,
     read_dns_feedback_rows,
     read_flow_feedback_rows,
@@ -169,12 +168,11 @@ def stage_pre(ctx: RunContext) -> dict:
             from ..features.qtiles import read_flow_qtiles
 
             cuts = read_flow_qtiles(cfg.qtiles_path)
-        with open(cfg.flow_path) as f:
-            features = featurize_flow(
-                (line.rstrip("\n") for line in f),
-                feedback_rows=fb_rows,
-                precomputed_cuts=cuts,
-            )
+        from ..features.native_flow import featurize_flow_file
+
+        features = featurize_flow_file(
+            cfg.flow_path, feedback_rows=fb_rows, precomputed_cuts=cuts
+        )
     else:
         fb_rows = read_dns_feedback_rows(
             os.path.join(cfg.data_dir, "dns_scores.csv"),

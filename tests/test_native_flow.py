@@ -1,0 +1,172 @@
+"""Native (C++) flow featurizer vs the pure-Python path.
+
+The native path must be featurization-identical: same kept rows, same
+numeric columns, same words, same first-seen-order word counts, same
+scored output.  Skips when the native lib can't build (no g++).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.features import flow as pyflow
+from oni_ml_tpu.features import native_flow
+
+from test_features import flow_row
+
+pytestmark = pytest.mark.skipif(
+    not native_flow.available(), reason="native flow featurizer unavailable"
+)
+
+
+def make_day(tmp_path, n=500, seed=7, with_edge_rows=True):
+    rng = np.random.default_rng(seed)
+    lines = ["word,count,header"]
+    for _ in range(n):
+        lines.append(
+            flow_row(
+                hour=int(rng.integers(0, 24)),
+                minute=int(rng.integers(0, 60)),
+                second=int(rng.integers(0, 60)),
+                sip=f"10.0.{rng.integers(0, 4)}.{rng.integers(1, 60)}",
+                dip=f"172.16.{rng.integers(0, 4)}.{rng.integers(1, 60)}",
+                col10=str(rng.choice([80, 443, 55000, 0, 1024, 1025])),
+                col11=str(rng.choice([80, 6000, 70000, 0, 1024])),
+                ipkt=str(rng.integers(1, 100)),
+                ibyt=str(rng.integers(40, 10000)),
+            )
+        )
+    if with_edge_rows:
+        lines.insert(5, "word,count,header")        # duplicate header
+        lines.insert(7, "short,row")                # wrong field count
+        lines.append(",".join(["##"] * 27))         # NaN everything
+        lines.append(flow_row(col10="0", col11="0"))      # both ports zero
+        lines.append(flow_row(col10="80", col11="80"))    # equal ports
+        lines.append(flow_row(col10="bogus", col11="80"))  # NaN port
+    path = tmp_path / "flow.csv"
+    path.write_text("\n".join(lines) + "\n")
+    return path, lines
+
+
+def featurize_both(tmp_path, feedback_rows=(), **kw):
+    path, lines = make_day(tmp_path, **kw)
+    with open(path) as f:
+        py = pyflow.featurize_flow(
+            (line.rstrip("\n") for line in f), feedback_rows=feedback_rows
+        )
+    nat = native_flow.featurize_flow_file(
+        str(path), feedback_rows=feedback_rows
+    )
+    assert isinstance(nat, native_flow.NativeFlowFeatures)
+    return py, nat
+
+
+def assert_parity(py, nat):
+    assert nat.num_events == py.num_events
+    assert nat.num_raw_events == py.num_raw_events
+    np.testing.assert_array_equal(nat.time_cuts, py.time_cuts)
+    np.testing.assert_array_equal(nat.ibyt_cuts, py.ibyt_cuts)
+    np.testing.assert_array_equal(nat.ipkt_cuts, py.ipkt_cuts)
+    np.testing.assert_array_equal(nat.num_time, py.num_time)
+    np.testing.assert_array_equal(nat.time_bin, py.time_bin)
+    np.testing.assert_array_equal(nat.ibyt_bin, py.ibyt_bin)
+    np.testing.assert_array_equal(nat.ipkt_bin, py.ipkt_bin)
+    assert nat.word_port == py.word_port
+    assert nat.src_word == py.src_word
+    assert nat.dest_word == py.dest_word
+    assert nat.ip_pair == py.ip_pair
+    assert nat.rows == py.rows
+    assert nat.word_counts() == py.word_counts()
+    for i in range(0, py.num_events, max(1, py.num_events // 7)):
+        assert nat.featurized_row(i) == py.featurized_row(i)
+        assert nat.sip(i) == py.sip(i)
+        assert nat.dip(i) == py.dip(i)
+
+
+def test_parity_random_day(tmp_path):
+    py, nat = featurize_both(tmp_path)
+    assert_parity(py, nat)
+
+
+def test_parity_with_feedback(tmp_path):
+    fb = [flow_row(sip="9.9.9.9", dip="8.8.8.8", col10="80", col11="55000")] * 7
+    py, nat = featurize_both(tmp_path, feedback_rows=fb)
+    assert_parity(py, nat)
+    # Feedback rows train but are not scored.
+    assert nat.num_events == nat.num_raw_events + 7
+
+
+def test_parity_precomputed_cuts(tmp_path):
+    path, _ = make_day(tmp_path)
+    cuts = (
+        np.linspace(0, 20, 10),
+        np.linspace(0, 9000, 10),
+        np.linspace(0, 80, 5),
+    )
+    with open(path) as f:
+        py = pyflow.featurize_flow(
+            (line.rstrip("\n") for line in f), precomputed_cuts=cuts
+        )
+    nat = native_flow.featurize_flow_file(str(path), precomputed_cuts=cuts)
+    assert_parity(py, nat)
+
+
+def test_parity_long_cut_lists(tmp_path):
+    # >15 cuts used to alias the native word cache's packed key; 12-bit
+    # fields must keep words exact for any realistic cut list.
+    path, _ = make_day(tmp_path)
+    cuts = (
+        np.linspace(0, 23, 20),
+        np.linspace(0, 9000, 20),
+        np.linspace(0, 80, 17),
+    )
+    with open(path) as f:
+        py = pyflow.featurize_flow(
+            (line.rstrip("\n") for line in f), precomputed_cuts=cuts
+        )
+    nat = native_flow.featurize_flow_file(str(path), precomputed_cuts=cuts)
+    assert_parity(py, nat)
+
+
+def test_absurd_cut_lists_rejected(tmp_path):
+    path, _ = make_day(tmp_path, n=10)
+    cuts = (np.zeros(5000), np.zeros(10), np.zeros(5))
+    with pytest.raises(ValueError, match="4095"):
+        native_flow.featurize_flow_file(str(path), precomputed_cuts=cuts)
+
+
+def test_directory_path_errors(tmp_path):
+    # fread on a directory yields 0 bytes + error; must raise, not return
+    # an empty day.
+    with pytest.raises(OSError):
+        native_flow.featurize_flow_file(str(tmp_path))
+
+
+def test_pickle_roundtrip(tmp_path):
+    _, nat = featurize_both(tmp_path, n=50)
+    again = pickle.loads(pickle.dumps(nat))
+    assert again.word_counts() == nat.word_counts()
+    assert again.featurized_row(3) == nat.featurized_row(3)
+    assert again.num_raw_events == nat.num_raw_events
+
+
+def test_scoring_identical(tmp_path):
+    from oni_ml_tpu.scoring import ScoringModel, score_flow
+
+    py, nat = featurize_both(tmp_path)
+    k = 4
+    rng = np.random.default_rng(0)
+    ips = sorted({ip for ip, _, _ in py.word_counts()})
+    words = sorted({w for _, w, _ in py.word_counts()})
+    model = ScoringModel.from_results(
+        doc_names=ips,
+        doc_topic=rng.dirichlet(np.ones(k), size=len(ips)),
+        vocab=words,
+        word_topic=rng.dirichlet(np.ones(k), size=len(words)),
+        fallback=0.05,
+    )
+    rows_py, s_py = score_flow(py, model, threshold=1.1)
+    rows_nat, s_nat = score_flow(nat, model, threshold=1.1)
+    assert rows_py == rows_nat
+    np.testing.assert_array_equal(s_py, s_nat)
