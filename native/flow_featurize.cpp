@@ -29,6 +29,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <string>
@@ -48,6 +49,9 @@ struct Interner {
   std::pair<int32_t, bool> intern(std::string_view s) {
     auto it = ids.find(s);
     if (it != ids.end()) return {it->second, false};
+    // Growing the arena invalidates any snapshot a caller exported.
+    blob.clear();
+    offsets.clear();
     arena.emplace_back(s);
     int32_t id = (int32_t)ids.size();
     ids.emplace(std::string_view(arena.back()), id);
@@ -81,6 +85,12 @@ double to_double(std::string_view s) {
   if (t.empty()) return NAN;
   double v;
   auto [p, ec] = std::from_chars(t.data(), t.data() + t.size(), v);
+  if (ec == std::errc::result_out_of_range && p == t.data() + t.size()) {
+    // Python float() saturates: "1e999" -> inf, "1e-999" -> 0.0.  strtod
+    // has exactly those semantics; rare path, so the copy is fine.
+    std::string z(t);
+    return strtod(z.c_str(), nullptr);
+  }
   if (ec != std::errc() || p != t.data() + t.size()) return NAN;
   return v;
 }

@@ -39,14 +39,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import estep
 
-# Per-block VMEM budget for the slab (bytes), and a hard cap on docs per
-# block.  The kernel's working set is dominated not by the slab but by
-# the K-unrolled [BB, 1] column temporaries, which the lane tiling pads
-# to [BB, 128] each: at bb=512 those alone exceeded the 16MB scoped-VMEM
-# limit (by a bb-independent-looking 88KB, at several L) while bb=256
-# compiles with room to spare at every L we ship.
-_SLAB_VMEM_BUDGET = 2 * 1024 * 1024
-_MAX_BLOCK_DOCS = 256
+# VMEM working-set model for picking the doc block size.  Two terms
+# dominate: the double-buffered slab block (2 * K*BB*L*4) and the
+# K-unrolled column temporaries, which the 128-lane tiling pads from
+# [BB, 1] to [BB, 128] each — two live sets of K of them
+# (2 * K*BB*128*4).  Empirically calibrated against Mosaic's 16MB
+# scoped-VMEM limit: (K=20, L=128, bb=512) blew it by 88KB and
+# (K=50, L=16, bb=256) by 3.4MB, while everything under ~12MB by this
+# model compiles with room to spare.
+_VMEM_BUDGET = 12 * 1024 * 1024
+# 128-doc blocks also benched faster than 256 at the production shapes
+# (more pipeline overlap across grid steps).
+_MAX_BLOCK_DOCS = 128
+
+
+def _vmem_estimate(bb: int, l: int, k: int) -> int:
+    return 2 * k * bb * l * 4 + 2 * k * bb * 128 * 4
 
 
 def digamma_pos(x: jnp.ndarray) -> jnp.ndarray:
@@ -118,12 +126,14 @@ def _fixed_point_kernel(
 
 
 def pick_block(b: int, l: int, k: int) -> int | None:
-    """Largest power-of-two doc block whose slab fits the VMEM budget.
-    None if no valid block exists (fall back to the XLA path)."""
+    """Largest power-of-two doc block whose estimated kernel working set
+    (double-buffered slab + the K sets of lane-padded column temporaries,
+    _vmem_estimate) fits the VMEM budget.  None if no valid block exists
+    (fall back to the XLA path)."""
     bb = 8
     best = None
     while bb <= min(b, _MAX_BLOCK_DOCS) and b % bb == 0:
-        if k * bb * l * 4 > _SLAB_VMEM_BUDGET:
+        if _vmem_estimate(bb, l, k) > _VMEM_BUDGET:
             break
         best = bb
         bb *= 2

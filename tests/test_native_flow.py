@@ -44,6 +44,10 @@ def make_day(tmp_path, n=500, seed=7, with_edge_rows=True):
         lines.append(flow_row(col10="0", col11="0"))      # both ports zero
         lines.append(flow_row(col10="80", col11="80"))    # equal ports
         lines.append(flow_row(col10="bogus", col11="80"))  # NaN port
+        # Overflow/underflow numerals: Python float() saturates to
+        # inf / 0.0; the native parser must match, not yield NaN.
+        lines.append(flow_row(ibyt="1e999", ipkt="1e-999"))
+        lines.append(flow_row(col10="1e999", col11="80"))
     path = tmp_path / "flow.csv"
     path.write_text("\n".join(lines) + "\n")
     return path, lines

@@ -80,7 +80,13 @@ def test_pick_block():
     assert pallas_estep.pick_block(12, 16, 4) is None
     # Huge L shrinks the block instead of blowing VMEM.
     bb = pallas_estep.pick_block(4096, 2048, 20)
-    assert bb is not None and 20 * bb * 2048 * 4 <= 4 * 1024 * 1024
+    assert bb is not None
+    assert pallas_estep._vmem_estimate(bb, 2048, 20) <= pallas_estep._VMEM_BUDGET
+    # Large K also shrinks the block (the column temporaries scale with
+    # K): the (K=50, L=16) case that OOM'd at bb=256 must stay under.
+    bb = pallas_estep.pick_block(4096, 16, 50)
+    assert bb is not None
+    assert pallas_estep._vmem_estimate(bb, 16, 50) <= pallas_estep._VMEM_BUDGET
 
 
 def test_auto_backend_on_cpu_uses_xla(problem):
