@@ -1,0 +1,89 @@
+// Shared helpers for the native featurizers/ingest (single header so a
+// parity-critical fix can never land in one translation unit and miss
+// the other — that drift already happened once during review).
+#pragma once
+
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <deque>
+#include <locale.h>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace oni {
+
+// String interner: stable ids in first-seen order, arena-backed views so
+// hot-path lookups never allocate, plus a lazily-built (blob, offsets)
+// export for the ctypes side.  Interning invalidates any prior export.
+struct Interner {
+  std::unordered_map<std::string_view, int32_t> ids;
+  std::deque<std::string> arena;
+  std::string blob;
+  std::vector<int64_t> offsets;
+
+  int32_t intern(std::string_view s) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    blob.clear();
+    offsets.clear();
+    arena.emplace_back(s);
+    int32_t id = (int32_t)ids.size();
+    ids.emplace(std::string_view(arena.back()), id);
+    return id;
+  }
+
+  void build_export() {
+    if (!offsets.empty()) return;
+    offsets.push_back(0);
+    size_t total = 0;
+    for (const auto& s : arena) total += s.size();
+    blob.reserve(total);
+    for (const auto& s : arena) {
+      blob += s;
+      offsets.push_back((int64_t)blob.size());
+    }
+  }
+};
+
+// Python float(): trimmed token, optional '+', decimal/exponent/inf/nan;
+// out-of-range saturates to +-inf / +-0.0; anything else -> NaN.
+// The saturation fallback pins LC_NUMERIC to "C" so a host process with
+// a different locale can't change how the digits parse.
+inline double to_double(std::string_view s) {
+  size_t b = 0, e = s.size();
+  while (b < e && std::isspace((unsigned char)s[b])) b++;
+  while (e > b && std::isspace((unsigned char)s[e - 1])) e--;
+  if (b == e) return NAN;
+  std::string_view t = s.substr(b, e - b);
+  if (t[0] == '+') t.remove_prefix(1);
+  if (t.empty()) return NAN;
+  double v;
+  auto [p, ec] = std::from_chars(t.data(), t.data() + t.size(), v);
+  if (ec == std::errc::result_out_of_range && p == t.data() + t.size()) {
+    static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    std::string z(t);
+    return strtod_l(z.c_str(), nullptr, c_loc);
+  }
+  if (ec != std::errc() || p != t.data() + t.size()) return NAN;
+  return v;
+}
+
+// bin(v) = #{cuts c : v > c} (quantiles.bin_values; NaN > c is false).
+inline int bin_of(double v, const double* cuts, int n) {
+  int b = 0;
+  for (int i = 0; i < n; i++) b += v > cuts[i];
+  return b;
+}
+
+inline void append_int(std::string& s, int v) {
+  char buf[16];
+  auto [p, ec] = std::to_chars(buf, buf + sizeof(buf), v);
+  (void)ec;
+  s.append(buf, p);
+}
+
+}  // namespace oni

@@ -1,0 +1,434 @@
+// Native DNS featurizer — the C++ fast path for the DNS "pre" stage
+// (dns_pre_lda.scala featurization, reimplemented in
+// oni_ml_tpu/features/dns.py).  This is the stage the reference's
+// authors sized a 62-executor x 12-core Spark cluster for
+// (dns_pre_lda.scala:1-2, SURVEY.md §6).
+//
+// Split of responsibilities with Python (features/native_dns.py), same
+// shape as the flow featurizer:
+//   pass A (ingest_*): row filtering (8 fields), unix_tstamp/frame_len
+//     numeric extraction, subdomain extraction (reverse-DNS +
+//     country-code TLD handling), Shannon entropy, interning of
+//     client IPs / domains / subdomains / qry_type / qry_rcode.
+//   cuts: Python computes the five ECDF cut lists (deciles over
+//     tstamp/frame_len, quintiles over the positive subsets) with
+//     quantiles.ecdf_cuts — single implementation of the quantile rule.
+//   pass B (finish): binning, whitelist flag, word construction
+//     ("top_blen_btime_bsub_bent_bper_type_rcode"), first-seen-order
+//     per-client word counts (dns_pre_lda.scala:330).
+//
+// Rows are exchanged and stored with the ASCII unit separator \x1f so
+// parquet-sourced fields containing commas (frame_time!) survive; CSV
+// files are split on ',' at ingest and re-joined with \x1f.
+//
+// Entropy matches Python bit-for-bit: character counts accumulate in
+// first-seen order (Counter's iteration order) and the sum uses the
+// same -(c/n)*log2(c/n) expression, so identical libm gives identical
+// doubles.  Known divergence: characters are bytes here, codepoints in
+// Python — identical for the ASCII/punycode names DNS carries.
+
+#include "common.h"
+
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+// ISO country-code TLDs, verbatim from dns_pre_lda.scala:180 (including
+// the stray empty string and "krd") — mirrors features/dns.py.
+using oni::Interner;
+using oni::to_double;
+using oni::bin_of;
+using oni::append_int;
+
+const char* kCountryCodes =
+    "ac ad ae af ag ai al am an ao aq ar as at au aw ax az ba bb bd be bf bg "
+    "bh bi bj bm bn bo bq br bs bt bv bw by bz ca cc cd cf cg ch ci ck cl cm "
+    "cn co cr cu cv cw cx cy cz de dj dk dm do dz ec ee eg eh er es et eu fi "
+    "fj fk fm fo fr ga gb gd ge gf gg gh gi gl gm gn gp gq gr gs gt gu gw gy "
+    "hk hm hn hr ht hu id ie il im in io iq ir is it je jm jo jp ke kg kh ki "
+    "km kn kp kr krd kw ky kz la lb lc li lk lr ls lt lu lv ly ma mc md me "
+    "mg mh mk ml mm mn mo mp mq mr ms mt mu mv mw mx my mz na nc ne nf ng ni "
+    "nl no np nr nu nz om pa pe pf pg ph pk pl pm pn pr ps pt pw py qa re ro "
+    "rs ru rw sa sb sc sd se sg sh si sj sk sl sm sn so sr ss st su sv sx sy "
+    "sz tc td tf tg th tj tk tl tm tn to tp tr tt tv tw tz ua ug uk us uy uz "
+    "va vc ve vg vi vn vu wf ws ye yt za zm zw";
+
+const std::unordered_set<std::string>& country_codes() {
+  static const std::unordered_set<std::string>* set = [] {
+    auto* s = new std::unordered_set<std::string>;
+    const char* p = kCountryCodes;
+    while (*p) {
+      const char* q = p;
+      while (*q && *q != ' ') q++;
+      s->emplace(p, (size_t)(q - p));
+      p = *q ? q + 1 : q;
+    }
+    s->emplace("");  // the reference set contains the empty string
+    return s;
+  }();
+  return *set;
+}
+
+// Shannon entropy with Python's exact summation: counts in first-seen
+// character order (Counter iteration order) and CPython 3.12+ builtin
+// sum()'s Neumaier compensated accumulation (Python/bltinmodule.c) —
+// plain left-to-right accumulation differs in the last ulp.
+double entropy_of(std::string_view s) {
+  if (s.empty()) return 0.0;
+  int32_t count[256] = {0};
+  unsigned char order[256];
+  int n_distinct = 0;
+  for (unsigned char c : s) {
+    if (count[c]++ == 0) order[n_distinct++] = c;
+  }
+  double n = (double)s.size();
+  double hi = 0.0, comp = 0.0;
+  for (int i = 0; i < n_distinct; i++) {
+    double p = (double)count[order[i]] / n;
+    double x = -(p)*log2(p);
+    double t = hi + x;
+    if (fabs(hi) >= fabs(x))
+      comp += (hi - t) + x;
+    else
+      comp += (x - t) + hi;
+    hi = t;
+  }
+  return hi + comp;
+}
+
+constexpr int NCOLS = 8;
+// Field indices (dns_pre_lda.scala:149; features/dns.py DNS_COLUMNS).
+constexpr int C_TSTAMP = 1, C_FLEN = 2, C_IPDST = 3, C_QNAME = 4;
+constexpr int C_QTYPE = 6, C_QRCODE = 7;
+constexpr char SEP = '\x1f';
+
+struct Dfz {
+  std::string rows;                   // \x1f-joined fields, rows appended
+  std::vector<int64_t> row_off{0};
+  std::vector<double> tstamp_, flen_, entropy_;
+  std::vector<int32_t> sublen_, nparts_;
+  Interner ips, domains, subdomains, qtypes, qrcodes;
+  std::vector<int32_t> ip_id, dom_id, sub_id, qtype_id, qrcode_id;
+  int64_t num_raw = -1;
+
+  // finish() outputs
+  std::vector<int32_t> b_time, b_len, b_sub, b_ent, b_per, top;
+  Interner words;
+  std::vector<int32_t> word_id;
+  std::vector<int32_t> wc_ip, wc_word;
+  std::vector<int64_t> wc_cnt;
+
+  std::string error;
+
+  void add_row(const std::string_view* f) {
+    for (int i = 0; i < NCOLS; i++) {
+      if (i) rows += SEP;
+      rows.append(f[i].data(), f[i].size());
+    }
+    row_off.push_back((int64_t)rows.size());
+
+    tstamp_.push_back(to_double(f[C_TSTAMP]));
+    flen_.push_back(to_double(f[C_FLEN]));
+    ip_id.push_back(ips.intern(f[C_IPDST]));
+    qtype_id.push_back(qtypes.intern(f[C_QTYPE]));
+    qrcode_id.push_back(qrcodes.intern(f[C_QRCODE]));
+
+    // extract_subdomain (dns_pre_lda.scala:185-220 / features/dns.py).
+    std::string_view url = f[C_QNAME];
+    std::vector<std::string_view> parts;
+    size_t start = 0;
+    for (size_t i = 0; i <= url.size(); i++) {
+      if (i == url.size() || url[i] == '.') {
+        parts.push_back(url.substr(start, i - start));
+        start = i + 1;
+      }
+    }
+    while (parts.size() > 1 && parts.back().empty()) parts.pop_back();
+    size_t n = parts.size();
+    std::string_view domain = "None";
+    std::string sub = "None";
+    bool is_ip = n > 2 && parts[n - 1] == "arpa" && parts[n - 2] == "in-addr";
+    if (n > 2 && !is_ip) {
+      bool cc = country_codes().count(std::string(parts[n - 1])) > 0;
+      size_t keep = cc ? n - 3 : n - 2;
+      domain = parts[keep];
+      if (keep >= 1) {
+        sub.clear();
+        for (size_t i = 0; i < keep; i++) {
+          if (i) sub += '.';
+          sub.append(parts[i].data(), parts[i].size());
+        }
+      } else if (!cc) {
+        sub.clear();  // unreachable (keep = n-2 >= 1 when n > 2)
+      }
+    }
+    dom_id.push_back(domains.intern(domain));
+    sub_id.push_back(subdomains.intern(sub));
+    sublen_.push_back(sub != "None" ? (int32_t)sub.size() : 0);
+    nparts_.push_back((int32_t)n);
+    entropy_.push_back(entropy_of(sub));
+  }
+
+  // Split a line on `sep`; keep iff exactly 8 fields.
+  void add_line(std::string_view line, char sep) {
+    std::string_view f[NCOLS];
+    int nf = 0;
+    size_t start = 0;
+    for (size_t i = 0; i <= line.size(); i++) {
+      if (i == line.size() || line[i] == sep) {
+        if (nf < NCOLS) f[nf] = line.substr(start, i - start);
+        nf++;
+        start = i + 1;
+      }
+    }
+    if (nf == NCOLS) add_row(f);
+  }
+
+  void ingest(const char* buf, int64_t len, char sep, bool skip_empty) {
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+      const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+      const char* stop = nl ? nl : end;
+      const char* s2 = stop;
+      if (s2 > p && s2[-1] == '\r') s2--;
+      std::string_view line(p, (size_t)(s2 - p));
+      if (!(skip_empty && line.empty())) add_line(line, sep);
+      p = nl ? nl + 1 : end;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dfz_create() { return new Dfz(); }
+void dfz_destroy(void* h) { delete (Dfz*)h; }
+const char* dfz_error(void* h) { return ((Dfz*)h)->error.c_str(); }
+
+int64_t dfz_ingest_csv_file(void* hv, const char* path, int skip_header) {
+  Dfz* h = (Dfz*)hv;
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    h->error = std::string("cannot open ") + path;
+    return -1;
+  }
+  std::string data;
+  std::vector<char> buf(1 << 22);
+  size_t got;
+  while ((got = fread(buf.data(), 1, buf.size(), f)) > 0)
+    data.append(buf.data(), got);
+  if (ferror(f)) {
+    h->error = std::string("read error on ") + path;
+    fclose(f);
+    return -1;
+  }
+  fclose(f);
+  const char* p = data.data();
+  int64_t len = (int64_t)data.size();
+  if (skip_header) {
+    const char* nl = (const char*)memchr(p, '\n', data.size());
+    if (nl) {
+      len -= (nl + 1 - p);
+      p = nl + 1;
+    } else {
+      len = 0;
+    }
+  }
+  h->ingest(p, len, ',', /*skip_empty=*/true);
+  return (int64_t)h->tstamp_.size();
+}
+
+// Rows pre-split by the caller (parquet, feedback): fields joined by
+// \x1f, rows by \n.
+int64_t dfz_ingest_rows(void* hv, const char* buf, int64_t len) {
+  Dfz* h = (Dfz*)hv;
+  h->ingest(buf, len, SEP, /*skip_empty=*/true);
+  return (int64_t)h->tstamp_.size();
+}
+
+void dfz_mark_raw(void* hv) {
+  Dfz* h = (Dfz*)hv;
+  h->num_raw = (int64_t)h->tstamp_.size();
+}
+int64_t dfz_num_raw(void* hv) {
+  Dfz* h = (Dfz*)hv;
+  return h->num_raw >= 0 ? h->num_raw : (int64_t)h->tstamp_.size();
+}
+int64_t dfz_num_events(void* hv) {
+  return (int64_t)((Dfz*)hv)->tstamp_.size();
+}
+
+const double* dfz_tstamp(void* h) { return ((Dfz*)h)->tstamp_.data(); }
+const double* dfz_frame_len(void* h) { return ((Dfz*)h)->flen_.data(); }
+const double* dfz_entropy(void* h) { return ((Dfz*)h)->entropy_.data(); }
+const int32_t* dfz_sublen(void* h) { return ((Dfz*)h)->sublen_.data(); }
+const int32_t* dfz_nparts(void* h) { return ((Dfz*)h)->nparts_.data(); }
+
+// top_blob: '\n'-joined whitelisted base-domain names (load_top_domains
+// output), decoded into a set for the flag pass.
+int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
+               int nlc, const double* sc, int nsc, const double* ec, int nec,
+               const double* pc, int npc, const char* top_blob,
+               int64_t top_len) {
+  Dfz* h = (Dfz*)hv;
+  size_t n = h->tstamp_.size();
+
+  std::unordered_set<std::string_view> top_set;
+  const char* p = top_blob;
+  const char* end = top_blob + top_len;
+  while (p < end) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+    const char* stop = nl ? nl : end;
+    if (stop > p) top_set.emplace(p, (size_t)(stop - p));
+    p = nl ? nl + 1 : end;
+  }
+  // Whitelist flag per unique domain, not per row.
+  std::vector<int32_t> dom_top(h->domains.arena.size());
+  for (size_t i = 0; i < h->domains.arena.size(); i++) {
+    const std::string& d = h->domains.arena[i];
+    dom_top[i] = d == "intel" ? 2 : (top_set.count(d) ? 1 : 0);
+  }
+
+  h->b_time.resize(n);
+  h->b_len.resize(n);
+  h->b_sub.resize(n);
+  h->b_ent.resize(n);
+  h->b_per.resize(n);
+  h->top.resize(n);
+  h->word_id.resize(n);
+
+  std::unordered_map<uint64_t, int64_t> pos;
+  pos.reserve(n);
+  std::vector<int32_t> w_ip, w_w;
+  std::vector<int64_t> w_c;
+
+  std::string word;
+  for (size_t i = 0; i < n; i++) {
+    int bt = bin_of(h->tstamp_[i], tc, ntc);
+    int bl = bin_of((double)h->flen_[i], lc, nlc);
+    int bs = bin_of((double)h->sublen_[i], sc, nsc);
+    int be = bin_of(h->entropy_[i], ec, nec);
+    int bp = bin_of((double)h->nparts_[i], pc, npc);
+    int tp = dom_top[(size_t)h->dom_id[i]];
+    h->b_time[i] = bt;
+    h->b_len[i] = bl;
+    h->b_sub[i] = bs;
+    h->b_ent[i] = be;
+    h->b_per[i] = bp;
+    h->top[i] = tp;
+
+    // word = top_blen_btime_bsub_bent_bper_type_rcode
+    // (dns_pre_lda.scala:320-327; raw type/rcode field text).
+    word.clear();
+    append_int(word, tp);
+    word += '_';
+    append_int(word, bl);
+    word += '_';
+    append_int(word, bt);
+    word += '_';
+    append_int(word, bs);
+    word += '_';
+    append_int(word, be);
+    word += '_';
+    append_int(word, bp);
+    word += '_';
+    word += h->qtypes.arena[(size_t)h->qtype_id[i]];
+    word += '_';
+    word += h->qrcodes.arena[(size_t)h->qrcode_id[i]];
+    int32_t wid = h->words.intern(word);
+    h->word_id[i] = wid;
+
+    uint64_t key = ((uint64_t)(uint32_t)h->ip_id[i] << 32) | (uint32_t)wid;
+    auto it = pos.emplace(key, (int64_t)w_c.size());
+    if (it.second) {
+      w_ip.push_back(h->ip_id[i]);
+      w_w.push_back(wid);
+      w_c.push_back(1);
+    } else {
+      w_c[(size_t)it.first->second]++;
+    }
+  }
+  h->wc_ip = std::move(w_ip);
+  h->wc_word = std::move(w_w);
+  h->wc_cnt = std::move(w_c);
+  return 0;
+}
+
+const int32_t* dfz_bins(void* hv, int which) {
+  Dfz* h = (Dfz*)hv;
+  switch (which) {
+    case 0: return h->b_time.data();
+    case 1: return h->b_len.data();
+    case 2: return h->b_sub.data();
+    case 3: return h->b_ent.data();
+    default: return h->b_per.data();
+  }
+}
+const int32_t* dfz_top(void* h) { return ((Dfz*)h)->top.data(); }
+
+const int32_t* dfz_ids(void* hv, int which) {
+  Dfz* h = (Dfz*)hv;
+  switch (which) {
+    case 0: return h->ip_id.data();
+    case 1: return h->dom_id.data();
+    case 2: return h->sub_id.data();
+    case 3: return h->word_id.data();
+    default: return nullptr;
+  }
+}
+
+static Interner& dtable_of(void* hv, int which) {
+  Dfz* h = (Dfz*)hv;
+  switch (which) {
+    case 0: return h->ips;
+    case 1: return h->domains;
+    case 2: return h->subdomains;
+    default: return h->words;
+  }
+}
+int64_t dfz_table_count(void* hv, int which) {
+  return (int64_t)dtable_of(hv, which).arena.size();
+}
+const char* dfz_table_blob(void* hv, int which) {
+  Interner& t = dtable_of(hv, which);
+  t.build_export();
+  return t.blob.data();
+}
+int64_t dfz_table_blob_len(void* hv, int which) {
+  Interner& t = dtable_of(hv, which);
+  t.build_export();
+  return (int64_t)t.blob.size();
+}
+const int64_t* dfz_table_offsets(void* hv, int which) {
+  Interner& t = dtable_of(hv, which);
+  t.build_export();
+  return t.offsets.data();
+}
+
+const char* dfz_rows_blob(void* hv) { return ((Dfz*)hv)->rows.data(); }
+int64_t dfz_rows_blob_len(void* hv) {
+  return (int64_t)((Dfz*)hv)->rows.size();
+}
+const int64_t* dfz_row_offsets(void* hv) {
+  return ((Dfz*)hv)->row_off.data();
+}
+
+int64_t dfz_wc_len(void* hv) { return (int64_t)((Dfz*)hv)->wc_cnt.size(); }
+const int32_t* dfz_wc_ip(void* hv) { return ((Dfz*)hv)->wc_ip.data(); }
+const int32_t* dfz_wc_word(void* hv) { return ((Dfz*)hv)->wc_word.data(); }
+const int64_t* dfz_wc_count(void* hv) { return ((Dfz*)hv)->wc_cnt.data(); }
+
+}  // extern "C"

@@ -25,6 +25,8 @@
 // numerals ("1_0") and unusual unicode whitespace are rejected (NaN) —
 // neither occurs in netflow CSVs.
 
+#include "common.h"
+
 #include <charconv>
 #include <cmath>
 #include <cstdint>
@@ -38,62 +40,6 @@
 #include <vector>
 
 namespace {
-
-struct Interner {
-  std::unordered_map<std::string_view, int32_t> ids;
-  std::deque<std::string> arena;
-  // Export cache (blob + offsets), built lazily.
-  std::string blob;
-  std::vector<int64_t> offsets;
-
-  std::pair<int32_t, bool> intern(std::string_view s) {
-    auto it = ids.find(s);
-    if (it != ids.end()) return {it->second, false};
-    // Growing the arena invalidates any snapshot a caller exported.
-    blob.clear();
-    offsets.clear();
-    arena.emplace_back(s);
-    int32_t id = (int32_t)ids.size();
-    ids.emplace(std::string_view(arena.back()), id);
-    return {id, true};
-  }
-
-  void build_export() {
-    if (!offsets.empty()) return;
-    offsets.reserve(arena.size() + 1);
-    offsets.push_back(0);
-    size_t total = 0;
-    for (const auto& s : arena) total += s.size();
-    blob.reserve(total);
-    for (const auto& s : arena) {
-      blob += s;
-      offsets.push_back((int64_t)blob.size());
-    }
-  }
-};
-
-// Python float(): trimmed token, optional sign, decimal/exponent/inf/nan;
-// anything else (or empty) -> NaN.  std::from_chars handles inf/nan but
-// not a leading '+'.
-double to_double(std::string_view s) {
-  size_t b = 0, e = s.size();
-  while (b < e && std::isspace((unsigned char)s[b])) b++;
-  while (e > b && std::isspace((unsigned char)s[e - 1])) e--;
-  if (b == e) return NAN;
-  std::string_view t = s.substr(b, e - b);
-  if (t[0] == '+') t.remove_prefix(1);
-  if (t.empty()) return NAN;
-  double v;
-  auto [p, ec] = std::from_chars(t.data(), t.data() + t.size(), v);
-  if (ec == std::errc::result_out_of_range && p == t.data() + t.size()) {
-    // Python float() saturates: "1e999" -> inf, "1e-999" -> 0.0.  strtod
-    // has exactly those semantics; rare path, so the copy is fine.
-    std::string z(t);
-    return strtod(z.c_str(), nullptr);
-  }
-  if (ec != std::errc() || p != t.data() + t.size()) return NAN;
-  return v;
-}
 
 // str(float) / JVM Double.toString for the values that occur here:
 // shortest round-trip repr with a ".0" suffix for integral values.
@@ -111,6 +57,10 @@ constexpr int NCOLS = 27;
 // swapped dport/sport naming (oni_ml_tpu/features/flow.py docstring).
 constexpr int C_HOUR = 4, C_MIN = 5, C_SEC = 6, C_SIP = 8, C_DIP = 9;
 constexpr int C_10 = 10, C_11 = 11, C_IPKT = 16, C_IBYT = 17;
+
+using oni::Interner;
+using oni::to_double;
+using oni::bin_of;
 
 struct Ffz {
   bool skip_header;
@@ -170,8 +120,8 @@ struct Ffz {
     ipkt_.push_back(to_double(f[C_IPKT]));
     c10_.push_back(to_double(f[C_10]));
     c11_.push_back(to_double(f[C_11]));
-    sip_id.push_back(ips.intern(f[C_SIP]).first);
-    dip_id.push_back(ips.intern(f[C_DIP]).first);
+    sip_id.push_back(ips.intern(f[C_SIP]));
+    dip_id.push_back(ips.intern(f[C_DIP]));
   }
 
   void ingest_buffer(const char* buf, int64_t len) {
@@ -188,12 +138,6 @@ struct Ffz {
     }
   }
 };
-
-int bin_of(double v, const double* cuts, int n) {
-  int b = 0;
-  for (int i = 0; i < n; i++) b += v > cuts[i];  // NaN > c is false
-  return b;
-}
 
 }  // namespace
 
@@ -345,7 +289,7 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
     if (wpit != wp_cache.end()) {
       wp_id = wpit->second;
     } else {
-      wp_id = h->words.intern(jvm_double(word_port)).first;
+      wp_id = h->words.intern(jvm_double(word_port));
       wp_cache.emplace(wp_bits, wp_id);
     }
 
@@ -373,8 +317,8 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
       word += jvm_double((double)bb);
       word += '_';
       word += jvm_double((double)pb);
-      wi.base = h->words.intern(word).first;
-      wi.prefixed = h->words.intern("-1_" + word).first;
+      wi.base = h->words.intern(word);
+      wi.prefixed = h->words.intern("-1_" + word);
       if (cacheable) word_cache.emplace(wkey, wi);
     }
     int32_t src_wid = src_prefixed ? wi.prefixed : wi.base;

@@ -95,13 +95,27 @@ def extract_subdomain(url: str) -> tuple[str, str, int, int]:
 
 def shannon_entropy(s: str) -> float:
     """Character-level Shannon entropy in bits (dns_pre_lda.scala:278-284).
-    entropy('') = 0; entropy of the literal 'None' placeholder = 2.0."""
+    entropy('') = 0; entropy of the literal 'None' placeholder = 2.0.
+
+    The accumulation is an explicit Neumaier compensated sum — the same
+    algorithm CPython 3.12+'s builtin sum() uses for floats — so the
+    result is identical on every interpreter version AND bit-identical
+    to the native featurizer's C++ implementation (which replicates this
+    exact loop; tests/test_native_dns.py asserts equality)."""
     if not s:
         return 0.0
     n = len(s)
-    return sum(
-        -(c / n) * math.log2(c / n) for c in Counter(s).values()
-    )
+    hi = comp = 0.0
+    for c in Counter(s).values():
+        p = c / n
+        x = -(p) * math.log2(p)
+        t = hi + x
+        if abs(hi) >= abs(x):
+            comp += (hi - t) + x
+        else:
+            comp += (x - t) + hi
+        hi = t
+    return hi + comp
 
 
 def load_top_domains(path: str) -> frozenset[str]:

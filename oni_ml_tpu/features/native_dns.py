@@ -1,0 +1,339 @@
+"""ctypes binding for the native DNS featurizer (native/dns_featurize.cpp).
+
+``featurize_dns_sources`` is the production entry point for the DNS pre
+stage: CSV files stream straight through C++; parquet files (and
+feedback rows) are projected to 8-column rows in Python and handed over
+as an \\x1f-separated blob.  Falls back to ``features.dns.featurize_dns``
+when the native library is unavailable.  Parity with the Python path is
+pinned by tests/test_native_dns.py.
+
+ECDF cuts are computed in Python from the native pass's arrays with
+quantiles.ecdf_cuts (single implementation of the reference's quantile
+rule), including the positive-subset quintiles for subdomain length /
+entropy / period count (dns_pre_lda.scala:298-305).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from ..native_build import NativeLib
+from .dns import DnsFeatures, featurize_dns
+from .quantiles import DECILES, QUINTILES, ecdf_cuts
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F64P = ctypes.POINTER(ctypes.c_double)
+_SEP = "\x1f"
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.dfz_create.restype = ctypes.c_void_p
+    lib.dfz_destroy.argtypes = [ctypes.c_void_p]
+    lib.dfz_error.restype = ctypes.c_char_p
+    lib.dfz_error.argtypes = [ctypes.c_void_p]
+    lib.dfz_ingest_csv_file.restype = ctypes.c_int64
+    lib.dfz_ingest_csv_file.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.dfz_ingest_rows.restype = ctypes.c_int64
+    lib.dfz_ingest_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.dfz_mark_raw.argtypes = [ctypes.c_void_p]
+    for fn in ("dfz_num_raw", "dfz_num_events", "dfz_rows_blob_len",
+               "dfz_wc_len"):
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    for fn in ("dfz_tstamp", "dfz_frame_len", "dfz_entropy"):
+        getattr(lib, fn).restype = _F64P
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    for fn in ("dfz_sublen", "dfz_nparts", "dfz_top"):
+        getattr(lib, fn).restype = _I32P
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.dfz_finish.restype = ctypes.c_int
+    lib.dfz_finish.argtypes = (
+        [ctypes.c_void_p]
+        + [_F64P, ctypes.c_int] * 5
+        + [ctypes.c_char_p, ctypes.c_int64]
+    )
+    for fn in ("dfz_bins", "dfz_ids"):
+        getattr(lib, fn).restype = _I32P
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dfz_table_count.restype = ctypes.c_int64
+    lib.dfz_table_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dfz_table_blob.restype = ctypes.c_void_p
+    lib.dfz_table_blob.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dfz_table_blob_len.restype = ctypes.c_int64
+    lib.dfz_table_blob_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dfz_table_offsets.restype = _I64P
+    lib.dfz_table_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dfz_rows_blob.restype = ctypes.c_void_p
+    lib.dfz_rows_blob.argtypes = [ctypes.c_void_p]
+    lib.dfz_row_offsets.restype = _I64P
+    lib.dfz_row_offsets.argtypes = [ctypes.c_void_p]
+    for fn, res in [
+        ("dfz_wc_ip", _I32P), ("dfz_wc_word", _I32P), ("dfz_wc_count", _I64P),
+    ]:
+        getattr(lib, fn).restype = res
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+
+
+_LIB = NativeLib(
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "dns_featurize.cpp"
+    ),
+    os.path.join(os.path.dirname(__file__), "_native", "liboni_dns.so"),
+    _configure,
+    deps=(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "native", "common.h"
+        ),
+    ),
+)
+
+
+def available() -> bool:
+    return _LIB.available()
+
+
+def _copy(ptr, n, dtype):
+    if n == 0:
+        return np.zeros(0, dtype=dtype)
+    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+def _table(lib, h, which: int) -> list[str]:
+    cnt = lib.dfz_table_count(h, which)
+    blob = ctypes.string_at(
+        lib.dfz_table_blob(h, which), lib.dfz_table_blob_len(h, which)
+    )
+    off = _copy(lib.dfz_table_offsets(h, which), cnt + 1, np.int64)
+    return [blob[off[i]:off[i + 1]].decode("utf-8") for i in range(cnt)]
+
+
+class NativeDnsFeatures:
+    """DnsFeatures-compatible container backed by native arrays (same
+    duck-typed surface the scorer and runner consume; see
+    NativeFlowFeatures for the design notes)."""
+
+    def __init__(self, *, rows_blob, row_off, ip_table, domain_table,
+                 subdomain_table, word_table, ip_id, dom_id, sub_id, word_id,
+                 subdomain_length, num_periods, subdomain_entropy, top_domain,
+                 wc_ip, wc_word, wc_count, num_raw_events, time_cuts,
+                 frame_length_cuts, subdomain_length_cuts, entropy_cuts,
+                 numperiods_cuts):
+        self.rows_blob = rows_blob
+        self.row_off = row_off
+        self.ip_table = ip_table
+        self.domain_table = domain_table
+        self.subdomain_table = subdomain_table
+        self.word_table = word_table
+        self.ip_id = ip_id
+        self.dom_id = dom_id
+        self.sub_id = sub_id
+        self.word_id = word_id
+        self.subdomain_length = subdomain_length
+        self.num_periods = num_periods
+        self.subdomain_entropy = subdomain_entropy
+        self.top_domain = top_domain
+        self.wc_ip = wc_ip
+        self.wc_word = wc_word
+        self.wc_count = wc_count
+        self.num_raw_events = num_raw_events
+        self.time_cuts = time_cuts
+        self.frame_length_cuts = frame_length_cuts
+        self.subdomain_length_cuts = subdomain_length_cuts
+        self.entropy_cuts = entropy_cuts
+        self.numperiods_cuts = numperiods_cuts
+        self._lists: dict[str, list[str]] = {}
+
+    @property
+    def num_events(self) -> int:
+        return len(self.ip_id)
+
+    def row(self, i: int) -> list[str]:
+        raw = self.rows_blob[self.row_off[i]:self.row_off[i + 1]]
+        return raw.decode("utf-8").split(_SEP)
+
+    def client_ip(self, i: int) -> str:
+        return self.ip_table[self.ip_id[i]]
+
+    def _list(self, which: str) -> list[str]:
+        if which not in self._lists:
+            table, ids = {
+                "domain": (self.domain_table, self.dom_id),
+                "subdomain": (self.subdomain_table, self.sub_id),
+                "word": (self.word_table, self.word_id),
+            }[which]
+            self._lists[which] = [table[j] for j in ids]
+        return self._lists[which]
+
+    @property
+    def domain(self) -> list[str]:
+        return self._list("domain")
+
+    @property
+    def subdomain(self) -> list[str]:
+        return self._list("subdomain")
+
+    @property
+    def word(self) -> list[str]:
+        return self._list("word")
+
+    @property
+    def rows(self) -> list[list[str]]:
+        return [self.row(i) for i in range(self.num_events)]
+
+    def word_counts(self) -> list[tuple[str, str, int]]:
+        ips, words = self.ip_table, self.word_table
+        return [
+            (ips[i], words[w], int(c))
+            for i, w, c in zip(self.wc_ip, self.wc_word, self.wc_count)
+        ]
+
+    def featurized_row(self, i: int) -> list[str]:
+        return self.row(i) + [
+            self.domain_table[self.dom_id[i]],
+            self.subdomain_table[self.sub_id[i]],
+            str(int(self.subdomain_length[i])),
+            str(int(self.num_periods[i])),
+            str(self.subdomain_entropy[i]),
+            str(int(self.top_domain[i])),
+            self.word_table[self.word_id[i]],
+        ]
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lists")
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lists = {}
+
+
+def _rows_to_blob(rows: Iterable[Sequence[str]]) -> bytes:
+    return (
+        "\n".join(_SEP.join(r) for r in rows) + "\n"
+    ).encode("utf-8") if rows else b""
+
+
+def _featurize_native(
+    lib,
+    sources: Sequence,
+    feedback_rows: Sequence[Sequence[str]],
+    top_domains: frozenset,
+) -> NativeDnsFeatures:
+    h = lib.dfz_create()
+    try:
+        for src in sources:
+            if isinstance(src, str):
+                if lib.dfz_ingest_csv_file(h, os.fsencode(src), 0) < 0:
+                    raise OSError(
+                        lib.dfz_error(h).decode("utf-8", "replace")
+                    )
+            elif src:
+                blob = _rows_to_blob(src)
+                lib.dfz_ingest_rows(h, blob, len(blob))
+        lib.dfz_mark_raw(h)
+        if feedback_rows:
+            blob = _rows_to_blob(feedback_rows)
+            lib.dfz_ingest_rows(h, blob, len(blob))
+
+        n = lib.dfz_num_events(h)
+        tstamp = _copy(lib.dfz_tstamp(h), n, np.float64)
+        frame_len = _copy(lib.dfz_frame_len(h), n, np.float64)
+        entropy = _copy(lib.dfz_entropy(h), n, np.float64)
+        sub_len = _copy(lib.dfz_sublen(h), n, np.int64)
+        n_parts = _copy(lib.dfz_nparts(h), n, np.int64)
+
+        time_cuts = ecdf_cuts(tstamp, DECILES)
+        frame_length_cuts = ecdf_cuts(frame_len, DECILES)
+        subdomain_length_cuts = ecdf_cuts(sub_len[sub_len > 0], QUINTILES)
+        entropy_cuts = ecdf_cuts(entropy[entropy > 0], QUINTILES)
+        numperiods_cuts = ecdf_cuts(n_parts[n_parts > 0], QUINTILES)
+
+        top_blob = "\n".join(sorted(top_domains)).encode("utf-8")
+
+        def fp(a):
+            return np.ascontiguousarray(a, np.float64).ctypes.data_as(_F64P)
+
+        if (
+            lib.dfz_finish(
+                h, fp(time_cuts), len(time_cuts),
+                fp(frame_length_cuts), len(frame_length_cuts),
+                fp(subdomain_length_cuts), len(subdomain_length_cuts),
+                fp(entropy_cuts), len(entropy_cuts),
+                fp(numperiods_cuts), len(numperiods_cuts),
+                top_blob, len(top_blob),
+            )
+            < 0
+        ):
+            raise ValueError(lib.dfz_error(h).decode("utf-8", "replace"))
+
+        nwc = lib.dfz_wc_len(h)
+        return NativeDnsFeatures(
+            rows_blob=ctypes.string_at(
+                lib.dfz_rows_blob(h), lib.dfz_rows_blob_len(h)
+            ),
+            row_off=_copy(lib.dfz_row_offsets(h), n + 1, np.int64),
+            ip_table=_table(lib, h, 0),
+            domain_table=_table(lib, h, 1),
+            subdomain_table=_table(lib, h, 2),
+            word_table=_table(lib, h, 3),
+            ip_id=_copy(lib.dfz_ids(h, 0), n, np.int32),
+            dom_id=_copy(lib.dfz_ids(h, 1), n, np.int32),
+            sub_id=_copy(lib.dfz_ids(h, 2), n, np.int32),
+            word_id=_copy(lib.dfz_ids(h, 3), n, np.int32),
+            subdomain_length=sub_len,
+            num_periods=n_parts,
+            subdomain_entropy=entropy,
+            top_domain=_copy(lib.dfz_top(h), n, np.int64),
+            wc_ip=_copy(lib.dfz_wc_ip(h), nwc, np.int32),
+            wc_word=_copy(lib.dfz_wc_word(h), nwc, np.int32),
+            wc_count=_copy(lib.dfz_wc_count(h), nwc, np.int64),
+            num_raw_events=int(lib.dfz_num_raw(h)),
+            time_cuts=time_cuts,
+            frame_length_cuts=frame_length_cuts,
+            subdomain_length_cuts=subdomain_length_cuts,
+            entropy_cuts=entropy_cuts,
+            numperiods_cuts=numperiods_cuts,
+        )
+    finally:
+        lib.dfz_destroy(ctypes.c_void_p(h))
+
+
+def featurize_dns_sources(
+    sources: Sequence = (),
+    top_domains: frozenset = frozenset(),
+    feedback_rows: Sequence[Sequence[str]] = (),
+) -> "NativeDnsFeatures | DnsFeatures":
+    """Featurize DNS events, native when possible.
+
+    `sources` is an ORDERED sequence whose elements are CSV paths (str)
+    or pre-projected 8-column row lists (parquet).  Events enter the
+    corpus in exactly the listed order — first-seen doc/word id
+    assignment (the words.dat/doc.dat line-number contract) and the
+    results row order depend on it.
+    """
+    lib = _LIB.load()
+    if lib is not None:
+        return _featurize_native(lib, sources, feedback_rows, top_domains)
+    rows: list[list[str]] = []
+    for src in sources:
+        if isinstance(src, str):
+            with open(src) as f:
+                for line in f:
+                    line = line.rstrip("\r\n")  # CRLF-safe like the C++ path
+                    if line:
+                        rows.append(line.split(","))
+        else:
+            rows.extend(list(r) for r in src)
+    return featurize_dns(
+        rows, top_domains=top_domains, feedback_rows=feedback_rows
+    )

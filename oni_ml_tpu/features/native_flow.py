@@ -87,6 +87,11 @@ _LIB = NativeLib(
     ),
     os.path.join(os.path.dirname(__file__), "_native", "liboni_flow.so"),
     _configure,
+    deps=(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "native", "common.h"
+        ),
+    ),
 )
 
 

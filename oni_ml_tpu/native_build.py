@@ -25,18 +25,23 @@ class NativeLib:
         src_path: str,
         lib_path: str,
         configure: Callable[[ctypes.CDLL], None],
+        deps: tuple[str, ...] = (),
     ):
         self._src = os.path.abspath(src_path)
         self._lib_path = lib_path
         self._configure = configure
+        self._deps = tuple(os.path.abspath(d) for d in deps)
         self._lock = threading.Lock()
         self._lib: ctypes.CDLL | None = None
         self._failed = False
 
     def _stale(self) -> bool:
         try:
-            return os.path.getmtime(self._src) > os.path.getmtime(
-                self._lib_path
+            lib_mtime = os.path.getmtime(self._lib_path)
+            return any(
+                os.path.getmtime(f) > lib_mtime
+                for f in (self._src, *self._deps)
+                if os.path.exists(f)
             )
         except OSError:
             return False
@@ -47,8 +52,11 @@ class NativeLib:
         os.makedirs(os.path.dirname(self._lib_path), exist_ok=True)
         tmp = self._lib_path + f".build{os.getpid()}"
         cmd = [
-            "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp,
-            self._src,
+            "g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+            # Match CPython's unfused float arithmetic bit-for-bit
+            # (the parity tests assert exact equality on entropy etc.).
+            "-ffp-contract=off",
+            "-o", tmp, self._src,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)

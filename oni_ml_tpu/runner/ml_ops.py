@@ -44,7 +44,6 @@ from ..config import (
     ScoringConfig,
 )
 from ..features import (
-    featurize_dns,
     load_top_domains,
     read_dns_feedback_rows,
     read_flow_feedback_rows,
@@ -116,22 +115,17 @@ def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _read_dns_rows(path: str) -> list[list[str]]:
-    """Read 8-column DNS events.  CSV always works; parquet if pyarrow or
-    pandas happens to be importable (the reference reads Hive parquet,
-    dns_pre_lda.scala:142)."""
-    paths = [p for p in path.split(",") if p]
-    rows: list[list[str]] = []
-    for p in paths:
-        if p.endswith(".parquet"):
-            rows.extend(_read_parquet_rows(p))
-        else:
-            with open(p) as f:
-                for line in f:
-                    line = line.rstrip("\n")
-                    if line:
-                        rows.append(line.split(","))
-    return rows
+def _dns_sources(path: str) -> list:
+    """Comma-separated DNS input list -> ordered featurizer sources: CSV
+    paths stay paths (streamed through the native featurizer); parquet
+    files become pre-projected row lists (the reference reads Hive
+    parquet, dns_pre_lda.scala:142).  Listed order is preserved — the
+    first-seen id contract depends on event order."""
+    return [
+        _read_parquet_rows(p) if p.endswith(".parquet") else p
+        for p in path.split(",")
+        if p
+    ]
 
 
 def _read_parquet_rows(path: str) -> list[list[str]]:
@@ -184,8 +178,11 @@ def stage_pre(ctx: RunContext) -> dict:
             if cfg.top_domains_path
             else frozenset()
         )
-        features = featurize_dns(
-            _read_dns_rows(cfg.dns_path), top_domains=top, feedback_rows=fb_rows
+        from ..features.native_dns import featurize_dns_sources
+
+        features = featurize_dns_sources(
+            _dns_sources(cfg.dns_path), top_domains=top,
+            feedback_rows=fb_rows,
         )
     with open(ctx.path("features.pkl"), "wb") as f:
         pickle.dump(features, f, protocol=pickle.HIGHEST_PROTOCOL)

@@ -1,0 +1,142 @@
+"""Native (C++) DNS featurizer vs the pure-Python path."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.features import dns as pydns
+from oni_ml_tpu.features import native_dns
+
+from test_features import dns_row
+
+pytestmark = pytest.mark.skipif(
+    not native_dns.available(), reason="native dns featurizer unavailable"
+)
+
+QNAMES = [
+    "www.google.com", "a.b.co.uk", "x.in-addr.arpa", "5.4.3.2.in-addr.arpa",
+    "justtld", "two.parts", "deep.sub.domain.example.org", "None.foo.com",
+    "dga-x7f3k9q2.evil.biz", "a.b.c.d.e.f.g.h.i.jp", "trailing.dot.net.",
+    ".leading.empty.com", "intel", "www.intel.com", "a..b.example.com", "",
+]
+
+
+def make_day(tmp_path, n=400, seed=3):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        rows.append(
+            dns_row(
+                tstamp=str(int(rng.integers(1, 100000))),
+                flen=str(int(rng.integers(40, 1500))),
+                ip=f"10.1.{rng.integers(0, 4)}.{rng.integers(1, 60)}",
+                qname=QNAMES[rng.integers(0, len(QNAMES))],
+                qtype=str(rng.integers(1, 5)),
+                rcode=str(rng.integers(0, 3)),
+            )
+        )
+    rows.append(["only", "three", "fields"])     # wrong width -> dropped
+    rows.append(dns_row(flen="##"))              # NaN numeric
+    path = tmp_path / "dns.csv"
+    path.write_text("\n".join(",".join(r) for r in rows) + "\n")
+    return path, rows
+
+
+TOP = frozenset({"google", "intel-ignored", "example"})
+
+
+def featurize_both(tmp_path, feedback_rows=(), **kw):
+    path, rows = make_day(tmp_path, **kw)
+    py_rows = [r for r in rows if len(r) == pydns.NUM_DNS_COLUMNS]
+    py = pydns.featurize_dns(
+        py_rows, top_domains=TOP, feedback_rows=feedback_rows
+    )
+    nat = native_dns.featurize_dns_sources(
+        [str(path)], top_domains=TOP, feedback_rows=feedback_rows
+    )
+    assert isinstance(nat, native_dns.NativeDnsFeatures)
+    return py, nat
+
+
+def assert_parity(py, nat):
+    assert nat.num_events == py.num_events
+    assert nat.num_raw_events == py.num_raw_events
+    for name in ("time_cuts", "frame_length_cuts", "subdomain_length_cuts",
+                 "entropy_cuts", "numperiods_cuts"):
+        np.testing.assert_array_equal(getattr(nat, name), getattr(py, name))
+    assert nat.domain == py.domain
+    assert nat.subdomain == py.subdomain
+    np.testing.assert_array_equal(nat.subdomain_length, py.subdomain_length)
+    np.testing.assert_array_equal(nat.num_periods, py.num_periods)
+    # Entropy must be bit-identical (same summation order, same libm).
+    np.testing.assert_array_equal(nat.subdomain_entropy, py.subdomain_entropy)
+    np.testing.assert_array_equal(nat.top_domain, py.top_domain)
+    assert nat.word == py.word
+    assert nat.rows == py.rows
+    assert nat.word_counts() == py.word_counts()
+    for i in range(0, py.num_events, max(1, py.num_events // 9)):
+        assert nat.featurized_row(i) == py.featurized_row(i)
+        assert nat.client_ip(i) == py.client_ip(i)
+
+
+def test_parity_random_day(tmp_path):
+    py, nat = featurize_both(tmp_path)
+    assert_parity(py, nat)
+
+
+def test_parity_with_feedback(tmp_path):
+    fb = [dns_row(ip="9.9.9.9", qname="fb.example.com")] * 5
+    py, nat = featurize_both(tmp_path, feedback_rows=fb)
+    assert_parity(py, nat)
+    assert nat.num_events == nat.num_raw_events + 5
+
+
+def test_parquet_style_rows_with_commas(tmp_path):
+    # Fields containing commas (frame_time!) survive the \x1f transport,
+    # and sources featurize in LISTED order (first-seen id contract).
+    path, rows = make_day(tmp_path, n=20)
+    extra = [
+        ["Mar 10, 2016 10:12:13", "12345", "99", "10.9.9.9",
+         "comma.example.com", "1", "1", "0"],
+    ]
+    py_rows = [r for r in rows if len(r) == 8] + extra
+    py = pydns.featurize_dns(py_rows, top_domains=TOP)
+    nat = native_dns.featurize_dns_sources(
+        [str(path), extra], top_domains=TOP
+    )
+    assert_parity(py, nat)
+    assert nat.row(nat.num_events - 1)[0] == "Mar 10, 2016 10:12:13"
+
+
+def test_pickle_roundtrip(tmp_path):
+    _, nat = featurize_both(tmp_path, n=30)
+    again = pickle.loads(pickle.dumps(nat))
+    assert again.word_counts() == nat.word_counts()
+    assert again.featurized_row(2) == nat.featurized_row(2)
+
+
+def test_scoring_identical(tmp_path):
+    from oni_ml_tpu.scoring import ScoringModel, score_dns
+
+    py, nat = featurize_both(tmp_path)
+    k = 4
+    rng = np.random.default_rng(0)
+    ips = sorted({ip for ip, _, _ in py.word_counts()})
+    words = sorted({w for _, w, _ in py.word_counts()})
+    model = ScoringModel.from_results(
+        doc_names=ips,
+        doc_topic=rng.dirichlet(np.ones(k), size=len(ips)),
+        vocab=words,
+        word_topic=rng.dirichlet(np.ones(k), size=len(words)),
+        fallback=0.1,
+    )
+    rows_py, s_py = score_dns(py, model, threshold=1.1)
+    rows_nat, s_nat = score_dns(nat, model, threshold=1.1)
+    assert rows_py == rows_nat
+    np.testing.assert_array_equal(s_py, s_nat)
+
+
+def test_directory_path_errors(tmp_path):
+    with pytest.raises(OSError):
+        native_dns.featurize_dns_sources([str(tmp_path)])
