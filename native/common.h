@@ -6,7 +6,9 @@
 #include <charconv>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <deque>
 #include <locale.h>
 #include <string>
@@ -84,6 +86,49 @@ inline void append_int(std::string& s, int v) {
   auto [p, ec] = std::to_chars(buf, buf + sizeof(buf), v);
   (void)ec;
   s.append(buf, p);
+}
+
+// Constant-memory file streaming: reads 4MB chunks, carries the partial
+// trailing line between chunks, and hands newline-complete buffers to
+// `on_buffer(ptr, len)`.  Returns false (setting `err`) on open/read
+// failure — fread reports EOF and I/O errors identically, so ferror is
+// the only truncation signal.
+template <class F>
+inline bool stream_file(const char* path, std::string& err, F&& on_buffer) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    err = std::string("cannot open ") + path;
+    return false;
+  }
+  std::string pending;
+  std::vector<char> buf(1 << 22);
+  size_t got;
+  while ((got = fread(buf.data(), 1, buf.size(), f)) > 0) {
+    size_t last_nl = got;
+    while (last_nl > 0 && buf[last_nl - 1] != '\n') last_nl--;
+    if (last_nl == 0) {
+      pending.append(buf.data(), got);
+      continue;
+    }
+    size_t start = 0;
+    if (!pending.empty()) {
+      const char* nl = (const char*)memchr(buf.data(), '\n', got);
+      pending.append(buf.data(), (size_t)(nl - buf.data() + 1));
+      on_buffer(pending.data(), (int64_t)pending.size());
+      pending.clear();
+      start = (size_t)(nl - buf.data() + 1);
+    }
+    on_buffer(buf.data() + start, (int64_t)(last_nl - start));
+    if (last_nl < got) pending.assign(buf.data() + last_nl, got - last_nl);
+  }
+  if (ferror(f)) {
+    err = std::string("read error on ") + path;
+    fclose(f);
+    return false;
+  }
+  fclose(f);
+  if (!pending.empty()) on_buffer(pending.data(), (int64_t)pending.size());
+  return true;
 }
 
 }  // namespace oni

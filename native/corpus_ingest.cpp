@@ -12,6 +12,8 @@
 // empty lines skipped, tokens grouped per document in first-seen doc
 // order, duplicate (doc, word) pairs kept as separate tokens.
 
+#include "common.h"
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -23,22 +25,7 @@
 
 namespace {
 
-// Id map keyed by string_view into an arena of stored names: lookups on
-// the hot path (repeat ips/words dominate real corpora) never allocate.
-struct Interner {
-  std::unordered_map<std::string_view, int32_t> ids;
-  std::deque<std::string> arena;  // stable addresses for the views
-
-  // Returns (id, was_new).
-  std::pair<int32_t, bool> intern(std::string_view s) {
-    auto it = ids.find(s);
-    if (it != ids.end()) return {it->second, false};
-    arena.emplace_back(s);
-    int32_t id = (int32_t)ids.size();
-    ids.emplace(std::string_view(arena.back()), id);
-    return {id, true};
-  }
-};
+using oni::Interner;
 
 struct Ingest {
   Interner words;
@@ -87,10 +74,10 @@ bool parse_line(const char* b, const char* e, Ingest& st, int64_t lineno) {
   }
   if (neg) count = -count;
 
-  auto [w, w_new] = st.words.intern(std::string_view(mid + 1, last - mid - 1));
-  (void)w_new;
-  auto [d, d_new] = st.docs.intern(std::string_view(b, mid - b));
-  if (d_new) st.doc_tokens.emplace_back();
+  int32_t w = st.words.intern(std::string_view(mid + 1, last - mid - 1));
+  int32_t d = st.docs.intern(std::string_view(b, mid - b));
+  // A fresh doc id always equals the previous doc count (first-seen ids).
+  if ((size_t)d == st.doc_tokens.size()) st.doc_tokens.emplace_back();
   st.doc_tokens[d].emplace_back(w, (int32_t)count);
   ++st.nnz;
   return true;

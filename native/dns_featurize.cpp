@@ -123,7 +123,7 @@ struct Dfz {
   int64_t num_raw = -1;
 
   // finish() outputs
-  std::vector<int32_t> b_time, b_len, b_sub, b_ent, b_per, top;
+  std::vector<int32_t> top;
   Interner words;
   std::vector<int32_t> word_id;
   std::vector<int32_t> wc_ip, wc_word;
@@ -220,35 +220,19 @@ const char* dfz_error(void* h) { return ((Dfz*)h)->error.c_str(); }
 
 int64_t dfz_ingest_csv_file(void* hv, const char* path, int skip_header) {
   Dfz* h = (Dfz*)hv;
-  FILE* f = fopen(path, "rb");
-  if (!f) {
-    h->error = std::string("cannot open ") + path;
-    return -1;
-  }
-  std::string data;
-  std::vector<char> buf(1 << 22);
-  size_t got;
-  while ((got = fread(buf.data(), 1, buf.size(), f)) > 0)
-    data.append(buf.data(), got);
-  if (ferror(f)) {
-    h->error = std::string("read error on ") + path;
-    fclose(f);
-    return -1;
-  }
-  fclose(f);
-  const char* p = data.data();
-  int64_t len = (int64_t)data.size();
-  if (skip_header) {
-    const char* nl = (const char*)memchr(p, '\n', data.size());
-    if (nl) {
-      len -= (nl + 1 - p);
-      p = nl + 1;
-    } else {
-      len = 0;
-    }
-  }
-  h->ingest(p, len, ',', /*skip_empty=*/true);
-  return (int64_t)h->tstamp_.size();
+  bool skipping = skip_header != 0;
+  bool ok = oni::stream_file(
+      path, h->error, [h, &skipping](const char* p, int64_t n) {
+        if (skipping) {
+          const char* nl = (const char*)memchr(p, '\n', (size_t)n);
+          if (!nl) return;  // header longer than this buffer
+          skipping = false;
+          n -= (nl + 1 - p);
+          p = nl + 1;
+        }
+        h->ingest(p, n, ',', /*skip_empty=*/true);
+      });
+  return ok ? (int64_t)h->tstamp_.size() : -1;
 }
 
 // Rows pre-split by the caller (parquet, feedback): fields joined by
@@ -302,11 +286,6 @@ int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
     dom_top[i] = d == "intel" ? 2 : (top_set.count(d) ? 1 : 0);
   }
 
-  h->b_time.resize(n);
-  h->b_len.resize(n);
-  h->b_sub.resize(n);
-  h->b_ent.resize(n);
-  h->b_per.resize(n);
   h->top.resize(n);
   h->word_id.resize(n);
 
@@ -323,11 +302,6 @@ int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
     int be = bin_of(h->entropy_[i], ec, nec);
     int bp = bin_of((double)h->nparts_[i], pc, npc);
     int tp = dom_top[(size_t)h->dom_id[i]];
-    h->b_time[i] = bt;
-    h->b_len[i] = bl;
-    h->b_sub[i] = bs;
-    h->b_ent[i] = be;
-    h->b_per[i] = bp;
     h->top[i] = tp;
 
     // word = top_blen_btime_bsub_bent_bper_type_rcode
@@ -367,16 +341,6 @@ int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
   return 0;
 }
 
-const int32_t* dfz_bins(void* hv, int which) {
-  Dfz* h = (Dfz*)hv;
-  switch (which) {
-    case 0: return h->b_time.data();
-    case 1: return h->b_len.data();
-    case 2: return h->b_sub.data();
-    case 3: return h->b_ent.data();
-    default: return h->b_per.data();
-  }
-}
 const int32_t* dfz_top(void* h) { return ((Dfz*)h)->top.data(); }
 
 const int32_t* dfz_ids(void* hv, int which) {

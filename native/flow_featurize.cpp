@@ -153,45 +153,10 @@ const char* ffz_error(void* h) { return ((Ffz*)h)->error.c_str(); }
 
 int64_t ffz_ingest_file(void* hv, const char* path) {
   Ffz* h = (Ffz*)hv;
-  FILE* f = fopen(path, "rb");
-  if (!f) {
-    h->error = std::string("cannot open ") + path;
-    return -1;
-  }
-  std::string pending;
-  std::vector<char> buf(1 << 22);
-  size_t got;
-  while ((got = fread(buf.data(), 1, buf.size(), f)) > 0) {
-    size_t start = 0;
-    // Find the last newline; carry the tail over to the next chunk.
-    size_t last_nl = got;
-    while (last_nl > 0 && buf[last_nl - 1] != '\n') last_nl--;
-    if (last_nl == 0) {
-      pending.append(buf.data(), got);
-      continue;
-    }
-    if (!pending.empty()) {
-      const char* nl = (const char*)memchr(buf.data(), '\n', got);
-      pending.append(buf.data(), (size_t)(nl - buf.data() + 1));
-      h->ingest_buffer(pending.data(), (int64_t)pending.size());
-      pending.clear();
-      start = (size_t)(nl - buf.data() + 1);
-    }
-    h->ingest_buffer(buf.data() + start, (int64_t)(last_nl - start));
-    if (last_nl < got) pending.assign(buf.data() + last_nl, got - last_nl);
-  }
-  if (!pending.empty())
-    h->ingest_buffer(pending.data(), (int64_t)pending.size());
-  // fread returns 0 both at EOF and on error (e.g. path is a directory,
-  // or a disk error mid-file): only ferror distinguishes a truncated
-  // read from a complete one.
-  if (ferror(f)) {
-    h->error = std::string("read error on ") + path;
-    fclose(f);
-    return -1;
-  }
-  fclose(f);
-  return (int64_t)h->time_.size();
+  bool ok = oni::stream_file(path, h->error, [h](const char* p, int64_t n) {
+    h->ingest_buffer(p, n);
+  });
+  return ok ? (int64_t)h->time_.size() : -1;
 }
 
 int64_t ffz_ingest_buffer(void* hv, const char* buf, int64_t len) {

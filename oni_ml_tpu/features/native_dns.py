@@ -61,9 +61,8 @@ def _configure(lib: ctypes.CDLL) -> None:
         + [_F64P, ctypes.c_int] * 5
         + [ctypes.c_char_p, ctypes.c_int64]
     )
-    for fn in ("dfz_bins", "dfz_ids"):
-        getattr(lib, fn).restype = _I32P
-        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dfz_ids.restype = _I32P
+    lib.dfz_ids.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dfz_table_count.restype = ctypes.c_int64
     lib.dfz_table_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dfz_table_blob.restype = ctypes.c_void_p
@@ -320,9 +319,24 @@ def featurize_dns_sources(
     corpus in exactly the listed order — first-seen doc/word id
     assignment (the words.dat/doc.dat line-number contract) and the
     results row order depend on it.
+
+    Pre-projected rows whose fields embed the transport bytes ('\\n' or
+    '\\x1f' — possible in raw wire query names, and in security telemetry
+    the weird names ARE the signal) cannot ride the native blob without
+    corruption, so their presence routes the whole run through the
+    Python path instead of silently dropping events.
     """
+
+    def _unsafe(rows) -> bool:
+        return any(
+            "\n" in field or _SEP in field for row in rows for field in row
+        )
+
     lib = _LIB.load()
-    if lib is not None:
+    if lib is not None and not any(
+        _unsafe(src) for src in (*sources, feedback_rows)
+        if not isinstance(src, str)
+    ):
         return _featurize_native(lib, sources, feedback_rows, top_domains)
     rows: list[list[str]] = []
     for src in sources:

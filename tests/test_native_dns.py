@@ -140,3 +140,14 @@ def test_scoring_identical(tmp_path):
 def test_directory_path_errors(tmp_path):
     with pytest.raises(OSError):
         native_dns.featurize_dns_sources([str(tmp_path)])
+
+
+def test_rows_with_transport_bytes_fall_back(tmp_path):
+    # Fields embedding '\n' or '\x1f' can't ride the native blob; the
+    # whole run must take the Python path, not silently drop events.
+    weird = [["t", "1454000000", "60", "10.9.9.1",
+              "evil\nname.example.com", "1", "1", "0"]]
+    feats = native_dns.featurize_dns_sources([weird])
+    assert isinstance(feats, pydns.DnsFeatures)
+    assert feats.num_events == 1
+    assert feats.rows[0][4] == "evil\nname.example.com"

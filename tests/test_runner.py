@@ -20,6 +20,44 @@ from oni_ml_tpu.runner import Stage, run_pipeline
 from test_features import dns_row, flow_row
 
 
+def test_dns_parquet_source(tmp_path):
+    """Mixed CSV + parquet dns_path featurizes in listed order with
+    comma-bearing parquet fields intact (the reference read Hive parquet,
+    dns_pre_lda.scala:142)."""
+    pytest.importorskip("pyarrow")
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from oni_ml_tpu.runner.ml_ops import _dns_sources
+
+    n = 6
+    table = pa.table({
+        "frame_time": ["Mar 10, 2016 01:02:03"] * n,
+        "unix_tstamp": list(range(1454000000, 1454000000 + n)),
+        "frame_len": [60 + i for i in range(n)],
+        "ip_dst": [f"10.2.0.{1 + i}" for i in range(n)],
+        "dns_qry_name": [f"h{i}.svc.example.com" for i in range(n)],
+        "dns_qry_class": ["1"] * n,
+        "dns_qry_type": ["1"] * n,
+        "dns_qry_rcode": ["0"] * n,
+    })
+    pq_path = tmp_path / "day.parquet"
+    pq.write_table(table, pq_path)
+    csv_path = tmp_path / "day.csv"
+    csv_path.write_text(",".join(dns_row(ip="10.3.0.1")) + "\n")
+
+    sources = _dns_sources(f"{pq_path},{csv_path}")
+    assert isinstance(sources[0], list) and isinstance(sources[1], str)
+
+    from oni_ml_tpu.features.native_dns import featurize_dns_sources
+
+    feats = featurize_dns_sources(sources)
+    assert feats.num_events == n + 1
+    # Parquet rows come first (listed order), commas preserved.
+    assert feats.rows[0][0] == "Mar 10, 2016 01:02:03"
+    assert feats.client_ip(n) == "10.3.0.1"
+
+
 @pytest.fixture()
 def flow_day(tmp_path):
     rng = np.random.default_rng(7)
