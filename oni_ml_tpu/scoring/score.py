@@ -129,14 +129,27 @@ def score_flow(
     suspicious-connects output (the reference's post stage re-reads raw
     data without feedback injection)."""
     n = features.num_raw_events
-    sips = [features.sip(i) for i in range(n)]
-    dips = [features.dip(i) for i in range(n)]
-    src_scores = _batched_scores(
-        model, model.ip_rows(sips), model.word_rows(features.src_word[:n])
-    )
-    dest_scores = _batched_scores(
-        model, model.ip_rows(dips), model.word_rows(features.dest_word[:n])
-    )
+    if hasattr(features, "sip_id"):
+        # Native-backed features carry interned id arrays: resolve model
+        # rows once per unique IP/word, then gather — O(unique) dict
+        # lookups instead of O(events).
+        ip_map = model.ip_rows(features.ip_table)
+        word_map = model.word_rows(features.word_table)
+        src_scores = _batched_scores(
+            model, ip_map[features.sip_id[:n]], word_map[features.sw_id[:n]]
+        )
+        dest_scores = _batched_scores(
+            model, ip_map[features.dip_id[:n]], word_map[features.dw_id[:n]]
+        )
+    else:
+        sips = [features.sip(i) for i in range(n)]
+        dips = [features.dip(i) for i in range(n)]
+        src_scores = _batched_scores(
+            model, model.ip_rows(sips), model.word_rows(features.src_word[:n])
+        )
+        dest_scores = _batched_scores(
+            model, model.ip_rows(dips), model.word_rows(features.dest_word[:n])
+        )
     min_scores = np.minimum(src_scores, dest_scores)
     keep = np.where(min_scores < threshold)[0]
     order = keep[np.argsort(min_scores[keep], kind="stable")]
@@ -156,10 +169,18 @@ def score_dns(
     (dns_post_lda.scala:312-331).  Each emitted row is the 15 featurized
     columns + score.  Only raw events are scored (see score_flow)."""
     n = features.num_raw_events
-    ips = [features.client_ip(i) for i in range(n)]
-    scores = _batched_scores(
-        model, model.ip_rows(ips), model.word_rows(features.word[:n])
-    )
+    if hasattr(features, "word_id"):
+        # Native-backed: O(unique) model-row resolution (see score_flow).
+        ip_map = model.ip_rows(features.ip_table)
+        word_map = model.word_rows(features.word_table)
+        scores = _batched_scores(
+            model, ip_map[features.ip_id[:n]], word_map[features.word_id[:n]]
+        )
+    else:
+        ips = [features.client_ip(i) for i in range(n)]
+        scores = _batched_scores(
+            model, model.ip_rows(ips), model.word_rows(features.word[:n])
+        )
     keep = np.where(scores < threshold)[0]
     order = keep[np.argsort(scores[keep], kind="stable")]
     rows = [
