@@ -1,0 +1,117 @@
+"""Randomized native-vs-Python parity fuzzing.
+
+The targeted parity tests (test_native_flow / test_native_dns) pin known
+edge cases; these sweep randomized structure — field garbage, weird
+ports, hostile query names, random widths — across several seeds so a
+future change that breaks parity off the happy path fails loudly.
+Every assertion is exact equality: the native paths are speedups, never
+approximations.
+"""
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.features import dns as pydns
+from oni_ml_tpu.features import flow as pyflow
+from oni_ml_tpu.features import native_dns, native_flow
+
+
+def _rand_token(rng) -> str:
+    kind = rng.integers(0, 7)
+    if kind == 0:
+        return ""
+    if kind == 1:
+        return str(rng.integers(-100, 70000))
+    if kind == 2:
+        return f"{rng.uniform(-1e4, 1e4):.3f}"
+    if kind == 3:
+        return "##"
+    if kind == 4:
+        return rng.choice(["nan", "inf", "-inf", "1e999", "1e-999", "+5"])
+    if kind == 5:
+        return "x" * int(rng.integers(1, 8))
+    return " " + str(rng.integers(0, 99)) + " "
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_flow_fuzz_parity(tmp_path, seed):
+    if not native_flow.available():
+        pytest.skip("native flow featurizer unavailable")
+    rng = np.random.default_rng(seed)
+    lines = ["hdr,line"]
+    for _ in range(300):
+        width = int(rng.choice([27, 27, 27, 26, 28, 5]))
+        lines.append(",".join(_rand_token(rng) for _ in range(width)))
+    path = tmp_path / "flow.csv"
+    path.write_text("\n".join(lines) + "\n")
+
+    with open(path) as f:
+        py = pyflow.featurize_flow(line.rstrip("\n") for line in f)
+    nat = native_flow.featurize_flow_file(str(path))
+    assert nat.num_events == py.num_events
+    np.testing.assert_array_equal(nat.num_time, py.num_time)
+    np.testing.assert_array_equal(nat.time_cuts, py.time_cuts)
+    np.testing.assert_array_equal(nat.ibyt_bin, py.ibyt_bin)
+    np.testing.assert_array_equal(nat.ipkt_bin, py.ipkt_bin)
+    assert nat.src_word == py.src_word
+    assert nat.dest_word == py.dest_word
+    assert nat.word_counts() == py.word_counts()
+    assert nat.rows == py.rows
+
+
+def _rand_qname(rng) -> str:
+    parts = []
+    for _ in range(int(rng.integers(0, 7))):
+        n = int(rng.integers(0, 12))
+        parts.append(
+            "".join(rng.choice(list("abcdef0123456789-"), size=n))
+        )
+    name = ".".join(parts)
+    suffix = rng.integers(0, 6)
+    if suffix == 0:
+        name += ".in-addr.arpa"
+    elif suffix == 1:
+        name += ".co.uk"
+    elif suffix == 2:
+        name += "."
+    elif suffix == 3:
+        name += ".com"
+    return name
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_dns_fuzz_parity(tmp_path, seed):
+    if not native_dns.available():
+        pytest.skip("native dns featurizer unavailable")
+    rng = np.random.default_rng(100 + seed)
+    lines = []
+    for _ in range(300):
+        width = int(rng.choice([8, 8, 8, 7, 9]))
+        fields = [_rand_token(rng) for _ in range(width)]
+        # Mostly structured qnames, but leave ~25% as raw fuzz tokens so
+        # extract_subdomain also sees garbage (##, padded numbers, ...).
+        if width == 8 and rng.random() < 0.75:
+            fields[4] = _rand_qname(rng)
+        lines.append(",".join(fields))
+    path = tmp_path / "dns.csv"
+    path.write_text("\n".join(lines) + "\n")
+
+    rows = [
+        line.split(",")
+        for line in (path.read_text().rstrip("\n")).split("\n")
+        if line
+    ]
+    py = pydns.featurize_dns(rows, top_domains=frozenset({"abc", "google"}))
+    nat = native_dns.featurize_dns_sources(
+        [str(path)], top_domains=frozenset({"abc", "google"})
+    )
+    assert nat.num_events == py.num_events
+    assert nat.domain == py.domain
+    assert nat.subdomain == py.subdomain
+    np.testing.assert_array_equal(nat.subdomain_entropy, py.subdomain_entropy)
+    np.testing.assert_array_equal(nat.num_periods, py.num_periods)
+    for name in ("time_cuts", "frame_length_cuts", "subdomain_length_cuts",
+                 "entropy_cuts", "numperiods_cuts"):
+        np.testing.assert_array_equal(getattr(nat, name), getattr(py, name))
+    assert nat.word == py.word
+    assert nat.word_counts() == py.word_counts()
