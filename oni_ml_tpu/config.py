@@ -46,6 +46,14 @@ class LDAConfig:
     # the convergence check happens on device and the host syncs only at
     # chunk boundaries.  0 or 1 falls back to one dispatch per iteration.
     fused_em_chunk: int = 8
+    # Dense-corpus E-step (ops/dense_estep.py): "auto" densifies the corpus
+    # once and runs the gather/scatter-free MXU kernel when the device is a
+    # TPU, the doc blocks fit VMEM, and the dense corpus fits the HBM
+    # budget below; "on"/"off" force it.  ONI_ML_TPU_ESTEP=dense/xla/pallas
+    # overrides.
+    dense_em: str = "auto"
+    # Device-byte ceiling for the densified corpus under dense_em="auto".
+    dense_hbm_budget: int = 2 * 1024**3
 
     @property
     def k(self) -> int:

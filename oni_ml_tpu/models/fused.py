@@ -77,6 +77,35 @@ def stack_batches(
     return StackedGroups(tuple(arrays), tuple(slots))
 
 
+def densify_groups(groups: StackedGroups, num_terms: int) -> StackedGroups:
+    """Convert stacked sparse groups to dense-counts groups for the
+    gather/scatter-free E-step (ops/dense_estep.py).
+
+    Each group (word_idx [NB,B,L], counts [NB,B,L], mask [NB,B]) becomes
+    (dense_counts [NB,B,V], mask [NB,B]).  The scatter runs ONCE here and
+    is amortized over every EM iteration of the run — that amortization
+    is the whole point (a per-iteration scatter is what the dense path
+    exists to avoid)."""
+    from ..ops import dense_estep
+
+    arrays = []
+    for widx, cnts, mask in groups.arrays:
+        dense = jax.jit(jax.vmap(
+            lambda w, c: dense_estep.densify(w, c, num_terms)
+        ))(widx, cnts)
+        arrays.append((dense, mask))
+    return StackedGroups(tuple(arrays), groups.batch_slots)
+
+
+def dense_groups_bytes(batches: Sequence[Batch], num_terms: int,
+                       itemsize: int = 4) -> int:
+    """Device bytes the densified corpus would occupy."""
+    from ..ops import dense_estep
+
+    width = dense_estep.padded_width(num_terms)
+    return sum(b.word_idx.shape[0] for b in batches) * width * itemsize
+
+
 class ChunkResult(NamedTuple):
     log_beta: jax.Array
     alpha: jax.Array
@@ -99,6 +128,7 @@ def make_chunk_runner(
     estimate_alpha: bool,
     e_step_fn: Callable | None = None,
     m_step_fn: Callable | None = None,
+    compiler_options: dict | None = None,
 ):
     """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
     n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
@@ -118,15 +148,25 @@ def make_chunk_runner(
         total_ll = jnp.zeros((), dtype)
         total_ass = jnp.zeros((), dtype)
         gammas = []
-        for widx, cnts, mask in groups:
+        for group in groups:
 
             def scan_body(carry, batch):
                 ss, ll, ass = carry
-                w, c, m = batch
-                res = e_fn(
-                    log_beta, alpha, w, c, m,
-                    var_max_iters=var_max_iters, var_tol=var_tol,
-                )
+                if len(batch) == 2:            # dense group: (C [B,V], mask)
+                    from ..ops import dense_estep
+
+                    dense, m = batch
+                    res = dense_estep.e_step_dense(
+                        log_beta, alpha, dense, m,
+                        var_max_iters=var_max_iters, var_tol=var_tol,
+                        interpret=jax.default_backend() != "tpu",
+                    )
+                else:                          # sparse group: (w, c, mask)
+                    w, c, m = batch
+                    res = e_fn(
+                        log_beta, alpha, w, c, m,
+                        var_max_iters=var_max_iters, var_tol=var_tol,
+                    )
                 return (
                     (ss + res.suff_stats, ll + res.likelihood,
                      ass + res.alpha_ss),
@@ -134,7 +174,7 @@ def make_chunk_runner(
                 )
 
             (total_ss, total_ll, total_ass), g = jax.lax.scan(
-                scan_body, (total_ss, total_ll, total_ass), (widx, cnts, mask)
+                scan_body, (total_ss, total_ll, total_ass), group
             )
             gammas.append(g)
         new_beta = m_fn(total_ss)
@@ -145,15 +185,14 @@ def make_chunk_runner(
         )
         return new_beta, new_alpha, total_ll, tuple(gammas)
 
-    @jax.jit
-    def run_chunk(log_beta, alpha, ll_prev, groups, n_steps) -> ChunkResult:
+    def run_chunk_impl(log_beta, alpha, ll_prev, groups, n_steps) -> ChunkResult:
         dtype = log_beta.dtype
         # Gamma buffers must exist in the carry before the first iteration
         # writes them; zeros are never read back (steps_done >= 1 whenever
         # the caller uses gammas).
         gamma0 = tuple(
-            jnp.zeros((w.shape[0], w.shape[1], k), dtype)
-            for w, _, _ in groups
+            jnp.zeros((g[0].shape[0], g[0].shape[1], k), dtype)
+            for g in groups
         )
         lls0 = jnp.zeros((chunk,), dtype)
 
@@ -192,4 +231,4 @@ def make_chunk_runner(
             log_beta, alpha, ll_prev, lls, step, converged, gammas
         )
 
-    return run_chunk
+    return jax.jit(run_chunk_impl, compiler_options=compiler_options)

@@ -406,6 +406,62 @@ class LDATrainer:
             gamma_out[b.doc_index[sel]] = g[sel]
         return log_beta, alpha, it
 
+    def _use_dense(self, batches) -> bool:
+        """Decide whether the fused loop runs the dense-corpus E-step
+        (ops/dense_estep.py).  Auto mode requires: a TPU backend, no mesh
+        (the dense kernel is not yet shard_map-wrapped), the stock E-step
+        (a custom e_step_fn must not be silently bypassed), VMEM-feasible
+        doc blocks for every batch shape, and the densified corpus under
+        the HBM budget."""
+        from ..ops import dense_estep
+
+        env = os.environ.get("ONI_ML_TPU_ESTEP", "")
+        mode = {"dense": "on", "xla": "off", "pallas": "off"}.get(
+            env, self.config.dense_em
+        )
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"LDAConfig.dense_em={mode!r}: expected 'auto', 'on', or "
+                "'off'"
+            )
+        if mode == "off":
+            return False
+        incompatible = (
+            "a mesh is set (the dense kernel is not shard_map-wrapped yet)"
+            if self.mesh is not None
+            else "a custom e_step_fn is installed"
+            if self._e_base is not estep.e_step
+            else None
+        )
+        if incompatible:
+            if mode == "on":
+                raise ValueError(f"dense E-step forced but {incompatible}")
+            return False
+        k, v = self.config.num_topics, self.num_terms
+        feasible = all(
+            dense_estep.pick_block(b.word_idx.shape[0], v, k) is not None
+            for b in batches
+        )
+        if mode == "on":
+            if not feasible:
+                raise ValueError(
+                    "dense E-step forced but a batch shape has no "
+                    f"VMEM-feasible doc block (V={v}, K={k})"
+                )
+            return True
+        # Peak device memory during densify_groups holds BOTH the sparse
+        # stacked arrays (scatter inputs) and the dense output, so budget
+        # the sum, not just the dense corpus.
+        sparse_bytes = sum(
+            b.word_idx.size * 8 for b in batches  # int32 idx + f32 counts
+        )
+        return (
+            feasible
+            and jax.default_backend() == "tpu"
+            and fused.dense_groups_bytes(batches, v) + sparse_bytes
+            <= self.config.dense_hbm_budget
+        )
+
     def _fused_loop(
         self, batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
         likelihoods, ll_file, progress, checkpoint_path, gamma_out,
@@ -431,6 +487,25 @@ class LDATrainer:
         groups = fused.stack_batches(
             batches, np.dtype(cfg.compute_dtype), put_stacked
         )
+        compiler_options = None
+        if self._use_dense(batches):
+            from ..ops import dense_estep
+
+            groups = fused.densify_groups(groups, self.num_terms)
+            # XLA drops the pallas kernel's own scoped-VMEM limit when the
+            # call is fusion-wrapped inside a stacked-group scan; forward
+            # the limit as a program-level compiler option instead.  The
+            # option only exists on the TPU compiler (CPU interpret runs
+            # have no VMEM to limit).
+            kibs = [
+                dense_estep.scoped_vmem_kib(b.word_idx.shape[0],
+                                            self.num_terms, k)
+                for b in batches
+            ]
+            if any(kibs) and jax.default_backend() == "tpu":
+                compiler_options = {
+                    "xla_tpu_scoped_vmem_limit_kib": str(max(filter(None, kibs)))
+                }
         run_chunk = fused.make_chunk_runner(
             num_docs=num_docs,
             num_topics=k,
@@ -442,6 +517,7 @@ class LDATrainer:
             estimate_alpha=cfg.estimate_alpha,
             e_step_fn=self._e_base,
             m_step_fn=self._m_base,
+            compiler_options=compiler_options,
         )
 
         ll_prev_dev = jnp.asarray(

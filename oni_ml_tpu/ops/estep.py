@@ -125,6 +125,24 @@ def suff_stats(phi_c: jnp.ndarray, word_idx: jnp.ndarray, num_segments: int):
     )
 
 
+def batch_likelihood_from_tok(gamma, tok_ll, alpha, doc_mask):
+    """ELBO from a precomputed per-doc token term (sum_l c*log(phinorm),
+    already masked) plus the gamma-dependent Dirichlet terms.  The dense
+    kernel computes tok_ll while C is VMEM-resident and hands it here."""
+    K = gamma.shape[-1]
+    e_lt = _e_log_theta(gamma)
+    doc_ll = (
+        gammaln(K * alpha)
+        - K * gammaln(alpha)
+        + ((alpha - gamma) * e_lt).sum(-1)
+        + gammaln(gamma).sum(-1)
+        - gammaln(gamma.sum(-1))
+    )
+    likelihood = (doc_ll * doc_mask).sum() + tok_ll.sum()
+    alpha_ss = (e_lt.sum(-1) * doc_mask).sum()
+    return likelihood, alpha_ss
+
+
 def batch_likelihood(gamma, phinorm, counts, alpha, doc_mask):
     """ELBO summed over real docs + alpha suff stats (sum E[log theta]).
 
@@ -132,19 +150,8 @@ def batch_likelihood(gamma, phinorm, counts, alpha, doc_mask):
     and the z-entropy; beta is a point estimate in lda-c so there is no
     beta-prior term (SURVEY §2.8).
     """
-    K = gamma.shape[-1]
-    e_lt = _e_log_theta(gamma)
-    doc_ll = (
-        (counts * jnp.log(phinorm)).sum(-1)
-        + gammaln(K * alpha)
-        - K * gammaln(alpha)
-        + ((alpha - gamma) * e_lt).sum(-1)
-        + gammaln(gamma).sum(-1)
-        - gammaln(gamma.sum(-1))
-    )
-    likelihood = (doc_ll * doc_mask).sum()
-    alpha_ss = (e_lt.sum(-1) * doc_mask).sum()
-    return likelihood, alpha_ss
+    tok_ll = (counts * jnp.log(phinorm)).sum(-1) * doc_mask
+    return batch_likelihood_from_tok(gamma, tok_ll, alpha, doc_mask)
 
 
 def e_step(
@@ -161,18 +168,57 @@ def e_step(
 
     backend: "auto" uses the Pallas VMEM-resident fixed point on TPU when
     the shapes admit it (ops/pallas_estep.py), else pure XLA; "xla" /
-    "pallas" force a path (ONI_ML_TPU_ESTEP env var overrides "auto").
+    "pallas" / "dense" force a path (ONI_ML_TPU_ESTEP env var overrides
+    "auto").  "dense" densifies the batch per call — drivers that own the
+    batches amortize the densification instead (models/fused.py).
     """
     import os
 
     if backend == "auto":
-        backend = os.environ.get("ONI_ML_TPU_ESTEP", "auto")
+        env = os.environ.get("ONI_ML_TPU_ESTEP", "auto")
+        # "dense" in the env is a DRIVER-level hint (models/lda.py picks it
+        # up in _use_dense, where the densification is amortized across the
+        # run).  Honoring it per call here would re-scatter the batch every
+        # EM iteration — the exact cost the dense path exists to avoid —
+        # so auto dispatch ignores it; only an explicit backend="dense"
+        # argument densifies inline.
+        backend = "auto" if env == "dense" else env
+    if backend not in ("auto", "xla", "pallas", "dense"):
+        raise ValueError(
+            f"unknown E-step backend {backend!r} (set via ONI_ML_TPU_ESTEP "
+            "or the backend= argument); expected auto, xla, pallas, or dense"
+        )
+    if backend == "dense":
+        from . import dense_estep
+
+        b = word_idx.shape[0]
+        k, v = log_beta.shape
+        if dense_estep.pick_block(b, v, k) is None:
+            raise ValueError(
+                f"dense E-step forced but B={b}, V={v}, K={k} has no "
+                "VMEM-feasible doc block (unset ONI_ML_TPU_ESTEP=dense "
+                "or reduce the batch/vocab size)"
+            )
+        dense = dense_estep.densify(word_idx, counts, v)
+        return dense_estep.e_step_dense(
+            log_beta, alpha, dense, doc_mask, var_max_iters, var_tol,
+            interpret=jax.default_backend() != "tpu",
+        )
     if backend != "xla":
         from . import pallas_estep
 
         b, l = word_idx.shape
-        eligible = pallas_estep.available(b, l, log_beta.shape[0])
-        if backend == "pallas" or eligible:
+        if backend == "pallas" and (
+            pallas_estep.pick_block(b, l, log_beta.shape[0]) is None
+        ):
+            raise ValueError(
+                f"pallas E-step forced but B={b}, L={l}, "
+                f"K={log_beta.shape[0]} has no VMEM-feasible doc block "
+                "(unset ONI_ML_TPU_ESTEP=pallas or reduce the batch)"
+            )
+        if backend == "pallas" or pallas_estep.available(
+            b, l, log_beta.shape[0]
+        ):
             return pallas_estep.e_step(
                 log_beta, alpha, word_idx, counts, doc_mask,
                 var_max_iters, var_tol,

@@ -1,0 +1,315 @@
+"""Dense-corpus E-step (ops/dense_estep.py) vs the sparse reference path.
+
+The dense kernel must reproduce estep.e_step exactly up to float32
+reassociation: same fixed point, same convergence rule, same ELBO and
+suff-stats semantics.  Runs in Pallas interpret mode on the CPU backend
+(tests/conftest.py), mirroring how test_pallas_estep.py validates the
+sparse kernel.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from oni_ml_tpu.config import LDAConfig
+from oni_ml_tpu.io import Batch
+from oni_ml_tpu.models import fused
+from oni_ml_tpu.ops import dense_estep, estep
+
+
+def _random_batch(rng, b, l, v, n_masked=0):
+    word_idx = rng.integers(0, v, size=(b, l)).astype(np.int32)
+    counts = rng.integers(1, 5, size=(b, l)).astype(np.float32)
+    # Ragged tail: zero-count padding tokens on some docs.
+    for i in range(b // 3):
+        pad = rng.integers(1, l)
+        word_idx[i, pad:] = 0
+        counts[i, pad:] = 0.0
+    doc_mask = np.ones((b,), np.float32)
+    if n_masked:
+        doc_mask[-n_masked:] = 0.0
+        counts[-n_masked:] = 0.0
+        word_idx[-n_masked:] = 0
+    return (
+        jnp.asarray(word_idx),
+        jnp.asarray(counts),
+        jnp.asarray(doc_mask),
+    )
+
+
+def _log_beta(rng, k, v):
+    noise = rng.uniform(size=(k, v)) + 1.0 / v
+    return jnp.asarray(
+        np.log(noise / noise.sum(-1, keepdims=True)), jnp.float32
+    )
+
+
+def test_densify_matches_loop():
+    rng = np.random.default_rng(0)
+    b, l, v = 8, 16, 50
+    word_idx, counts, _ = _random_batch(rng, b, l, v)
+    dense = np.asarray(dense_estep.densify(word_idx, counts, v))
+    assert dense.shape == (b, dense_estep.padded_width(v))
+    expect = np.zeros((b, v), np.float32)
+    for i in range(b):
+        for j in range(l):
+            expect[i, int(word_idx[i, j])] += float(counts[i, j])
+    np.testing.assert_allclose(dense[:, :v], expect, rtol=0, atol=0)
+    assert dense[:, v:].sum() == 0.0
+
+
+@pytest.mark.parametrize(
+    "b,l,v,k,n_masked",
+    [(16, 32, 300, 4, 0), (32, 16, 130, 7, 5), (8, 8, 128, 3, 2)],
+)
+def test_dense_parity_vs_xla(b, l, v, k, n_masked):
+    rng = np.random.default_rng(b * 1000 + v)
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked)
+    log_beta = _log_beta(rng, k, v)
+    alpha = jnp.float32(2.5)
+
+    ref = estep.e_step(
+        log_beta, alpha, word_idx, counts, doc_mask,
+        var_max_iters=20, var_tol=1e-6, backend="xla",
+    )
+    dense = dense_estep.densify(word_idx, counts, v)
+    got = dense_estep.e_step_dense(
+        log_beta, alpha, dense, doc_mask,
+        var_max_iters=20, var_tol=1e-6, interpret=True,
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(got.gamma), np.asarray(ref.gamma), rtol=2e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.suff_stats), np.asarray(ref.suff_stats),
+        rtol=2e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(got.likelihood), float(ref.likelihood), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(got.alpha_ss), float(ref.alpha_ss), rtol=1e-4
+    )
+
+
+def test_masked_docs_are_inert():
+    """A masked doc must contribute nothing to suff stats / likelihood and
+    converge to gamma = alpha (its dense row is all zeros)."""
+    rng = np.random.default_rng(3)
+    b, l, v, k = 8, 8, 140, 3
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked=3)
+    log_beta = _log_beta(rng, k, v)
+    dense = dense_estep.densify(word_idx, counts, v)
+    got = dense_estep.e_step_dense(
+        log_beta, jnp.float32(1.5), dense, doc_mask,
+        var_max_iters=10, var_tol=1e-6, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.gamma)[-3:], 1.5, rtol=1e-6
+    )
+
+
+def test_backend_dispatch():
+    rng = np.random.default_rng(7)
+    b, l, v, k = 8, 8, 140, 3
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v)
+    log_beta = _log_beta(rng, k, v)
+    ref = estep.e_step(
+        log_beta, 2.5, word_idx, counts, doc_mask,
+        var_max_iters=10, var_tol=1e-6, backend="xla",
+    )
+    got = estep.e_step(
+        log_beta, 2.5, word_idx, counts, doc_mask,
+        var_max_iters=10, var_tol=1e-6, backend="dense",
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.gamma), np.asarray(ref.gamma), rtol=2e-3, atol=1e-3
+    )
+
+    with pytest.raises(ValueError, match="unknown E-step backend"):
+        estep.e_step(
+            log_beta, 2.5, word_idx, counts, doc_mask,
+            var_max_iters=10, var_tol=1e-6, backend="palas",
+        )
+    # Forced dense on an infeasible shape names the problem.
+    with pytest.raises(ValueError, match="dense E-step forced"):
+        estep.e_step(
+            _log_beta(rng, 3, 7), 2.5,
+            word_idx[:5], counts[:5], doc_mask[:5],
+            var_max_iters=10, var_tol=1e-6, backend="dense",
+        )
+
+
+def test_fused_runner_dense_groups_match_sparse():
+    """The fused chunk runner must produce the same EM trajectory from
+    densified groups as from sparse groups."""
+    rng = np.random.default_rng(11)
+    b, l, v, k = 16, 16, 260, 4
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked=2)
+    log_beta = _log_beta(rng, k, v)
+    alpha = jnp.float32(2.5)
+
+    sparse_groups = (
+        (word_idx[None], counts[None], doc_mask[None]),
+    )
+    dense = dense_estep.densify(word_idx, counts, v)
+    dense_groups = ((dense[None], doc_mask[None]),)
+
+    run = fused.make_chunk_runner(
+        num_docs=b - 2, num_topics=k, num_terms=v, chunk=4,
+        var_max_iters=20, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
+    )
+    r_sparse = run(log_beta, alpha, jnp.float32(np.nan), sparse_groups, 4)
+    r_dense = run(log_beta, alpha, jnp.float32(np.nan), dense_groups, 4)
+
+    np.testing.assert_allclose(
+        np.asarray(r_dense.lls), np.asarray(r_sparse.lls), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_dense.log_beta), np.asarray(r_sparse.log_beta),
+        rtol=5e-3, atol=5e-3,
+    )
+    np.testing.assert_allclose(
+        float(r_dense.alpha), float(r_sparse.alpha), rtol=1e-3
+    )
+
+
+def test_trainer_dense_mode_matches_sparse():
+    """LDATrainer end-to-end with dense_em='on' vs 'off' on a tiny corpus."""
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    rng = np.random.default_rng(5)
+    b, l, v = 16, 16, 200
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked=2)
+    batch = Batch(
+        word_idx=np.asarray(word_idx),
+        counts=np.asarray(counts),
+        doc_mask=np.asarray(doc_mask),
+        doc_index=np.arange(b),
+    )
+    results = {}
+    for mode in ("on", "off"):
+        cfg = LDAConfig(
+            num_topics=4, em_max_iters=6, em_tol=0.0,
+            var_max_iters=20, fused_em_chunk=3, seed=1, dense_em=mode,
+        )
+        trainer = LDATrainer(cfg, num_terms=v)
+        results[mode] = trainer.fit([batch], num_docs=b - 2)
+
+    on, off = results["on"], results["off"]
+    np.testing.assert_allclose(on.log_beta, off.log_beta, rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(
+        [ll for ll, _ in on.likelihoods],
+        [ll for ll, _ in off.likelihoods],
+        rtol=1e-4,
+    )
+
+
+def test_explicit_block_must_divide_batch():
+    rng = np.random.default_rng(2)
+    b, l, v, k = 16, 8, 140, 3
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v)
+    dense = dense_estep.densify(word_idx, counts, v)
+    with pytest.raises(ValueError, match="does not divide"):
+        dense_estep.e_step_dense(
+            _log_beta(rng, k, v), 2.5, dense, doc_mask,
+            var_max_iters=5, var_tol=1e-6, block=12, interpret=True,
+        )
+
+
+def test_dense_em_typo_raises():
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    trainer = LDATrainer(
+        LDAConfig(num_topics=4, dense_em="true"), num_terms=200
+    )
+    batch = Batch(
+        word_idx=np.zeros((16, 8), np.int32),
+        counts=np.zeros((16, 8), np.float32),
+        doc_mask=np.ones((16,), np.float32),
+        doc_index=np.arange(16),
+    )
+    with pytest.raises(ValueError, match="dense_em"):
+        trainer._use_dense([batch])
+
+
+def test_forced_dense_with_mesh_raises():
+    from oni_ml_tpu.models.lda import LDATrainer
+    from oni_ml_tpu.parallel import make_mesh
+
+    mesh = make_mesh(data=2, model=1)
+    trainer = LDATrainer(
+        LDAConfig(num_topics=4, dense_em="on"), num_terms=200, mesh=mesh
+    )
+    batch = Batch(
+        word_idx=np.zeros((16, 8), np.int32),
+        counts=np.zeros((16, 8), np.float32),
+        doc_mask=np.ones((16,), np.float32),
+        doc_index=np.arange(16),
+    )
+    with pytest.raises(ValueError, match="mesh"):
+        trainer._use_dense([batch])
+
+
+def test_env_dense_does_not_leak_into_auto_dispatch(monkeypatch):
+    """ONI_ML_TPU_ESTEP=dense is a driver hint; per-call e_step auto must
+    not densify inline (that would re-scatter every EM iteration) and must
+    not raise on shapes the dense path can't block."""
+    monkeypatch.setenv("ONI_ML_TPU_ESTEP", "dense")
+    rng = np.random.default_rng(9)
+    # B=5 has no feasible dense block (not divisible by 8): auto dispatch
+    # must still succeed via the sparse paths.
+    word_idx, counts, doc_mask = _random_batch(rng, 5, 8, 60, 0)
+    log_beta = _log_beta(rng, 3, 60)
+    res = estep.e_step(
+        log_beta, 2.5, word_idx, counts, doc_mask,
+        var_max_iters=5, var_tol=1e-6,
+    )
+    ref = estep.e_step(
+        log_beta, 2.5, word_idx, counts, doc_mask,
+        var_max_iters=5, var_tol=1e-6, backend="xla",
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.gamma), np.asarray(ref.gamma), rtol=1e-5
+    )
+
+
+def test_scoped_vmem_kib_and_multibatch_groups():
+    """The scoped-VMEM compiler option must be computable for feasible
+    shapes (XLA drops the kernel's own limit inside stacked-group scans)
+    and the fused runner must accept stacked NB>=2 dense groups."""
+    kib = dense_estep.scoped_vmem_kib(1024, 13530, 20)
+    assert kib is not None and kib >= 32 * 1024
+    assert dense_estep.scoped_vmem_kib(5, 100, 4) is None  # infeasible
+
+    rng = np.random.default_rng(21)
+    b, l, v, k = 16, 8, 140, 3
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v)
+    dense = dense_estep.densify(word_idx, counts, v)
+    groups = ((jnp.stack([dense, dense]), jnp.stack([doc_mask, doc_mask])),)
+    # The xla_tpu_* option itself only exists on the TPU compiler; on the
+    # CPU test backend exercise the plumbing with a portable no-op option.
+    run = fused.make_chunk_runner(
+        num_docs=2 * b, num_topics=k, num_terms=v, chunk=2,
+        var_max_iters=5, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
+        compiler_options={},
+    )
+    res = run(_log_beta(rng, k, v), jnp.float32(2.5), jnp.float32(np.nan),
+              groups, 2)
+    assert np.isfinite(float(res.lls[-1]))
+
+
+def test_use_dense_auto_is_off_on_cpu():
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    cfg = LDAConfig(num_topics=4, dense_em="auto")
+    trainer = LDATrainer(cfg, num_terms=200)
+    batch = Batch(
+        word_idx=np.zeros((16, 8), np.int32),
+        counts=np.zeros((16, 8), np.float32),
+        doc_mask=np.ones((16,), np.float32),
+        doc_index=np.arange(16),
+    )
+    assert trainer._use_dense([batch]) is False  # CPU backend in tests
