@@ -51,14 +51,24 @@ struct Interner {
   }
 };
 
+// ASCII whitespace exactly (' ', '\t', '\n', '\v', '\f', '\r').  NOT
+// std::isspace: that is LC_CTYPE-locale-dependent (e.g. 0xA0 counts as
+// space under a Latin-1 locale), which would make featurization depend
+// on the host environment.  CPython's float() additionally strips some
+// unicode spaces (U+0085/U+00A0...) — a documented divergence
+// (flow_featurize.cpp header), same class as underscored numerals.
+inline bool ascii_space(char c) {
+  return c == ' ' || (c >= '\t' && c <= '\r');
+}
+
 // Python float(): trimmed token, optional '+', decimal/exponent/inf/nan;
 // out-of-range saturates to +-inf / +-0.0; anything else -> NaN.
 // The saturation fallback pins LC_NUMERIC to "C" so a host process with
 // a different locale can't change how the digits parse.
 inline double to_double(std::string_view s) {
   size_t b = 0, e = s.size();
-  while (b < e && std::isspace((unsigned char)s[b])) b++;
-  while (e > b && std::isspace((unsigned char)s[e - 1])) e--;
+  while (b < e && ascii_space(s[b])) b++;
+  while (e > b && ascii_space(s[e - 1])) e--;
   if (b == e) return NAN;
   std::string_view t = s.substr(b, e - b);
   if (t[0] == '+') t.remove_prefix(1);

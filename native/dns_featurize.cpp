@@ -121,6 +121,12 @@ struct Dfz {
   Interner ips, domains, subdomains, qtypes, qrcodes;
   std::vector<int32_t> ip_id, dom_id, sub_id, qtype_id, qrcode_id;
   int64_t num_raw = -1;
+  // A CSV-sourced field containing the \x1f transport separator would
+  // split into extra columns when the stored rows blob is re-split on
+  // the Python side; flag it so the caller can discard this handle and
+  // re-run through the pure-Python path instead of emitting misaligned
+  // results rows.
+  bool unsafe = false;
 
   // finish() outputs
   std::vector<int32_t> top;
@@ -182,6 +188,8 @@ struct Dfz {
 
   // Split a line on `sep`; keep iff exactly 8 fields.
   void add_line(std::string_view line, char sep) {
+    if (sep != SEP && line.find(SEP) != std::string_view::npos)
+      unsafe = true;
     std::string_view f[NCOLS];
     int nf = 0;
     size_t start = 0;
@@ -242,6 +250,8 @@ int64_t dfz_ingest_rows(void* hv, const char* buf, int64_t len) {
   h->ingest(buf, len, SEP, /*skip_empty=*/true);
   return (int64_t)h->tstamp_.size();
 }
+
+int dfz_unsafe(void* hv) { return ((Dfz*)hv)->unsafe ? 1 : 0; }
 
 void dfz_mark_raw(void* hv) {
   Dfz* h = (Dfz*)hv;

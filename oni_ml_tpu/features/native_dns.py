@@ -45,6 +45,8 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
     ]
     lib.dfz_mark_raw.argtypes = [ctypes.c_void_p]
+    lib.dfz_unsafe.restype = ctypes.c_int
+    lib.dfz_unsafe.argtypes = [ctypes.c_void_p]
     for fn in ("dfz_num_raw", "dfz_num_events", "dfz_rows_blob_len",
                "dfz_wc_len"):
         getattr(lib, fn).restype = ctypes.c_int64
@@ -227,7 +229,11 @@ def _featurize_native(
     sources: Sequence,
     feedback_rows: Sequence[Sequence[str]],
     top_domains: frozenset,
-) -> NativeDnsFeatures:
+) -> "NativeDnsFeatures | None":
+    """Run the native featurizer; returns None when ingest saw a CSV
+    field embedding the \\x1f transport separator (the stored rows blob
+    would re-split into misaligned columns) — the caller falls back to
+    the Python path for the whole run."""
     h = lib.dfz_create()
     try:
         for src in sources:
@@ -239,6 +245,8 @@ def _featurize_native(
             elif src:
                 blob = _rows_to_blob(src)
                 lib.dfz_ingest_rows(h, blob, len(blob))
+        if lib.dfz_unsafe(h):
+            return None
         lib.dfz_mark_raw(h)
         if feedback_rows:
             blob = _rows_to_blob(feedback_rows)
@@ -320,16 +328,21 @@ def featurize_dns_sources(
     assignment (the words.dat/doc.dat line-number contract) and the
     results row order depend on it.
 
-    Pre-projected rows whose fields embed the transport bytes ('\\n' or
-    '\\x1f' — possible in raw wire query names, and in security telemetry
-    the weird names ARE the signal) cannot ride the native blob without
-    corruption, so their presence routes the whole run through the
-    Python path instead of silently dropping events.
+    Pre-projected rows whose fields embed the transport bytes ('\\n',
+    '\\x1f', or '\\r' — possible in raw wire query names, and in security
+    telemetry the weird names ARE the signal) cannot ride the native
+    blob without corruption ('\\r' because ingest's CRLF handling strips
+    a field-final CR), so their presence routes the whole run through
+    the Python path instead of silently dropping events.  CSV files can
+    likewise embed '\\x1f' inside a field; native ingest detects that
+    and the run falls back the same way.
     """
 
     def _unsafe(rows) -> bool:
         return any(
-            "\n" in field or _SEP in field for row in rows for field in row
+            "\n" in field or _SEP in field or "\r" in field
+            for row in rows
+            for field in row
         )
 
     lib = _LIB.load()
@@ -337,7 +350,9 @@ def featurize_dns_sources(
         _unsafe(src) for src in (*sources, feedback_rows)
         if not isinstance(src, str)
     ):
-        return _featurize_native(lib, sources, feedback_rows, top_domains)
+        feats = _featurize_native(lib, sources, feedback_rows, top_domains)
+        if feats is not None:
+            return feats
     rows: list[list[str]] = []
     for src in sources:
         if isinstance(src, str):

@@ -11,9 +11,11 @@ M-step, Newton alpha, convergence check — runs inside ONE compiled
 program as a `lax.while_loop`, executing up to `chunk` EM iterations
 before returning control.  The host only syncs at chunk boundaries to
 stream `likelihood.dat`, fire progress callbacks, and checkpoint; the
-convergence decision itself is made on device so a run that converges
-mid-chunk stops immediately (the reference's `|Δℓ/ℓ| < em_tol` semantics,
-SURVEY.md §2.8, evaluated in compute dtype instead of host float64).
+convergence decision is made on device so a run that converges mid-chunk
+stops immediately (the reference's `|Δℓ/ℓ| < em_tol` semantics, SURVEY.md
+§2.8, evaluated in compute dtype); at each chunk boundary the driver
+(lda.py _fused_loop) re-derives conv in float64 and that value is
+authoritative, so the final stop always agrees with likelihood.dat.
 
 Batches are grouped by (B, L) shape and stacked [NB, B, L] so each group
 is one `lax.scan`; bucketed batching (io/corpus.py) produces few distinct

@@ -467,8 +467,13 @@ class LDATrainer:
         likelihoods, ll_file, progress, checkpoint_path, gamma_out,
     ):
         """Device-resident EM (models/fused.py): up to fused_em_chunk
-        iterations per compiled call, convergence checked on device in
-        compute dtype; the host logs / checkpoints at chunk boundaries."""
+        iterations per compiled call.  The device checks convergence in
+        compute dtype to stop mid-chunk; the host re-derives conv in
+        float64 at chunk boundaries (_log_iteration) and that value is
+        authoritative — a device stop that float64 disagrees with (the
+        ~1-ulp |Δll/ll| boundary) resumes, so the stop decision always
+        matches the conv written to likelihood.dat and the stepwise
+        driver's float64 semantics."""
         cfg = self.config
         k = cfg.num_topics
         dtype = jnp.dtype(cfg.compute_dtype)
@@ -537,17 +542,24 @@ class LDATrainer:
             )
             log_beta, alpha, ll_prev_dev = res.log_beta, res.alpha, res.ll_prev
             steps = int(res.steps_done)
+            host_conv = None
             for ll in np.asarray(res.lls[:steps], np.float64):
                 it += 1
                 ll = float(ll)
-                self._log_iteration(
+                host_conv = self._log_iteration(
                     it, ll, ll_prev, likelihoods, ll_file, progress
                 )
                 ll_prev = ll
             self._maybe_checkpoint(
                 checkpoint_path, log_beta, alpha, it, likelihoods
             )
-            if bool(res.converged) or steps == 0:
+            if steps == 0:
+                break
+            # float64 conv (what likelihood.dat records) decides the stop;
+            # res.converged only ends a chunk early.  Near em_tol the
+            # compute-dtype device check can disagree by ~1 ulp — if it
+            # stopped but float64 says not converged, keep iterating.
+            if host_conv is not None and host_conv < cfg.em_tol:
                 break
 
         if res is not None and int(res.steps_done) > 0:

@@ -151,3 +151,37 @@ def test_rows_with_transport_bytes_fall_back(tmp_path):
     assert isinstance(feats, pydns.DnsFeatures)
     assert feats.num_events == 1
     assert feats.rows[0][4] == "evil\nname.example.com"
+
+
+def test_rows_with_carriage_return_fall_back():
+    # Native ingest's CRLF handling strips a field-final '\r' from the
+    # blob; such rows must route through the Python path unaltered.
+    weird = [["t", "1454000000", "60", "10.9.9.1",
+              "evil\rname.example.com\r", "1", "1", "0"]]
+    feats = native_dns.featurize_dns_sources([weird])
+    assert isinstance(feats, pydns.DnsFeatures)
+    assert feats.num_events == 1
+    assert feats.rows[0][4] == "evil\rname.example.com\r"
+
+
+def test_csv_with_separator_byte_falls_back(tmp_path):
+    # A CSV field embedding the '\x1f' transport separator would split
+    # into extra columns when the native rows blob is re-split; ingest
+    # flags it and the whole run re-runs through the Python path.
+    qname = "evil\x1fname.example.com"
+    rows = [
+        ["t", "1454000000", "60", "10.9.9.1", qname, "1", "1", "0"],
+        ["t", "1454000060", "70", "10.9.9.2", "ok.example.com", "1", "1",
+         "0"],
+    ]
+    path = tmp_path / "dns.csv"
+    path.write_text("\n".join(",".join(r) for r in rows) + "\n")
+    feats = native_dns.featurize_dns_sources([str(path)], top_domains=TOP)
+    assert isinstance(feats, pydns.DnsFeatures)
+    assert feats.num_events == 2
+    assert feats.rows[0][4] == qname
+    # The clean-file path still takes the native engine.
+    clean = tmp_path / "clean.csv"
+    clean.write_text(",".join(rows[1]) + "\n")
+    feats2 = native_dns.featurize_dns_sources([str(clean)], top_domains=TOP)
+    assert isinstance(feats2, native_dns.NativeDnsFeatures)

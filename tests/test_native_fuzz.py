@@ -17,7 +17,7 @@ from oni_ml_tpu.features import native_dns, native_flow
 
 
 def _rand_token(rng) -> str:
-    kind = rng.integers(0, 7)
+    kind = rng.integers(0, 8)
     if kind == 0:
         return ""
     if kind == 1:
@@ -30,6 +30,13 @@ def _rand_token(rng) -> str:
         return rng.choice(["nan", "inf", "-inf", "1e999", "1e-999", "+5"])
     if kind == 5:
         return "x" * int(rng.integers(1, 8))
+    if kind == 6:
+        # str(float) fixed/scientific boundary magnitudes (exponent in
+        # [-4, 16) prints fixed; outside prints "1e+16"-style).
+        return rng.choice([
+            "1e15", "1e16", "-1e16", "9999999999999998", "1e-4", "0.0001",
+            "0.00001", "2.5e-5", "123456789012345678", "1e100",
+        ])
     return " " + str(rng.integers(0, 99)) + " "
 
 
