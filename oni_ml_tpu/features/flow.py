@@ -216,7 +216,8 @@ def featurize_flow(
     ibyt = np.array([_to_double(r[c["ibyt"]]) for r in rows])
     col10 = np.array([_to_double(r[c["sport"]]) for r in rows])
     col11 = np.array([_to_double(r[c["dport"]]) for r in rows])
-    num_time = hour + minute / 60.0 + second / 3600.0
+    with np.errstate(invalid="ignore"):  # garbage rows carry NaN by design
+        num_time = hour + minute / 60.0 + second / 3600.0
 
     if precomputed_cuts is not None:
         time_cuts, ibyt_cuts, ipkt_cuts = (

@@ -1,0 +1,38 @@
+"""Streaming raw-line reader shared by the featurizer fallback paths.
+
+Matches the native ingest's line semantics exactly (native_src/common.h
+stream_file + the featurizers' ingest): rows end at '\n' with ONE
+optional preceding '\r' stripped.  Deliberately NOT Python universal
+newlines — an embedded lone '\r' is a legal byte in a hostile DNS query
+name (in security telemetry the weird names ARE the signal) and must
+stay inside its field, not split the row.  Reads in bounded chunks so a
+multi-GB day file never materializes in memory.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+
+def iter_raw_lines(path: str, chunk_size: int = 1 << 22) -> Iterator[str]:
+    """Yield decoded lines of `path` without their '\n' terminator,
+    stripping one trailing '\r' per line (CRLF); empty lines included
+    (callers filter), no terminator on the final line required."""
+    with open(path, "rb") as f:
+        pending = b""
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            pending += chunk
+            if b"\n" not in chunk:
+                continue
+            *lines, pending = pending.split(b"\n")
+            for ln in lines:
+                if ln.endswith(b"\r"):
+                    ln = ln[:-1]
+                yield ln.decode("utf-8")
+        if pending:
+            if pending.endswith(b"\r"):
+                pending = pending[:-1]
+            yield pending.decode("utf-8")

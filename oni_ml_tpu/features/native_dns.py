@@ -1,4 +1,4 @@
-"""ctypes binding for the native DNS featurizer (native/dns_featurize.cpp).
+"""ctypes binding for the native DNS featurizer (oni_ml_tpu/native_src/dns_featurize.cpp).
 
 ``featurize_dns_sources`` is the production entry point for the DNS pre
 stage: CSV files stream straight through C++; parquet files (and
@@ -86,13 +86,13 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 _LIB = NativeLib(
     os.path.join(
-        os.path.dirname(__file__), "..", "..", "native", "dns_featurize.cpp"
+        os.path.dirname(__file__), "..", "native_src", "dns_featurize.cpp"
     ),
     os.path.join(os.path.dirname(__file__), "_native", "liboni_dns.so"),
     _configure,
     deps=(
         os.path.join(
-            os.path.dirname(__file__), "..", "..", "native", "common.h"
+            os.path.dirname(__file__), "..", "native_src", "common.h"
         ),
     ),
 )
@@ -353,14 +353,14 @@ def featurize_dns_sources(
         feats = _featurize_native(lib, sources, feedback_rows, top_domains)
         if feats is not None:
             return feats
+    from .lineio import iter_raw_lines
+
     rows: list[list[str]] = []
     for src in sources:
         if isinstance(src, str):
-            with open(src) as f:
-                for line in f:
-                    line = line.rstrip("\r\n")  # CRLF-safe like the C++ path
-                    if line:
-                        rows.append(line.split(","))
+            rows.extend(
+                line.split(",") for line in iter_raw_lines(src) if line
+            )
         else:
             rows.extend(list(r) for r in src)
     return featurize_dns(

@@ -1,4 +1,4 @@
-"""ctypes binding for the native flow featurizer (native/flow_featurize.cpp).
+"""ctypes binding for the native flow featurizer (oni_ml_tpu/native_src/flow_featurize.cpp).
 
 ``featurize_flow_file`` is the production entry point for the flow pre
 stage: it runs the parse/word-build/word-count passes in C++ when the
@@ -83,13 +83,13 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 _LIB = NativeLib(
     os.path.join(
-        os.path.dirname(__file__), "..", "..", "native", "flow_featurize.cpp"
+        os.path.dirname(__file__), "..", "native_src", "flow_featurize.cpp"
     ),
     os.path.join(os.path.dirname(__file__), "_native", "liboni_flow.so"),
     _configure,
     deps=(
         os.path.join(
-            os.path.dirname(__file__), "..", "..", "native", "common.h"
+            os.path.dirname(__file__), "..", "native_src", "common.h"
         ),
     ),
 )
@@ -311,9 +311,10 @@ def featurize_flow_file(
     lib = _LIB.load()
     if lib is not None:
         return _featurize_native(lib, path, feedback_rows, precomputed_cuts)
-    with open(path) as f:
-        return featurize_flow(
-            (line.rstrip("\n") for line in f),
-            feedback_rows=feedback_rows,
-            precomputed_cuts=precomputed_cuts,
-        )
+    from .lineio import iter_raw_lines
+
+    return featurize_flow(
+        iter_raw_lines(path),
+        feedback_rows=feedback_rows,
+        precomputed_cuts=precomputed_cuts,
+    )

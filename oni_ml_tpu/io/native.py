@@ -1,4 +1,4 @@
-"""ctypes binding for the native corpus ingest (native/corpus_ingest.cpp).
+"""ctypes binding for the native corpus ingest (oni_ml_tpu/native_src/corpus_ingest.cpp).
 
 The reference's corpus build (lda_pre.py, SURVEY.md §2.4) is three
 sequential Python passes over the day's word counts — its single-node
@@ -50,7 +50,7 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 _LIB = NativeLib(
     os.path.join(
-        os.path.dirname(__file__), "..", "..", "native", "corpus_ingest.cpp"
+        os.path.dirname(__file__), "..", "native_src", "corpus_ingest.cpp"
     ),
     os.path.join(os.path.dirname(__file__), "_native", "liboni_ingest.so"),
     _configure,

@@ -1,0 +1,89 @@
+"""Model-quality evaluation: held-out per-token log-likelihood.
+
+The reference's only quality signal is the training-set convergence log
+(likelihood.dat, README.md:119) — it never measures generalization.
+This module adds the standard document-completion protocol from the
+online-LDA literature (Hoffman, Blei, Bach, NIPS 2010 — see PAPERS.md):
+for each held-out document, condition on half its tokens (even slots),
+infer the doc-topic posterior gamma from that half only, then score the
+unseen half's tokens under the predictive distribution
+
+    p(w | w_obs) = sum_k  E[theta_k | gamma(w_obs)] * E[beta_kw]
+
+and report  sum(count * log p) / sum(count)  over the held-out halves —
+a per-token score comparable across corpus sizes, batch vs online
+trainers, and hyperparameters (higher is better; exp(-score) is the
+perplexity).
+
+Works on any point-estimate topics in the final.beta contract (log
+p(w|topic) rows, LOG_ZERO floor): the batch trainer's log_beta, the
+online trainer's log E_q[beta], or a final.beta file read back via
+io.formats.  Evaluation is cheap relative to training, so it runs
+unsharded on the default device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io import Batch
+from ..ops import estep
+
+
+@partial(jax.jit, static_argnames=("var_max_iters",))
+def _batch_held_out(log_beta, alpha, word_idx, counts, doc_mask,
+                    var_max_iters, var_tol):
+    """One padded batch -> (sum log p over held-out tokens, token count).
+
+    Token slots split deterministically by position parity: even slots
+    are observed, odd slots held out.  Bucketed batches store one unique
+    word per slot, so the split is over a doc's distinct words; padding
+    slots carry count 0 and drop out of both halves.
+    """
+    pos = jnp.arange(word_idx.shape[1])
+    obs = counts * (pos % 2 == 0)
+    ho = counts * (pos % 2 == 1)
+    res = estep.e_step(
+        log_beta, alpha, word_idx, obs, doc_mask,
+        var_max_iters=var_max_iters, var_tol=var_tol, backend="xla",
+    )
+    theta = res.gamma / res.gamma.sum(-1, keepdims=True)
+    beta_bt = estep.gather_beta(log_beta, word_idx)  # [B, L, K] probabilities
+    p = jnp.einsum("bk,blk->bl", theta, beta_bt)
+    ll = (ho * jnp.log(jnp.maximum(p, 1e-300))).sum(-1) * doc_mask
+    return ll.sum(), (ho.sum(-1) * doc_mask).sum()
+
+
+def held_out_per_token_ll(
+    log_beta: np.ndarray,
+    alpha: float,
+    batches: Sequence[Batch],
+    var_max_iters: int = 20,
+    var_tol: float = 1e-6,
+) -> float:
+    """Held-out per-token log-likelihood of `batches` under the topics.
+
+    `batches` must be documents the model was NOT trained on (or the
+    score is optimistic); make them with io.make_batches over a held-out
+    corpus split.
+    """
+    log_beta = jnp.asarray(log_beta, jnp.float32)
+    alpha_dev = jnp.asarray(alpha, log_beta.dtype)
+    total_ll = 0.0
+    total_tok = 0.0
+    for b in batches:
+        ll, tok = _batch_held_out(
+            log_beta, alpha_dev,
+            jnp.asarray(b.word_idx),
+            jnp.asarray(b.counts, log_beta.dtype),
+            jnp.asarray(b.doc_mask, log_beta.dtype),
+            var_max_iters, var_tol,
+        )
+        total_ll += float(ll)
+        total_tok += float(tok)
+    return total_ll / max(total_tok, 1.0)

@@ -41,6 +41,47 @@ from ..ops.estep import e_log_dirichlet as expected_log_beta
 from .lda import LDAResult
 
 
+def save_stream_checkpoint(
+    path: str,
+    lam: np.ndarray,
+    alpha: float,
+    step: int,
+    history: list[tuple[float, float]],
+) -> None:
+    """Atomic streaming checkpoint with SVI-native field names: `lam`
+    (the variational Dirichlet posterior over topics — NOT a log beta),
+    `step` (micro-batch count), `history` rows of (likelihood, rho).
+    Early revisions smuggled these through the batch checkpoint's
+    log_beta/em_iter/likelihoods fields; load_stream_checkpoint still
+    reads that layout."""
+    tmp = path + ".tmp.npz"  # savez appends nothing to an .npz name
+    np.savez(
+        tmp,
+        lam=np.asarray(lam),
+        alpha=np.float64(alpha),
+        step=np.int64(step),
+        history=np.asarray(history, np.float64).reshape(-1, 2),
+    )
+    os.replace(tmp, path)
+
+
+def load_stream_checkpoint(path: str) -> dict:
+    with np.load(path) as z:
+        if "lam" in z.files:
+            return {
+                "lam": z["lam"],
+                "alpha": float(z["alpha"]),
+                "step": int(z["step"]),
+                "history": [tuple(row) for row in z["history"]],
+            }
+        return {  # legacy layout (batch-checkpoint field names)
+            "lam": z["log_beta"],
+            "alpha": float(z["alpha"]),
+            "step": int(z["em_iter"]),
+            "history": [tuple(row) for row in z["likelihoods"]],
+        }
+
+
 @dataclass
 class StreamStepInfo:
     step: int
@@ -110,20 +151,18 @@ class OnlineLDATrainer:
         ) / 100.0
         self._alpha = jnp.asarray(config.alpha, dtype)
         if checkpoint_path is not None and os.path.exists(checkpoint_path):
-            from .lda import load_checkpoint
-
-            ckpt = load_checkpoint(checkpoint_path)
-            if ckpt["log_beta"].shape != self._lam.shape:
+            ckpt = load_stream_checkpoint(checkpoint_path)
+            if ckpt["lam"].shape != self._lam.shape:
                 raise ValueError(
-                    f"checkpoint lambda shape {ckpt['log_beta'].shape} does "
+                    f"checkpoint lambda shape {ckpt['lam'].shape} does "
                     f"not match ({config.num_topics}, {num_terms})"
                 )
-            self._lam = jnp.asarray(ckpt["log_beta"], dtype)  # holds lambda
-            self.step_count = ckpt["em_iter"]
+            self._lam = jnp.asarray(ckpt["lam"], dtype)
+            self.step_count = ckpt["step"]
             self.history = [
                 StreamStepInfo(step=i + 1, rho=rho, batch_docs=0,
                                likelihood=jnp.asarray(ll, dtype), tokens=0)
-                for i, (ll, rho) in enumerate(ckpt["likelihoods"])
+                for i, (ll, rho) in enumerate(ckpt["history"])
             ]
         if mesh is not None:
             from ..parallel.mesh import replicated
@@ -196,12 +235,16 @@ class OnlineLDATrainer:
             and cfg.checkpoint_every
             and self.step_count % cfg.checkpoint_every == 0
         ):
-            from .lda import _is_coordinator, save_checkpoint
+            from .lda import _is_coordinator
 
+            # _to_host is collective on multi-host meshes
+            # (process_allgather) — every process must reach it; only
+            # the coordinator writes.
+            lam_host = self._to_host(self._lam)
             if _is_coordinator():
-                save_checkpoint(
+                save_stream_checkpoint(
                     self.checkpoint_path,
-                    self._to_host(self._lam),
+                    lam_host,
                     float(self._alpha),
                     self.step_count,
                     [(float(h.likelihood), h.rho) for h in self.history],
@@ -234,6 +277,19 @@ class OnlineLDATrainer:
         beta = lam / lam.sum(-1, keepdims=True)
         return np.where(beta > 0, np.log(np.maximum(beta, 1e-300)),
                         estep.LOG_ZERO)
+
+    def held_out_per_token_ll(self, batches: Sequence[Batch]) -> float:
+        """Held-out per-token log-likelihood (document completion,
+        models/evaluate.py) of unseen docs under the current topics —
+        the quality number for streaming runs, where training ELBO per
+        micro-batch (history) is too noisy to compare configurations."""
+        from .evaluate import held_out_per_token_ll
+
+        return held_out_per_token_ll(
+            self.log_beta(), float(self._alpha), batches,
+            var_max_iters=self.config.var_max_iters,
+            var_tol=self.config.var_tol,
+        )
 
     def infer_gamma(self, batches: Sequence[Batch], num_docs: int) -> np.ndarray:
         """Final inference pass: doc-topic posteriors for ``num_docs`` docs

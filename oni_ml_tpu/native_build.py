@@ -1,6 +1,6 @@
 """Shared build/load machinery for the native (C++) components.
 
-Each native module is one translation unit under ``native/`` compiled to
+Each native module is one translation unit under ``oni_ml_tpu/native_src/`` compiled to
 its own .so beside the Python wrapper that binds it.  Loading strategy
 (shared by io/native.py and features/native_flow.py): use the prebuilt
 .so (``make -C native``); if missing or older than its source, compile

@@ -188,6 +188,11 @@ struct Dfz {
 
   // Split a line on `sep`; keep iff exactly 8 fields.
   void add_line(std::string_view line, char sep) {
+    // A CSV-sourced \x1f would re-split the stored rows blob.  An
+    // embedded lone '\r' is fine here: rows are recovered by offsets,
+    // not delimiters, and the Python fallback reader uses the same
+    // line semantics (split on '\n', strip one trailing '\r'), so both
+    // engines preserve it in the field.
     if (sep != SEP && line.find(SEP) != std::string_view::npos)
       unsafe = true;
     std::string_view f[NCOLS];

@@ -1,0 +1,25 @@
+"""Streaming raw-line reader (features/lineio.py): native-ingest line
+semantics independent of chunk boundaries."""
+
+import pytest
+
+from oni_ml_tpu.features.lineio import iter_raw_lines
+
+CASES = [
+    (b"a,b\nc,d\n", ["a,b", "c,d"]),
+    (b"a,b\r\nc,d\r\n", ["a,b", "c,d"]),          # CRLF stripped
+    (b"a\rb\nc\n", ["a\rb", "c"]),                 # lone \r stays in field
+    (b"a\r\r\nb\n", ["a\r", "b"]),                 # only ONE \r stripped
+    (b"last-no-newline", ["last-no-newline"]),
+    (b"tail\r", ["tail"]),                         # CR-final unterminated
+    (b"\n\nx\n", ["", "", "x"]),                   # empties preserved
+    (b"", []),
+]
+
+
+@pytest.mark.parametrize("data,want", CASES)
+@pytest.mark.parametrize("chunk", [1, 2, 3, 1 << 22])
+def test_line_semantics_all_chunk_sizes(tmp_path, data, want, chunk):
+    p = tmp_path / "f.csv"
+    p.write_bytes(data)
+    assert list(iter_raw_lines(str(p), chunk_size=chunk)) == want

@@ -164,6 +164,33 @@ def test_rows_with_carriage_return_fall_back():
     assert feats.rows[0][4] == "evil\rname.example.com\r"
 
 
+def test_csv_with_embedded_carriage_return_parity(tmp_path):
+    # An embedded lone '\r' in a CSV field is a legal hostile-qname byte.
+    # Both engines must keep the row intact with the '\r' in the field —
+    # universal-newline reading would split it into dropped fragments.
+    qname = "evil\rname.example.com"
+    line1 = f"t,1454000000,60,10.9.9.1,{qname},1,1,0"
+    line2 = "t,1454000060,70,10.9.9.2,ok.example.com,1,1,0"
+    path = tmp_path / "dns.csv"
+    path.write_bytes((line1 + "\n" + line2 + "\r\n").encode())
+    nat = native_dns.featurize_dns_sources([str(path)], top_domains=TOP)
+    assert isinstance(nat, native_dns.NativeDnsFeatures)
+    assert nat.num_events == 2
+    assert nat.rows[0][4] == qname
+    assert nat.rows[1][4] == "ok.example.com"  # CRLF tail stripped
+
+    # Python fallback (native unavailable) reads identically.
+    orig = native_dns._LIB.load
+    native_dns._LIB.load = lambda: None
+    try:
+        py = native_dns.featurize_dns_sources([str(path)], top_domains=TOP)
+    finally:
+        native_dns._LIB.load = orig
+    assert isinstance(py, pydns.DnsFeatures)
+    assert py.rows == nat.rows
+    assert py.word == nat.word
+
+
 def test_csv_with_separator_byte_falls_back(tmp_path):
     # A CSV field embedding the '\x1f' transport separator would split
     # into extra columns when the native rows blob is re-split; ingest

@@ -82,6 +82,47 @@ def test_online_approaches_batch_quality():
     assert ll_online > ll_batch - 0.05 * abs(ll_batch), (ll_batch, ll_online)
 
 
+def test_held_out_ll_improves_with_training():
+    """Document-completion held-out per-token LL (models/evaluate.py):
+    a trained model must predict unseen docs' held-out halves better
+    than the random init, and the batch optimum must score at least
+    comparably to it on the same held-out split."""
+    docs, _ = ref.make_synthetic_corpus(num_docs=200, num_terms=30,
+                                        num_topics=3, seed=7)
+    V, K = 30, 3
+    train_corpus_docs = corpus_from_docs(docs[:150], V)
+    heldout = corpus_from_docs(docs[150:], V)
+    ho_batches = make_batches(heldout, batch_size=64, min_bucket_len=64)
+
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          tau0=8.0, kappa=0.7, seed=1)
+    trainer = OnlineLDATrainer(cfg, num_terms=V,
+                               total_docs=train_corpus_docs.num_docs)
+    ll_init = trainer.held_out_per_token_ll(ho_batches)
+    batches = make_batches(train_corpus_docs, cfg.batch_size,
+                           cfg.min_bucket_len)
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        for i in rng.permutation(len(batches)):
+            trainer.step(batches[i])
+    ll_trained = trainer.held_out_per_token_ll(ho_batches)
+    assert ll_trained > ll_init + 0.1, (ll_init, ll_trained)
+    # per-token log-prob is bounded above by 0
+    assert ll_trained < 0.0
+
+    from oni_ml_tpu.models.evaluate import held_out_per_token_ll
+    batch_res = train_corpus(
+        train_corpus_docs,
+        LDAConfig(num_topics=K, em_max_iters=30, em_tol=1e-6,
+                  batch_size=256, min_bucket_len=64, seed=2),
+    )
+    ll_batch = held_out_per_token_ll(batch_res.log_beta, batch_res.alpha,
+                                     ho_batches)
+    assert ll_batch > ll_init + 0.1, (ll_init, ll_batch)
+    # the two engines should land in the same quality neighborhood
+    assert abs(ll_batch - ll_trained) < 0.5, (ll_batch, ll_trained)
+
+
 def test_online_writes_reference_files(tmp_path):
     docs, _ = ref.make_synthetic_corpus(num_docs=40, num_terms=25,
                                         num_topics=2, seed=3)
@@ -130,6 +171,57 @@ def test_online_sharded_matches_single_device():
     import pytest
     with pytest.raises(ValueError, match="data-parallel"):
         OnlineLDATrainer(cfg, num_terms=V, total_docs=10, mesh=bad_mesh)
+
+
+def test_stream_checkpoint_roundtrip_and_resume(tmp_path):
+    """The streaming checkpoint writes SVI-native fields (lam/step/
+    history) and a fresh trainer resumes from it bit-for-bit."""
+    from oni_ml_tpu.models.online_lda import load_stream_checkpoint
+
+    docs, _ = ref.make_synthetic_corpus(num_docs=60, num_terms=25,
+                                        num_topics=2, seed=12)
+    V, K = 25, 3
+    corpus = corpus_from_docs(docs, V)
+    ck = str(tmp_path / "stream.npz")
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          checkpoint_every=2, seed=5)
+    tr = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs,
+                          checkpoint_path=ck)
+    for b in make_batches(corpus, cfg.batch_size, cfg.min_bucket_len):
+        tr.step(b)
+
+    z = load_stream_checkpoint(ck)
+    assert set(z) == {"lam", "alpha", "step", "history"}
+    assert z["step"] % cfg.checkpoint_every == 0 and z["step"] > 0
+    assert all(0 < rho <= 1 for _, rho in z["history"])
+
+    resumed = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs,
+                               checkpoint_path=ck)
+    assert resumed.step_count == z["step"]
+    np.testing.assert_array_equal(np.asarray(resumed.lam), z["lam"])
+    assert [h.rho for h in resumed.history] == [r for _, r in z["history"]]
+
+
+def test_stream_checkpoint_reads_legacy_layout(tmp_path):
+    """Checkpoints written by early revisions (batch-checkpoint field
+    names smuggling lambda through log_beta) still load."""
+    from oni_ml_tpu.models.online_lda import load_stream_checkpoint
+
+    lam = np.random.default_rng(0).gamma(100.0, 0.01, (3, 25))
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez(legacy, log_beta=lam, alpha=np.float64(2.5),
+             em_iter=np.int64(7),
+             likelihoods=np.array([[-100.0, 0.5], [-90.0, 0.4]]))
+    z = load_stream_checkpoint(legacy)
+    assert z["step"] == 7 and z["alpha"] == 2.5
+    np.testing.assert_array_equal(z["lam"], lam)
+    assert z["history"] == [(-100.0, 0.5), (-90.0, 0.4)]
+
+    tr = OnlineLDATrainer(
+        OnlineLDAConfig(num_topics=3, batch_size=16, min_bucket_len=64),
+        num_terms=25, total_docs=10, checkpoint_path=legacy,
+    )
+    assert tr.step_count == 7 and len(tr.history) == 2
 
 
 def test_stream_extends_without_restart():
