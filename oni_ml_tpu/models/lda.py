@@ -285,7 +285,14 @@ class LDATrainer:
 
         gamma_out = np.zeros((num_docs, k), dtype=np.float64)
         likelihoods: list[tuple[float, float]] = list(restored[:start_it])
-        ll_file = open(likelihood_file, "w") if likelihood_file else None
+        # Only the coordinator streams likelihood.dat: in multi-host runs
+        # every process executes fit() against a shared day dir, and two
+        # appenders on one file would interleave.
+        ll_file = (
+            open(likelihood_file, "w")
+            if likelihood_file and _is_coordinator()
+            else None
+        )
         if ll_file:
             for ll_r, conv_r in likelihoods:
                 formats.append_likelihood(ll_file, ll_r, conv_r)
@@ -659,7 +666,9 @@ def train_corpus(
     )
     if num_terms != corpus.num_terms:
         result.log_beta = result.log_beta[:, : corpus.num_terms]
-    if out_dir:
-        # likelihood.dat was already streamed (crash-safe) during fit.
+    if out_dir and _is_coordinator():
+        # likelihood.dat was already streamed (crash-safe) during fit;
+        # multi-host: the result is identical on every process (to_host
+        # gathers collectively) but only the coordinator owns the files.
         result.save(out_dir, num_terms=corpus.num_terms, include_likelihood=False)
     return result

@@ -74,8 +74,20 @@ def load_stream_checkpoint(path: str) -> dict:
                 "step": int(z["step"]),
                 "history": [tuple(row) for row in z["history"]],
             }
-        return {  # legacy layout (batch-checkpoint field names)
-            "lam": z["log_beta"],
+        # Legacy layout (batch-checkpoint field names smuggling lambda).
+        # A real batch EM checkpoint shares these field names AND the
+        # (K, V) shape but holds log-probabilities (all <= 0), while a
+        # variational lambda is strictly positive Dirichlet parameters —
+        # reject it instead of streaming NaN topics out of digamma.
+        lam = z["log_beta"]
+        if not (lam > 0).all():
+            raise ValueError(
+                f"{path} is a batch EM checkpoint (log_beta has "
+                "non-positive entries), not a streaming-LDA checkpoint; "
+                "resume it with the batch trainer or remove it"
+            )
+        return {
+            "lam": lam,
             "alpha": float(z["alpha"]),
             "step": int(z["em_iter"]),
             "history": [tuple(row) for row in z["likelihoods"]],
@@ -377,11 +389,12 @@ def train_corpus_online(
             (batches[i] for i in order[skip:]), progress=progress
         )
     result = trainer.result(batches, corpus.num_docs)
-    if ckpt_path and os.path.exists(ckpt_path):
-        from .lda import _is_coordinator
+    from .lda import _is_coordinator
 
-        if _is_coordinator():
-            os.remove(ckpt_path)
-    if out_dir:
+    if ckpt_path and os.path.exists(ckpt_path) and _is_coordinator():
+        os.remove(ckpt_path)
+    if out_dir and _is_coordinator():
+        # Multi-host: result is identical on every rank (collective
+        # gathers), but the shared day dir has exactly one writer.
         result.save(out_dir, num_terms=corpus.num_terms)
     return result

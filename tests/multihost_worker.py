@@ -1,0 +1,96 @@
+"""Worker process for tests/test_multihost.py.
+
+Runs one rank of a 2-process jax.distributed CPU cluster (2 virtual
+devices per process -> a 4-device global (data, model) mesh spanning
+both) and trains the small synthetic corpus through the REAL multi-host
+code paths the single-process suite cannot reach:
+
+- `jax.device_put` onto shardings spanning non-addressable devices,
+- `to_host`'s `process_allgather` branch (models/lda.py) — the arrays
+  are genuinely not fully addressable here,
+- `_is_coordinator` gating of likelihood.dat / final.* / checkpoint
+  writes against a shared day directory,
+- the `initialize_distributed` bootstrap (parallel/mesh.py) that
+  `ml_ops --multihost` calls.
+
+Each rank dumps its LDAResult to proc<pid>.npz; the launcher asserts
+rank parity and compares against a plain single-process run.
+
+Usage: multihost_worker.py <port> <pid> <num_procs> <outdir>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    port, pid, nprocs, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    # Backend setup must precede any jax import side effects: CPU-only,
+    # two virtual local devices per process.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    from oni_ml_tpu.parallel import initialize_distributed, make_mesh
+
+    initialize_distributed(f"localhost:{port}", nprocs, pid)
+
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == nprocs
+    assert len(jax.devices()) == 2 * nprocs
+    assert len(jax.local_devices()) == 2
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import reference_lda as ref
+    from test_lda import corpus_from_docs
+
+    from oni_ml_tpu.config import LDAConfig
+    from oni_ml_tpu.models import train_corpus
+
+    docs, _ = ref.make_synthetic_corpus(
+        num_docs=80, num_terms=25, num_topics=3, seed=21
+    )
+    corpus = corpus_from_docs(docs, 25)
+    cfg = LDAConfig(
+        num_topics=3, em_max_iters=6, em_tol=0.0, batch_size=32,
+        min_bucket_len=64, seed=4, checkpoint_every=2, fused_em_chunk=4,
+    )
+    mesh = make_mesh(data=2 * nprocs, model=1)
+    day_dir = os.path.join(outdir, "day")
+    os.makedirs(day_dir, exist_ok=True)
+    res = train_corpus(corpus, cfg, out_dir=day_dir, mesh=mesh)
+
+    # Streaming trainer through the same mesh: its checkpoint path calls
+    # the collective _to_host BEFORE the coordinator gate — the old
+    # gate-first ordering deadlocks exactly here (ADVICE r2 finding).
+    from oni_ml_tpu.config import OnlineLDAConfig
+    from oni_ml_tpu.io import make_batches
+    from oni_ml_tpu.models import OnlineLDATrainer
+
+    stream_ck = os.path.join(outdir, "day", "stream.npz")
+    ocfg = OnlineLDAConfig(num_topics=3, batch_size=32, min_bucket_len=64,
+                           checkpoint_every=1, seed=4)
+    trainer = OnlineLDATrainer(ocfg, num_terms=25, total_docs=corpus.num_docs,
+                               mesh=mesh, checkpoint_path=stream_ck)
+    for b in make_batches(corpus, ocfg.batch_size, ocfg.min_bucket_len):
+        trainer.step(b)
+    lam = np.asarray(trainer._to_host(trainer.lam))
+
+    np.savez(
+        os.path.join(outdir, f"proc{pid}.npz"),
+        log_beta=res.log_beta,
+        gamma=res.gamma,
+        alpha=np.float64(res.alpha),
+        lls=np.asarray([ll for ll, _ in res.likelihoods], np.float64),
+        stream_lam=lam,
+        stream_steps=np.int64(trainer.step_count),
+    )
+    print(f"WORKER_OK {pid}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

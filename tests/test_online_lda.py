@@ -3,6 +3,7 @@ with the batch engine, and reference file contracts."""
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from oni_ml_tpu.config import LDAConfig, OnlineLDAConfig
 from oni_ml_tpu.io import make_batches
@@ -222,6 +223,16 @@ def test_stream_checkpoint_reads_legacy_layout(tmp_path):
         num_terms=25, total_docs=10, checkpoint_path=legacy,
     )
     assert tr.step_count == 7 and len(tr.history) == 2
+
+    # A genuine batch EM checkpoint (log-probabilities, all <= 0) shares
+    # the legacy field names and shape but must be rejected, not fed to
+    # digamma as a "lambda".
+    batch_ck = str(tmp_path / "batch.npz")
+    np.savez(batch_ck, log_beta=np.log(lam / lam.sum(-1, keepdims=True)),
+             alpha=np.float64(2.5), em_iter=np.int64(3),
+             likelihoods=np.array([[-50.0, 1.0]]))
+    with pytest.raises(ValueError, match="batch EM checkpoint"):
+        load_stream_checkpoint(batch_ck)
 
 
 def test_stream_extends_without_restart():
