@@ -154,9 +154,26 @@ def save_checkpoint(
 
 
 def load_checkpoint(path: str) -> dict:
+    """Load a batch EM checkpoint, rejecting streaming-LDA checkpoints
+    that can share the same out_dir/checkpoint.npz filename: new-format
+    ones carry `lam`, and legacy ones smuggled a strictly positive
+    lambda through `log_beta` where real log-probabilities are <= 0
+    (the mirror of online_lda.load_stream_checkpoint's guard)."""
     with np.load(path) as z:
+        if "lam" in z.files:
+            raise ValueError(
+                f"{path} is a streaming-LDA checkpoint; resume it with "
+                "the online trainer or remove it"
+            )
+        log_beta = z["log_beta"]
+        if log_beta.size and (log_beta > 0).all():
+            raise ValueError(
+                f"{path} holds strictly positive values — a legacy "
+                "streaming-LDA checkpoint (lambda), not batch log_beta; "
+                "resume it with the online trainer or remove it"
+            )
         return {
-            "log_beta": z["log_beta"],
+            "log_beta": log_beta,
             "alpha": float(z["alpha"]),
             "em_iter": int(z["em_iter"]),
             "likelihoods": [tuple(row) for row in z["likelihoods"]],

@@ -102,6 +102,25 @@ def _stage_done(ctx: RunContext, stage: Stage) -> bool:
     return all(os.path.exists(ctx.path(n)) for n in names)
 
 
+def _coord_decision(value: bool) -> bool:
+    """Make a per-stage decision on the coordinator and broadcast it, so
+    ranks can never desync on filesystem state (a rank skipping a stage
+    whose collectives the others entered would deadlock the mesh).  The
+    broadcast doubles as the inter-stage barrier: non-coordinators wait
+    here until the coordinator has finished the previous stage's writes."""
+    import jax
+
+    if jax.process_count() == 1:
+        return value
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(
+        np.asarray([1.0 if value else 0.0], np.float32)
+    )
+    return bool(out[0] > 0.5)
+
+
 def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
     t0 = time.perf_counter()
     info = fn()
@@ -226,12 +245,17 @@ def stage_lda(ctx: RunContext) -> dict:
             mesh=ctx.mesh,
             vocab_sharded=ctx.vocab_sharded,
         )
-    formats.write_doc_results(
-        ctx.path("doc_results.csv"), corpus.doc_names, result.gamma
-    )
-    formats.write_word_results(
-        ctx.path("word_results.csv"), corpus.vocab, result.log_beta
-    )
+    from ..models.lda import _is_coordinator
+
+    if _is_coordinator():
+        # result is rank-identical (collective gathers in train_corpus*);
+        # the shared day dir has exactly one writer.
+        formats.write_doc_results(
+            ctx.path("doc_results.csv"), corpus.doc_names, result.gamma
+        )
+        formats.write_word_results(
+            ctx.path("word_results.csv"), corpus.vocab, result.log_beta
+        )
     lls = [ll for ll, _ in result.likelihoods]
     return {
         "em_iters": result.em_iters,
@@ -298,16 +322,35 @@ def run_pipeline(
         vocab_sharded=vocab_sharded,
         online=online,
     )
+    import jax
+
+    # Multi-host contract (--multihost): every rank runs run_pipeline
+    # against a SHARED day dir.  Host-only stages (pre/corpus/score) and
+    # all file writes execute on the coordinator alone; stage_lda runs
+    # on every rank (its training collectives span the mesh).  Stage
+    # skip/run decisions broadcast from the coordinator so ranks cannot
+    # desync on filesystem state.
+    multiproc = jax.process_count() > 1
+    is_coord = jax.process_index() == 0
     wanted = stages or STAGE_ORDER
     for stage in STAGE_ORDER:
         if stage not in wanted:
             continue
-        if not force and _stage_done(ctx, stage):
-            ctx.emit({"stage": stage.value, "skipped": "outputs exist"})
+        done = (
+            _stage_done(ctx, stage) if (is_coord or not multiproc) else False
+        )
+        skip = not force and done
+        if multiproc:
+            skip = _coord_decision(skip)
+        if skip:
+            if is_coord:
+                ctx.emit({"stage": stage.value, "skipped": "outputs exist"})
             continue
-        _run_stage(ctx, stage, lambda s=stage: _STAGE_FNS[s](ctx))
-    with open(ctx.path("metrics.json"), "w") as f:
-        json.dump(ctx.metrics, f, indent=1)
+        if is_coord or stage is Stage.LDA:
+            _run_stage(ctx, stage, lambda s=stage: _STAGE_FNS[s](ctx))
+    if is_coord:
+        with open(ctx.path("metrics.json"), "w") as f:
+            json.dump(ctx.metrics, f, indent=1)
     return ctx.metrics
 
 
@@ -408,7 +451,10 @@ def main(argv: list[str] | None = None) -> int:
         help="initialize jax.distributed (one controller process per host; "
         "coordinator/process env via JAX_COORDINATOR_ADDRESS etc.) so the "
         "mesh spans all hosts' devices over ICI/DCN — the reference's "
-        "mpiexec -f machinefile fan-out (ml_ops.sh:80), minus MPI",
+        "mpiexec -f machinefile fan-out (ml_ops.sh:80), minus MPI.  "
+        "Requires --data-dir on a filesystem shared by all hosts: the "
+        "coordinator is the only writer; other ranks join the training "
+        "collectives and read the shared stage outputs",
     )
     p.add_argument(
         "--profile", default=None, metavar="DIR",

@@ -79,6 +79,35 @@ def main() -> int:
         trainer.step(b)
     lam = np.asarray(trainer._to_host(trainer.lam))
 
+    # Full runner pipeline against the shared day dir: host-only stages
+    # and all writes are coordinator-only, stage decisions broadcast, and
+    # every rank joins stage_lda's collectives (runner/ml_ops.py
+    # run_pipeline's multi-host contract).
+    from oni_ml_tpu.config import PipelineConfig, ScoringConfig
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    flow_csv = os.path.join(outdir, "flow.csv")
+    if pid == 0:  # shared dir: one writer is the point
+        rows = ["hdr"]
+        rng = np.random.default_rng(3)
+        for i in range(200):
+            c = ["0"] * 27
+            c[4], c[5], c[6] = "3", "14", "9"
+            c[8] = f"10.0.0.{i % 11}"
+            c[9] = f"10.0.1.{i % 7}"
+            c[10], c[11] = "443", str(1025 + int(rng.integers(0, 500)))
+            c[16], c[17] = "9", str(int(rng.integers(40, 1500)))
+            rows.append(",".join(c))
+        with open(flow_csv, "w") as f:
+            f.write("\n".join(rows) + "\n")
+    pipe_cfg = PipelineConfig(
+        data_dir=outdir, flow_path=flow_csv,
+        lda=LDAConfig(num_topics=3, em_max_iters=4, em_tol=0.0,
+                      batch_size=32, min_bucket_len=64, seed=4),
+        scoring=ScoringConfig(threshold=0.5),
+    )
+    metrics = run_pipeline(pipe_cfg, "20260101", "flow", mesh=mesh)
+
     np.savez(
         os.path.join(outdir, f"proc{pid}.npz"),
         log_beta=res.log_beta,
@@ -87,6 +116,7 @@ def main() -> int:
         lls=np.asarray([ll for ll, _ in res.likelihoods], np.float64),
         stream_lam=lam,
         stream_steps=np.int64(trainer.step_count),
+        pipeline_stages=np.int64(len(metrics)),
     )
     print(f"WORKER_OK {pid}", flush=True)
     return 0

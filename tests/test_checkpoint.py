@@ -31,6 +31,26 @@ def test_checkpoint_roundtrip(tmp_path):
     assert ck["likelihoods"] == [(-10.0, 1.0), (-9.0, 0.1)]
 
 
+def test_batch_load_rejects_stream_checkpoints(tmp_path):
+    """Both streaming layouts sharing out_dir/checkpoint.npz must be
+    rejected by the batch loader, not trained from as garbage topics."""
+    import pytest
+
+    from oni_ml_tpu.models.online_lda import save_stream_checkpoint
+
+    lam = np.random.default_rng(1).gamma(100.0, 0.01, (3, 7))
+    new_fmt = str(tmp_path / "new.npz")
+    save_stream_checkpoint(new_fmt, lam, 2.5, 3, [(-5.0, 0.5)])
+    with pytest.raises(ValueError, match="streaming-LDA checkpoint"):
+        load_checkpoint(new_fmt)
+
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez(legacy, log_beta=lam, alpha=np.float64(2.5),
+             em_iter=np.int64(3), likelihoods=np.array([[-5.0, 0.5]]))
+    with pytest.raises(ValueError, match="strictly positive"):
+        load_checkpoint(legacy)
+
+
 def test_resume_matches_uninterrupted(tmp_path):
     corpus, V = _problem()
     K = 3

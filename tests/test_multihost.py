@@ -110,6 +110,26 @@ def test_streaming_checkpoint_survives_multihost(worker_runs):
     np.testing.assert_allclose(z["lam"], r0["stream_lam"], rtol=1e-6)
 
 
+def test_pipeline_multihost_single_writer(worker_runs):
+    """run_pipeline across both ranks: stage decisions broadcast, every
+    stage output written exactly once by the coordinator, full day
+    completes (pre/corpus/lda/score all recorded)."""
+    import json
+
+    r0 = np.load(worker_runs / "proc0.npz")
+    r1 = np.load(worker_runs / "proc1.npz")
+    assert r0["pipeline_stages"] == 4          # coordinator ran all stages
+    day = worker_runs / "20260101"
+    for fn in ("word_counts.dat", "model.dat", "final.beta",
+               "doc_results.csv", "word_results.csv", "flow_results.csv",
+               "metrics.json"):
+        assert (day / fn).exists(), fn
+    metrics = json.loads((day / "metrics.json").read_text())
+    assert [m["stage"] for m in metrics] == ["pre", "corpus", "lda", "score"]
+    assert metrics[-1]["scored_events"] == 200
+    assert r1["pipeline_stages"] >= 1          # rank 1 joined stage_lda
+
+
 def test_coordinator_owns_shared_files(worker_runs):
     day = worker_runs / "day"
     # Coordinator wrote the full reference output set...
