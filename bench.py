@@ -127,10 +127,10 @@ def em_utilization(k, v, b, t_iter, var_max_iters=20):
 
 def bench_dns_scoring(n_events=400_000, reps=3):
     """Full score_dns stage (model-row resolution, batched device dots,
-    threshold/sort, CSV row emit) over a synthetic day; returns
+    threshold/sort, native CSV emit) over a synthetic day; returns
     (events_per_sec, p50_seconds)."""
-    from oni_ml_tpu.features import featurize_dns
-    from oni_ml_tpu.scoring import ScoringModel, score_dns
+    from oni_ml_tpu.features.native_dns import featurize_dns_sources
+    from oni_ml_tpu.scoring import ScoringModel, score_dns_csv
 
     rng = np.random.default_rng(7)
     k = 20
@@ -148,7 +148,7 @@ def bench_dns_scoring(n_events=400_000, reps=3):
         ]
         for i in range(n_events)
     ]
-    feats = featurize_dns(rows)
+    feats = featurize_dns_sources([rows])  # production (native) container
     ips = sorted({feats.client_ip(i) for i in range(min(n_ips, n_events))})
     vocab = sorted(set(feats.word))
     theta = rng.dirichlet(np.ones(k), size=len(ips))
@@ -158,10 +158,10 @@ def bench_dns_scoring(n_events=400_000, reps=3):
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        rows_out, _ = score_dns(feats, model, threshold=1e-3)
+        blob, scores = score_dns_csv(feats, model, threshold=1e-3)
         times.append(time.perf_counter() - t0)
     p50 = float(np.median(times))
-    assert rows_out  # threshold keeps some events
+    assert len(blob) and len(scores)  # threshold keeps some events
     return n_events / p50, p50
 
 

@@ -41,62 +41,7 @@
 
 namespace {
 
-// str(float): CPython repr — shortest round-trip digits, fixed notation
-// for decimal exponents in [-4, 16), scientific ("1e+16", "1e-05",
-// two-plus exponent digits, explicit sign) outside, ".0" suffix on
-// integral fixed values.  std::to_chars' shortest *general* format
-// picks scientific wherever it is shorter (1e15 -> "1e+15",
-// 0.0001 -> "1e-04"), which diverges from Python inside that window —
-// so take shortest-scientific digits and re-format per Python's rule.
-std::string jvm_double(double v) {
-  char buf[64];
-  if (!std::isfinite(v)) {
-    auto [p, ec] = std::to_chars(buf, buf + sizeof(buf), v);
-    (void)ec;
-    return std::string(buf, p);  // "inf" / "-inf" / "nan" == str(float)
-  }
-  auto [p, ec] = std::to_chars(buf, buf + sizeof(buf), v,
-                               std::chars_format::scientific);
-  (void)ec;
-  std::string_view s(buf, (size_t)(p - buf));
-  bool neg = s.front() == '-';
-  if (neg) s.remove_prefix(1);
-  size_t epos = s.find('e');
-  std::string digits(1, s[0]);
-  if (epos > 1) digits.append(s.substr(2, epos - 2));  // skip the '.'
-  int exp10 = 0;
-  std::from_chars(s.data() + epos + 1 + (s[epos + 1] == '+'),
-                  s.data() + s.size(), exp10);
-  std::string out;
-  if (neg) out += '-';
-  if (exp10 >= -4 && exp10 < 16) {
-    if (exp10 < 0) {
-      out += "0.";
-      out.append((size_t)(-exp10 - 1), '0');
-      out += digits;
-    } else if ((size_t)exp10 + 1 >= digits.size()) {
-      out += digits;
-      out.append((size_t)exp10 + 1 - digits.size(), '0');
-      out += ".0";
-    } else {
-      out.append(digits, 0, (size_t)exp10 + 1);
-      out += '.';
-      out.append(digits, (size_t)exp10 + 1, std::string::npos);
-    }
-  } else {
-    out += digits[0];
-    if (digits.size() > 1) {
-      out += '.';
-      out.append(digits, 1, std::string::npos);
-    }
-    out += 'e';
-    out += exp10 < 0 ? '-' : '+';
-    int ae = exp10 < 0 ? -exp10 : exp10;
-    if (ae < 10) out += '0';
-    oni::append_int(out, ae);
-  }
-  return out;
-}
+using oni::jvm_double;
 
 constexpr int NCOLS = 27;
 // Column indices (flow_pre_lda.scala:46-72); 10/11 keep the reference's

@@ -50,7 +50,7 @@ from ..features import (
 )
 from ..io import Corpus, formats
 from ..models import train_corpus, train_corpus_online
-from ..scoring import ScoringModel, score_dns, score_flow
+from ..scoring import ScoringModel
 
 
 class Stage(str, Enum):
@@ -272,14 +272,15 @@ def stage_score(ctx: RunContext) -> dict:
     model = ScoringModel.from_files(
         ctx.path("doc_results.csv"), ctx.path("word_results.csv"), fallback
     )
-    score_fn = score_flow if ctx.dsource == "flow" else score_dns
-    rows, scores = score_fn(features, model, sc.threshold)
-    with open(ctx.path(ctx.results_name()), "w") as f:
-        for row in rows:
-            f.write(row + "\n")
+    from ..scoring import score_dns_csv, score_flow_csv
+
+    score_fn = score_flow_csv if ctx.dsource == "flow" else score_dns_csv
+    blob, scores = score_fn(features, model, sc.threshold)
+    with open(ctx.path(ctx.results_name()), "wb") as f:
+        f.write(blob)
     return {
         "scored_events": features.num_raw_events,
-        "flagged": len(rows),
+        "flagged": int(len(scores)),
         "min_score": float(scores[0]) if len(scores) else None,
     }
 
@@ -346,8 +347,26 @@ def run_pipeline(
             if is_coord:
                 ctx.emit({"stage": stage.value, "skipped": "outputs exist"})
             continue
+        err: Exception | None = None
         if is_coord or stage is Stage.LDA:
-            _run_stage(ctx, stage, lambda s=stage: _STAGE_FNS[s](ctx))
+            try:
+                _run_stage(ctx, stage, lambda s=stage: _STAGE_FNS[s](ctx))
+            except Exception as e:  # relayed to the other ranks below
+                err = e
+        if multiproc:
+            # Outcome barrier: a stage failure on the coordinator must
+            # fail every rank — otherwise they block forever in the next
+            # decision broadcast while the coordinator unwinds.  (A
+            # non-coordinator failing inside stage_lda's collectives
+            # errors on all ranks through the collective itself.)
+            ok = _coord_decision(err is None)
+            if not ok and err is None:
+                raise RuntimeError(
+                    f"stage {stage.value} failed on the coordinator; "
+                    "aborting this rank"
+                )
+        if err is not None:
+            raise err
     if is_coord:
         with open(ctx.path("metrics.json"), "w") as f:
             json.dump(ctx.metrics, f, indent=1)

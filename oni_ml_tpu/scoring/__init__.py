@@ -1,6 +1,18 @@
 """Event scoring — the framework's replacement for flow_post_lda.scala /
 dns_post_lda.scala."""
 
-from .score import ScoringModel, score_flow, score_dns
+from .score import (
+    ScoringModel,
+    score_dns,
+    score_dns_csv,
+    score_flow,
+    score_flow_csv,
+)
 
-__all__ = ["ScoringModel", "score_flow", "score_dns"]
+__all__ = [
+    "ScoringModel",
+    "score_flow",
+    "score_flow_csv",
+    "score_dns",
+    "score_dns_csv",
+]

@@ -1,0 +1,167 @@
+"""ctypes binding for the native scored-CSV emitter
+(oni_ml_tpu/native_src/row_emit.cpp).
+
+Row assembly dominates the score stage (>90% on a 400k-event day);
+this builds the whole output buffer in C++ from the arena blobs and
+numeric columns the Native*Features containers already hold.  Output is
+bit-identical to the Python emit loop (pinned by
+tests/test_scoring.py's emit-parity tests and the golden fixture).
+
+Only native-backed feature containers qualify — the pure-Python
+DnsFeatures/FlowFeatures keep rows as lists and take the Python loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..native_build import NativeLib
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F64P = ctypes.POINTER(ctypes.c_double)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.emit_free.argtypes = [ctypes.c_void_p]
+    lib.flow_emit.restype = ctypes.c_void_p
+    lib.flow_emit.argtypes = (
+        [ctypes.c_char_p, _I64P] * 3
+        + [_I32P] * 5
+        + [_F64P, _I64P, _I64P, _I64P]
+        + [_F64P, _F64P]
+        + [_I64P, ctypes.c_int64, _I64P]
+    )
+    lib.dns_emit.restype = ctypes.c_void_p
+    lib.dns_emit.argtypes = (
+        [ctypes.c_char_p, _I64P] * 4
+        + [_I32P] * 3
+        + [_I64P, _I64P, _F64P, _I64P, _F64P]
+        + [_I64P, ctypes.c_int64, _I64P]
+    )
+
+
+_LIB = NativeLib(
+    os.path.join(
+        os.path.dirname(__file__), "..", "native_src", "row_emit.cpp"
+    ),
+    os.path.join(os.path.dirname(__file__), "_native", "liboni_emit.so"),
+    _configure,
+    deps=(
+        os.path.join(
+            os.path.dirname(__file__), "..", "native_src", "common.h"
+        ),
+    ),
+)
+
+
+def available() -> bool:
+    return _LIB.available()
+
+
+def _table_blob(strs: list[str]) -> tuple[bytes, np.ndarray]:
+    """Re-encode a decoded string table into (blob, offsets) — tables
+    hold unique strings only, so this is tiny next to the row count."""
+    enc = [s.encode("utf-8") for s in strs]
+    off = np.zeros(len(enc) + 1, np.int64)
+    if enc:
+        np.cumsum([len(e) for e in enc], out=off[1:])
+    return b"".join(enc), off
+
+
+def _i64p(a: np.ndarray):
+    return np.ascontiguousarray(a, np.int64).ctypes.data_as(_I64P)
+
+
+def _i32p(a: np.ndarray):
+    return np.ascontiguousarray(a, np.int32).ctypes.data_as(_I32P)
+
+
+def _f64p(a: np.ndarray):
+    return np.ascontiguousarray(a, np.float64).ctypes.data_as(_F64P)
+
+
+def _collect(lib, ptr, out_len) -> bytes:
+    try:
+        return ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.emit_free(ptr)
+
+
+def flow_emit(features, src_scores, dest_scores, order) -> bytes | None:
+    """Scored-CSV buffer for NativeFlowFeatures, or None when the
+    native library is unavailable."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    ip_blob, ip_off = _table_blob(features.ip_table)
+    word_blob, word_off = _table_blob(features.word_table)
+    # keep the contiguous arrays alive across the call
+    holds = [
+        np.ascontiguousarray(features.line_off, np.int64),
+        ip_off, word_off,
+        np.ascontiguousarray(features.sip_id, np.int32),
+        np.ascontiguousarray(features.dip_id, np.int32),
+        np.ascontiguousarray(features.wp_id, np.int32),
+        np.ascontiguousarray(features.sw_id, np.int32),
+        np.ascontiguousarray(features.dw_id, np.int32),
+        np.ascontiguousarray(features.num_time, np.float64),
+        np.ascontiguousarray(features.ibyt_bin, np.int64),
+        np.ascontiguousarray(features.ipkt_bin, np.int64),
+        np.ascontiguousarray(features.time_bin, np.int64),
+        np.ascontiguousarray(src_scores, np.float64),
+        np.ascontiguousarray(dest_scores, np.float64),
+        np.ascontiguousarray(order, np.int64),
+    ]
+    out_len = ctypes.c_int64(0)
+    ptr = lib.flow_emit(
+        features.lines_blob, _i64p(holds[0]),
+        ip_blob, _i64p(holds[1]),
+        word_blob, _i64p(holds[2]),
+        _i32p(holds[3]), _i32p(holds[4]),
+        _i32p(holds[5]), _i32p(holds[6]), _i32p(holds[7]),
+        _f64p(holds[8]), _i64p(holds[9]), _i64p(holds[10]),
+        _i64p(holds[11]),
+        _f64p(holds[12]), _f64p(holds[13]),
+        _i64p(holds[14]), len(holds[14]), ctypes.byref(out_len),
+    )
+    return _collect(lib, ptr, out_len)
+
+
+def dns_emit(features, scores, order) -> bytes | None:
+    """Scored-CSV buffer for NativeDnsFeatures, or None when the native
+    library is unavailable."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    dom_blob, dom_off = _table_blob(features.domain_table)
+    sub_blob, sub_off = _table_blob(features.subdomain_table)
+    word_blob, word_off = _table_blob(features.word_table)
+    holds = [
+        np.ascontiguousarray(features.row_off, np.int64),
+        dom_off, sub_off, word_off,
+        np.ascontiguousarray(features.dom_id, np.int32),
+        np.ascontiguousarray(features.sub_id, np.int32),
+        np.ascontiguousarray(features.word_id, np.int32),
+        np.ascontiguousarray(features.subdomain_length, np.int64),
+        np.ascontiguousarray(features.num_periods, np.int64),
+        np.ascontiguousarray(features.subdomain_entropy, np.float64),
+        np.ascontiguousarray(features.top_domain, np.int64),
+        np.ascontiguousarray(scores, np.float64),
+        np.ascontiguousarray(order, np.int64),
+    ]
+    out_len = ctypes.c_int64(0)
+    ptr = lib.dns_emit(
+        features.rows_blob, _i64p(holds[0]),
+        dom_blob, _i64p(holds[1]),
+        sub_blob, _i64p(holds[2]),
+        word_blob, _i64p(holds[3]),
+        _i32p(holds[4]), _i32p(holds[5]), _i32p(holds[6]),
+        _i64p(holds[7]), _i64p(holds[8]), _f64p(holds[9]), _i64p(holds[10]),
+        _f64p(holds[11]),
+        _i64p(holds[12]), len(holds[12]), ctypes.byref(out_len),
+    )
+    return _collect(lib, ptr, out_len)

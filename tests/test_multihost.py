@@ -130,6 +130,56 @@ def test_pipeline_multihost_single_writer(worker_runs):
     assert r1["pipeline_stages"] >= 1          # rank 1 joined stage_lda
 
 
+_ABORT_WORKER = r"""
+import os, sys
+port, pid = sys.argv[1], int(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+from oni_ml_tpu.parallel import initialize_distributed, make_mesh
+initialize_distributed(f"localhost:{port}", 2, pid)
+from oni_ml_tpu.config import LDAConfig, PipelineConfig, ScoringConfig
+from oni_ml_tpu.runner.ml_ops import run_pipeline
+cfg = PipelineConfig(
+    data_dir=sys.argv[3], flow_path="/nonexistent/flow.csv",
+    lda=LDAConfig(num_topics=3), scoring=ScoringConfig(threshold=0.5),
+)
+run_pipeline(cfg, "20260102", "flow", mesh=make_mesh(data=4, model=1))
+"""
+
+
+def test_coordinator_stage_failure_fails_all_ranks(tmp_path):
+    """A stage exception on the coordinator (bad flow_path) must
+    propagate to every rank through the outcome barrier — not leave
+    non-coordinators blocked in the next decision broadcast."""
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _ABORT_WORKER, str(port), str(pid),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)  # hang == old bug
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert procs[0].returncode != 0, outs[0][-2000:]
+    assert procs[1].returncode != 0, outs[1][-2000:]
+    assert "failed on the coordinator" in outs[1]
+
+
 def test_coordinator_owns_shared_files(worker_runs):
     day = worker_runs / "day"
     # Coordinator wrote the full reference output set...

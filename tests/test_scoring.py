@@ -96,3 +96,97 @@ def test_model_roundtrip_through_result_files(tmp_path):
     assert model.ip_index["10.0.0.2"] == 2
     assert model.word_index["w6"] == 6
     np.testing.assert_allclose(model.theta[4], 0.1)  # fallback row
+
+
+def _weird_flow_day(tmp_path, n=400):
+    """Native-backed flow day with str(float) boundary ports, NaN rows,
+    and CRLF — the emit seams that must stay byte-identical."""
+    from oni_ml_tpu.features import native_flow
+
+    rng = np.random.default_rng(5)
+    lines = ["hdr"]
+    ports = ["80", "443", "0", "1e15", "1e16", "0.0001", "52100", "##"]
+    for i in range(n):
+        c = ["x"] * 27
+        c[4], c[5], c[6] = str(int(rng.integers(0, 24))), "30", "15"
+        c[8], c[9] = f"10.0.0.{i % 17}", f"192.168.9.{i % 13}"
+        c[10], c[11] = ports[i % len(ports)], ports[(i * 3 + 1) % len(ports)]
+        c[16], c[17] = str(int(rng.integers(1, 300))), "##" if i % 37 == 0 else str(int(rng.integers(40, 5000)))
+        lines.append(",".join(c))
+    p = tmp_path / "flow.csv"
+    p.write_bytes(("\r\n".join(lines) + "\n").encode())
+    return native_flow.featurize_flow_file(str(p))
+
+
+def test_native_flow_emit_matches_python_bytes(tmp_path):
+    from oni_ml_tpu.scoring import native_emit, score_flow_csv
+    from oni_ml_tpu.scoring.score import _batched_scores, _keep_order
+
+    if not native_emit.available():
+        import pytest
+
+        pytest.skip("native emit unavailable")
+    feats = _weird_flow_day(tmp_path)
+    rng = np.random.default_rng(0)
+    ips = sorted(set(feats.ip_table))[: len(feats.ip_table) // 2]
+    vocab = sorted(set(feats.word_table))[: max(1, len(feats.word_table) // 2)]
+    k = 6
+    model = ScoringModel.from_results(
+        ips, rng.dirichlet(np.ones(k), size=len(ips)),
+        vocab, rng.dirichlet(np.ones(len(vocab)), size=k).T, fallback=0.05,
+    )
+    blob, scores = score_flow_csv(feats, model, threshold=np.inf)
+    assert len(scores) == feats.num_raw_events  # all kept
+
+    # Python reference loop over the same order
+    n = feats.num_raw_events
+    ip_map = model.ip_rows(feats.ip_table)
+    word_map = model.word_rows(feats.word_table)
+    src = _batched_scores(model, ip_map[feats.sip_id[:n]], word_map[feats.sw_id[:n]])
+    dest = _batched_scores(model, ip_map[feats.dip_id[:n]], word_map[feats.dw_id[:n]])
+    order = _keep_order(np.minimum(src, dest), np.inf)
+    want = "".join(
+        ",".join(feats.featurized_row(i) + [str(src[i]), str(dest[i])]) + "\n"
+        for i in order
+    ).encode("utf-8")
+    assert blob == want
+
+
+def test_native_dns_emit_matches_python_bytes():
+    from oni_ml_tpu.features import native_dns
+    from oni_ml_tpu.scoring import native_emit, score_dns_csv
+    from oni_ml_tpu.scoring.score import _batched_scores, _keep_order
+
+    if not (native_emit.available() and native_dns.available()):
+        import pytest
+
+        pytest.skip("native libs unavailable")
+    rng = np.random.default_rng(2)
+    qnames = ["www.google.com", "a.b.co.uk", "4.3.2.1.in-addr.arpa", "x",
+              "dga-9x.evil.biz", "deep.sub.example.org", "comma,in.field.com"]
+    rows = [
+        ["t", str(1454000000 + int(rng.integers(0, 9999))),
+         str(int(rng.integers(40, 1500))), f"172.16.0.{i % 9}",
+         qnames[i % len(qnames)], "1", str(int(rng.integers(1, 17))),
+         str(int(rng.integers(0, 4)))]
+        for i in range(300)
+    ]
+    feats = native_dns.featurize_dns_sources([rows])
+    k = 5
+    ips = sorted(set(feats.ip_table))[:5]
+    vocab = sorted(set(feats.word_table))[: max(1, len(feats.word_table) - 3)]
+    model = ScoringModel.from_results(
+        ips, rng.dirichlet(np.ones(k), size=len(ips)),
+        vocab, rng.dirichlet(np.ones(len(vocab)), size=k).T, fallback=0.1,
+    )
+    blob, scores = score_dns_csv(feats, model, threshold=np.inf)
+
+    n = feats.num_raw_events
+    ip_map = model.ip_rows(feats.ip_table)
+    word_map = model.word_rows(feats.word_table)
+    s = _batched_scores(model, ip_map[feats.ip_id[:n]], word_map[feats.word_id[:n]])
+    order = _keep_order(s, np.inf)
+    want = "".join(
+        ",".join(feats.featurized_row(i) + [str(s[i])]) + "\n" for i in order
+    ).encode("utf-8")
+    assert blob == want
