@@ -121,6 +121,24 @@ def _coord_decision(value: bool) -> bool:
     return bool(out[0] > 0.5)
 
 
+def _all_ranks_ok(ok: bool) -> bool:
+    """All-gather per-rank outcome flags; True only if EVERY rank
+    succeeded.  Unlike a one-to-all broadcast this also relays
+    non-coordinator failures (e.g. a rank whose shared-FS read raised
+    before it entered the stage's collectives)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return ok
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([1.0 if ok else 0.0], np.float32)
+    )
+    return bool(np.min(flags) > 0.5)
+
+
 def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
     t0 = time.perf_counter()
     info = fn()
@@ -293,6 +311,31 @@ _STAGE_FNS = {
 }
 
 
+def publish_day(day_dir: str, dest: str) -> dict:
+    """Deliver the completed day directory to the operational-analytics
+    consumer — the reference's final `scp -r ${LPATH} ${UINODE}:${RPATH}`
+    (ml_ops.sh:118-121).  `dest` is either a local/NFS directory (copied
+    with shutil) or an scp-style `host:path` remote."""
+    name = os.path.basename(os.path.normpath(day_dir))
+    if ":" in dest.split(os.sep, 1)[0]:
+        import subprocess
+
+        proc = subprocess.run(
+            ["scp", "-r", day_dir, dest], capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"publish to {dest} failed (rc={proc.returncode}): "
+                f"{proc.stderr.strip()[-500:]}"
+            )
+        return {"published": f"{dest}/{name}", "transport": "scp"}
+    import shutil
+
+    target = os.path.join(dest, name)
+    shutil.copytree(day_dir, target, dirs_exist_ok=True)
+    return {"published": target, "transport": "copy"}
+
+
 # ---------------------------------------------------------------------------
 # Entry
 # ---------------------------------------------------------------------------
@@ -307,6 +350,7 @@ def run_pipeline(
     mesh=None,
     vocab_sharded: bool = False,
     online: bool = False,
+    publish: str | None = None,
 ) -> list[dict]:
     """Run (or resume) the pipeline for one day.  Completed stages are
     skipped unless `force`; `stages` restricts to a subset (they still run
@@ -354,22 +398,46 @@ def run_pipeline(
             except Exception as e:  # relayed to the other ranks below
                 err = e
         if multiproc:
-            # Outcome barrier: a stage failure on the coordinator must
-            # fail every rank — otherwise they block forever in the next
-            # decision broadcast while the coordinator unwinds.  (A
-            # non-coordinator failing inside stage_lda's collectives
-            # errors on all ranks through the collective itself.)
-            ok = _coord_decision(err is None)
+            # Outcome barrier: a stage failure on ANY rank must fail
+            # every rank — otherwise the survivors block forever in the
+            # next decision broadcast.  Ranks stuck inside the failed
+            # stage's own collectives are instead unblocked by the
+            # jax.distributed coordination-service heartbeat once the
+            # failed rank's process exits (covered by
+            # tests/test_multihost.py's failure-injection tests).
+            try:
+                ok = _all_ranks_ok(err is None)
+            except Exception as barrier_err:
+                # The barrier collective itself can fail when another
+                # rank is inside a different collective or already died;
+                # the local stage error (if any) is the root cause and
+                # must not be masked by it.
+                if err is not None:
+                    raise err from barrier_err
+                raise
             if not ok and err is None:
                 raise RuntimeError(
-                    f"stage {stage.value} failed on the coordinator; "
+                    f"stage {stage.value} failed on another rank; "
                     "aborting this rank"
                 )
         if err is not None:
             raise err
-    if is_coord:
+    def _dump_metrics() -> None:
         with open(ctx.path("metrics.json"), "w") as f:
             json.dump(ctx.metrics, f, indent=1)
+
+    # metrics.json lands BEFORE publish so the delivered day dir carries
+    # the run's metrics — and so a failed delivery cannot lose them.
+    if is_coord:
+        _dump_metrics()
+    if publish and is_coord:
+        t0 = time.perf_counter()
+        info = publish_day(day_dir, publish)
+        ctx.emit(
+            {"stage": "publish",
+             "wall_s": round(time.perf_counter() - t0, 3), **info}
+        )
+        _dump_metrics()  # refresh the local copy with the publish record
     return ctx.metrics
 
 
@@ -466,6 +534,12 @@ def main(argv: list[str] | None = None) -> int:
         help="device mesh shape; MODEL>1 shards the vocabulary",
     )
     p.add_argument(
+        "--publish", default=None, metavar="DEST",
+        help="after all stages complete, deliver the day directory to "
+        "DEST: a local/NFS path (copied) or an scp-style host:path — "
+        "the reference's final scp to the UI node (ml_ops.sh:118-121)",
+    )
+    p.add_argument(
         "--multihost", action="store_true",
         help="initialize jax.distributed (one controller process per host; "
         "coordinator/process env via JAX_COORDINATOR_ADDRESS etc.) so the "
@@ -522,6 +596,7 @@ def main(argv: list[str] | None = None) -> int:
             mesh=mesh,
             vocab_sharded=vocab_sharded,
             online=args.online,
+            publish=args.publish,
         )
     return 0
 

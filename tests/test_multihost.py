@@ -147,6 +147,87 @@ run_pipeline(cfg, "20260102", "flow", mesh=make_mesh(data=4, model=1))
 """
 
 
+_RANK1_FAIL_WORKER = r"""
+import os, sys
+port, pid = sys.argv[1], int(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+from oni_ml_tpu.parallel import initialize_distributed, make_mesh
+initialize_distributed(f"localhost:{port}", 2, pid)
+import numpy as np
+from oni_ml_tpu.config import LDAConfig, PipelineConfig, ScoringConfig
+from oni_ml_tpu.runner import ml_ops
+
+flow = os.path.join(sys.argv[3], "flow.csv")
+if pid == 0:
+    rows = ["hdr"] + [
+        ",".join(["0"]*4 + ["1","2","3","0",f"10.0.0.{i%5}",f"10.0.1.{i%3}",
+                  "443","2000","0","0","0","0","5","100"] + ["0"]*9)
+        for i in range(64)
+    ]
+    with open(flow, "w") as f:
+        f.write("\n".join(rows) + "\n")
+if pid == 1:
+    # Fail BEFORE any collective inside the lda stage — the class of
+    # failure a one-to-all outcome broadcast cannot relay.
+    def boom(ctx):
+        raise OSError("rank1 cannot read shared model.dat")
+    ml_ops._STAGE_FNS[ml_ops.Stage.LDA] = boom
+cfg = PipelineConfig(
+    data_dir=sys.argv[3], flow_path=flow,
+    lda=LDAConfig(num_topics=3, em_max_iters=3, batch_size=32,
+                  min_bucket_len=64),
+    scoring=ScoringConfig(threshold=0.5),
+)
+ml_ops.run_pipeline(cfg, "20260103", "flow", mesh=make_mesh(data=4, model=1))
+"""
+
+
+def _run_pair(script, tmp_path, timeout=180):
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(port), str(pid),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)  # hang == the bug
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+def test_noncoordinator_precollective_failure_fails_all_ranks(tmp_path):
+    """Rank 1 raising inside stage_lda before its collectives must fail
+    the whole job, not hang it.  Two mechanisms cover this: the
+    all-gathered outcome flags relay the failure when the survivor has
+    reached the barrier, and the jax.distributed coordination-service
+    heartbeat errors a survivor stuck inside the stage's collectives
+    once the failed rank's process exits.  Either way both ranks must
+    terminate nonzero within the timeout."""
+    procs, outs = _run_pair(_RANK1_FAIL_WORKER, tmp_path)
+    # The survivor's collective errors via the runtime (heartbeat /
+    # mismatch detection) and the failed rank can die on a C++-level
+    # abort before Python prints — the contract is termination, not a
+    # specific message.
+    assert procs[0].returncode != 0, outs[0][-2000:]
+    assert procs[1].returncode != 0, outs[1][-2000:]
+
+
 def test_coordinator_stage_failure_fails_all_ranks(tmp_path):
     """A stage exception on the coordinator (bad flow_path) must
     propagate to every rank through the outcome barrier — not leave
@@ -177,7 +258,7 @@ def test_coordinator_stage_failure_fails_all_ranks(tmp_path):
                 p.kill()
     assert procs[0].returncode != 0, outs[0][-2000:]
     assert procs[1].returncode != 0, outs[1][-2000:]
-    assert "failed on the coordinator" in outs[1]
+    assert "failed on another rank" in outs[1]
 
 
 def test_coordinator_owns_shared_files(worker_runs):

@@ -116,6 +116,58 @@ def test_flow_pipeline_end_to_end(flow_day):
     assert other["num_topics"] == 4
 
 
+def test_publish_delivers_day_dir(flow_day):
+    """--publish: the completed day dir lands at DEST (the reference's
+    final scp to the UI node, ml_ops.sh:118-121)."""
+    cfg, tmp_path = flow_day
+    dest = tmp_path / "ui_node"
+    dest.mkdir()
+    metrics = run_pipeline(cfg, "20160122", "flow", publish=str(dest))
+    pub = [m for m in metrics if m.get("stage") == "publish"]
+    assert len(pub) == 1 and pub[0]["transport"] == "copy"
+    for name in ("flow_results.csv", "doc_results.csv", "final.beta",
+                 "metrics.json"):
+        assert (dest / "20160122" / name).exists(), name
+    for name in ("flow_results.csv", "doc_results.csv", "final.beta"):
+        src = (tmp_path / "20160122" / name).read_bytes()
+        assert (dest / "20160122" / name).read_bytes() == src
+    # delivered metrics cover all four stages; the local copy also
+    # records the publish step afterwards
+    import json as _json
+
+    delivered = _json.loads((dest / "20160122" / "metrics.json").read_text())
+    assert [m["stage"] for m in delivered] == ["pre", "corpus", "lda",
+                                               "score"]
+    local = _json.loads((tmp_path / "20160122" / "metrics.json").read_text())
+    assert local[-1]["stage"] == "publish"
+    # re-publish over an existing delivery is idempotent, not an error
+    run_pipeline(cfg, "20160122", "flow", publish=str(dest))
+
+
+def test_publish_remote_failure_raises(flow_day, monkeypatch):
+    import subprocess
+
+    from oni_ml_tpu.runner.ml_ops import publish_day
+
+    calls = {}
+
+    def fake_run(argv, capture_output, text):
+        calls["argv"] = argv
+
+        class R:
+            returncode = 1
+            stderr = "ssh: connect refused"
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="connect refused"):
+        publish_day("/data/20160122", "uinode:/var/oni")
+    assert calls["argv"] == ["scp", "-r", "/data/20160122", "uinode:/var/oni"]
+
+
 def test_flow_pipeline_resume_skips_done_stages(flow_day):
     cfg, tmp_path = flow_day
     run_pipeline(cfg, "20160122", "flow")
