@@ -47,9 +47,9 @@ def _sync(x):
 
 
 def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
-             force_sparse=False):
+             force_sparse=False, wmajor=True):
     """Production fused-EM throughput at (K, V, B, L); returns
-    (docs_per_sec, seconds_per_em_iter, used_dense).
+    (docs_per_sec, seconds_per_em_iter, used_dense, used_wmajor).
 
     chunk EM iterations run device-resident per host call; chunk=32
     amortizes the host<->device round-trip (which dominates at chunk=8
@@ -72,13 +72,18 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
     alpha = jnp.float32(2.5)
 
     use_dense = not force_sparse and dense_estep.available(b, v, k)
+    wmajor = wmajor and use_dense and (
+        dense_estep.pick_block_w(b, v, k) is not None
+    )
     compiler_options = None
     if use_dense:
         dense = jax.jit(
             lambda w, c: dense_estep.densify(w, c, v)
         )(word_idx, counts)
+        if wmajor:
+            dense = jnp.transpose(dense)
         groups = ((dense[None], doc_mask[None]),)
-        kib = dense_estep.scoped_vmem_kib(b, v, k)
+        kib = dense_estep.scoped_vmem_kib(b, v, k, wmajor=wmajor)
         compiler_options = {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
     else:
         groups = ((word_idx[None], counts[None], doc_mask[None]),)
@@ -87,6 +92,7 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         num_docs=b, num_topics=k, num_terms=v, chunk=chunk,
         var_max_iters=var_max_iters, var_tol=1e-6, em_tol=0.0,
         estimate_alpha=True, compiler_options=compiler_options,
+        dense_wmajor=wmajor,
     )
     res = run_chunk(log_beta, alpha, jnp.float32(np.nan), groups, chunk)
     _sync(res.lls[-1])
@@ -98,24 +104,30 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         ll = _sync(res.lls[-1])
         best = min(best, (time.perf_counter() - t0) / chunk)
     assert np.isfinite(ll)
-    return b / best, best, use_dense
+    return b / best, best, use_dense, wmajor
 
 
-def em_utilization(k, v, b, t_iter, var_max_iters=20):
+def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True):
     """Roofline accounting for one dense-path EM iteration.
 
     FLOPs: the kernel runs (var_max_iters VI iterations + 1 tail pass),
-    each two [B,K]x[K,W] contractions = 4*B*K*W flops; the MXU executes
-    them with K padded to the 128-lane tile.  HBM: the dense corpus
-    crosses once per EM iteration, beta re-reads once per doc block
-    (grid = B/bb blocks), plus model/outputs.
+    each two K-small matmuls of 2*B*K*W flops.  In the W-major layout
+    (the production default) the phinorm contraction pads K to the
+    128-lane tile while the gamma-update output pads K only to the
+    8-sublane granularity.  HBM: the dense corpus crosses once per EM
+    iteration, beta re-reads once per doc block (grid = B/bb blocks),
+    plus model/outputs.
     """
     from oni_ml_tpu.ops import dense_estep
 
     w = dense_estep.padded_width(v)
-    grid = b // (dense_estep.pick_block(b, v, k) or b)
+    pick = dense_estep.pick_block_w if wmajor else dense_estep.pick_block
+    grid = b // (pick(b, v, k) or b)
     flops_useful = 4.0 * b * k * w * (var_max_iters + 1)
-    flops_padded = flops_useful * (128.0 / k) if k < 128 else flops_useful
+    k_q = max(k, 128)                  # contraction pad (phinorm matmul)
+    # gamma-update matmul: K pads to 8 sublanes W-major, 128 lanes row-major
+    k_s = max(k, -(-k // 8) * 8) if wmajor else max(k, 128)
+    flops_padded = flops_useful * (k_q + k_s) / (2.0 * k)
     bytes_hbm = 4.0 * (b * w + b * k + (grid + 3) * k * w)
     return {
         "achieved_tflops": round(flops_useful / t_iter / 1e12, 2),
@@ -168,11 +180,15 @@ def bench_dns_scoring(n_events=400_000, reps=3):
 def main() -> int:
     # Headline: config-1 suspicious-connects scale.
     k1, v1, b1, l1 = 20, 8192, 4096, 128
-    docs_per_sec, t_iter, used_dense = bench_em(k1, v1, b1, l1)
-    util = em_utilization(k1, v1, b1, t_iter) if used_dense else {}
+    docs_per_sec, t_iter, used_dense, used_wmajor = bench_em(k1, v1, b1, l1)
+    util = (
+        em_utilization(k1, v1, b1, t_iter, wmajor=used_wmajor)
+        if used_dense
+        else {}
+    )
 
     # Config-3 scale (BASELINE.json: 50 topics, full vocabulary).
-    docs50k, _, dense50k = bench_em(50, 50_000, 2048, 128, rounds=3)
+    docs50k, _, dense50k, _ = bench_em(50, 50_000, 2048, 128, rounds=3)
 
     # DNS scoring stage (BASELINE.md "DNS scoring p50").
     score_eps, score_p50 = bench_dns_scoring()

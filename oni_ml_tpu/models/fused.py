@@ -79,22 +79,27 @@ def stack_batches(
     return StackedGroups(tuple(arrays), tuple(slots))
 
 
-def densify_groups(groups: StackedGroups, num_terms: int) -> StackedGroups:
+def densify_groups(
+    groups: StackedGroups, num_terms: int, wmajor: bool = False
+) -> StackedGroups:
     """Convert stacked sparse groups to dense-counts groups for the
     gather/scatter-free E-step (ops/dense_estep.py).
 
     Each group (word_idx [NB,B,L], counts [NB,B,L], mask [NB,B]) becomes
-    (dense_counts [NB,B,V], mask [NB,B]).  The scatter runs ONCE here and
-    is amortized over every EM iteration of the run — that amortization
-    is the whole point (a per-iteration scatter is what the dense path
-    exists to avoid)."""
+    (dense_counts [NB,B,V], mask [NB,B]) — or [NB,V,B] with `wmajor`,
+    the transposed layout the W-major kernel consumes.  The scatter runs
+    ONCE here and is amortized over every EM iteration of the run — that
+    amortization is the whole point (a per-iteration scatter is what the
+    dense path exists to avoid)."""
     from ..ops import dense_estep
+
+    def one(w, c):
+        d = dense_estep.densify(w, c, num_terms)
+        return d.T if wmajor else d
 
     arrays = []
     for widx, cnts, mask in groups.arrays:
-        dense = jax.jit(jax.vmap(
-            lambda w, c: dense_estep.densify(w, c, num_terms)
-        ))(widx, cnts)
+        dense = jax.jit(jax.vmap(one))(widx, cnts)
         arrays.append((dense, mask))
     return StackedGroups(tuple(arrays), groups.batch_slots)
 
@@ -131,6 +136,7 @@ def make_chunk_runner(
     e_step_fn: Callable | None = None,
     m_step_fn: Callable | None = None,
     compiler_options: dict | None = None,
+    dense_wmajor: bool = False,
 ):
     """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
     n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
@@ -162,6 +168,7 @@ def make_chunk_runner(
                         log_beta, alpha, dense, m,
                         var_max_iters=var_max_iters, var_tol=var_tol,
                         interpret=jax.default_backend() != "tpu",
+                        wmajor=dense_wmajor,
                     )
                 else:                          # sparse group: (w, c, mask)
                     w, c, m = batch
@@ -191,9 +198,17 @@ def make_chunk_runner(
         dtype = log_beta.dtype
         # Gamma buffers must exist in the carry before the first iteration
         # writes them; zeros are never read back (steps_done >= 1 whenever
-        # the caller uses gammas).
+        # the caller uses gammas).  The doc axis of a W-major dense group
+        # ([NB, W, B]) is the last one.
+        def batch_dim(g):
+            return (
+                g[0].shape[2]
+                if len(g) == 2 and dense_wmajor
+                else g[0].shape[1]
+            )
+
         gamma0 = tuple(
-            jnp.zeros((g[0].shape[0], g[0].shape[1], k), dtype)
+            jnp.zeros((g[0].shape[0], batch_dim(g), k), dtype)
             for g in groups
         )
         lls0 = jnp.zeros((chunk,), dtype)

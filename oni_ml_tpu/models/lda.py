@@ -517,10 +517,21 @@ class LDATrainer:
             batches, np.dtype(cfg.compute_dtype), put_stacked
         )
         compiler_options = None
-        if self._use_dense(batches):
+        use_dense = self._use_dense(batches)
+        use_wmajor = False
+        if use_dense:
             from ..ops import dense_estep
 
-            groups = fused.densify_groups(groups, self.num_terms)
+            # W-major needs the doc axis on the 128-lane dimension; fall
+            # back to row-major when any batch shape can't block that way.
+            use_wmajor = cfg.dense_wmajor and all(
+                dense_estep.pick_block_w(b.word_idx.shape[0],
+                                         self.num_terms, k)
+                for b in batches
+            )
+            groups = fused.densify_groups(
+                groups, self.num_terms, wmajor=use_wmajor
+            )
             # XLA drops the pallas kernel's own scoped-VMEM limit when the
             # call is fusion-wrapped inside a stacked-group scan; forward
             # the limit as a program-level compiler option instead.  The
@@ -528,7 +539,8 @@ class LDATrainer:
             # have no VMEM to limit).
             kibs = [
                 dense_estep.scoped_vmem_kib(b.word_idx.shape[0],
-                                            self.num_terms, k)
+                                            self.num_terms, k,
+                                            wmajor=use_wmajor)
                 for b in batches
             ]
             if any(kibs) and jax.default_backend() == "tpu":
@@ -547,6 +559,7 @@ class LDATrainer:
             e_step_fn=self._e_base,
             m_step_fn=self._m_base,
             compiler_options=compiler_options,
+            dense_wmajor=use_wmajor,
         )
 
         ll_prev_dev = jnp.asarray(

@@ -94,6 +94,42 @@ def test_dense_parity_vs_xla(b, l, v, k, n_masked):
     )
 
 
+@pytest.mark.parametrize(
+    "b,l,v,k,n_masked",
+    [(16, 32, 300, 4, 0), (32, 16, 130, 7, 5), (8, 8, 128, 3, 2)],
+)
+def test_wmajor_parity_vs_xla(b, l, v, k, n_masked):
+    """The W-major (transposed-corpus) kernel — the production default —
+    must match the sparse XLA reference exactly like the row-major one."""
+    rng = np.random.default_rng(b * 1000 + v + 1)
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked)
+    log_beta = _log_beta(rng, k, v)
+    alpha = jnp.float32(2.5)
+
+    ref = estep.e_step(
+        log_beta, alpha, word_idx, counts, doc_mask,
+        var_max_iters=20, var_tol=1e-6, backend="xla",
+    )
+    dense_t = dense_estep.densify(word_idx, counts, v).T
+    got = dense_estep.e_step_dense(
+        log_beta, alpha, dense_t, doc_mask,
+        var_max_iters=20, var_tol=1e-6, interpret=True, wmajor=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.gamma), np.asarray(ref.gamma), rtol=2e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.suff_stats), np.asarray(ref.suff_stats),
+        rtol=2e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(got.likelihood), float(ref.likelihood), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(got.alpha_ss), float(ref.alpha_ss), rtol=1e-4
+    )
+
+
 def test_masked_docs_are_inert():
     """A masked doc must contribute nothing to suff stats / likelihood and
     converge to gamma = alpha (its dense row is all zeros)."""
@@ -161,18 +197,31 @@ def test_fused_runner_dense_groups_match_sparse():
         num_docs=b - 2, num_topics=k, num_terms=v, chunk=4,
         var_max_iters=20, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
     )
+    run_w = fused.make_chunk_runner(
+        num_docs=b - 2, num_topics=k, num_terms=v, chunk=4,
+        var_max_iters=20, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
+        dense_wmajor=True,
+    )
+    wmajor_groups = ((dense.T[None], doc_mask[None]),)
     r_sparse = run(log_beta, alpha, jnp.float32(np.nan), sparse_groups, 4)
     r_dense = run(log_beta, alpha, jnp.float32(np.nan), dense_groups, 4)
+    r_wmajor = run_w(log_beta, alpha, jnp.float32(np.nan), wmajor_groups, 4)
 
+    for r in (r_dense, r_wmajor):
+        np.testing.assert_allclose(
+            np.asarray(r.lls), np.asarray(r_sparse.lls), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(r.log_beta), np.asarray(r_sparse.log_beta),
+            rtol=5e-3, atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            float(r.alpha), float(r_sparse.alpha), rtol=1e-3
+        )
+    # gammas come back doc-major from both dense layouts
     np.testing.assert_allclose(
-        np.asarray(r_dense.lls), np.asarray(r_sparse.lls), rtol=1e-4
-    )
-    np.testing.assert_allclose(
-        np.asarray(r_dense.log_beta), np.asarray(r_sparse.log_beta),
-        rtol=5e-3, atol=5e-3,
-    )
-    np.testing.assert_allclose(
-        float(r_dense.alpha), float(r_sparse.alpha), rtol=1e-3
+        np.asarray(r_wmajor.gammas[0]), np.asarray(r_dense.gammas[0]),
+        rtol=2e-3, atol=1e-3,
     )
 
 

@@ -232,30 +232,7 @@ def test_coordinator_stage_failure_fails_all_ranks(tmp_path):
     """A stage exception on the coordinator (bad flow_path) must
     propagate to every rank through the outcome barrier — not leave
     non-coordinators blocked in the next decision broadcast."""
-    port = _free_port()
-    env = {
-        k: v for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
-    }
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _ABORT_WORKER, str(port), str(pid),
-             str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in (0, 1)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)  # hang == old bug
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    procs, outs = _run_pair(_ABORT_WORKER, tmp_path)
     assert procs[0].returncode != 0, outs[0][-2000:]
     assert procs[1].returncode != 0, outs[1][-2000:]
     assert "failed on another rank" in outs[1]
