@@ -304,10 +304,16 @@ int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
   h->top.resize(n);
   h->word_id.resize(n);
 
-  std::unordered_map<uint64_t, int64_t> pos;
-  pos.reserve(n);
+  oni::FlatMap64 pos(n / 2);
   std::vector<int32_t> w_ip, w_w;
   std::vector<int64_t> w_c;
+
+  // The word is a pure function of (top, 5 bins, qtype, qrcode); unique
+  // combinations number far below the row count, so cache the interned
+  // id behind a packed integer key and skip the per-row string build.
+  // Packing limits (bins < 256, interner ids < 2048, top in 0..3) hold
+  // for any real day; rows beyond them fall back to building the word.
+  oni::FlatMap64 word_cache;
 
   std::string word;
   for (size_t i = 0; i < n; i++) {
@@ -319,35 +325,58 @@ int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
     int tp = dom_top[(size_t)h->dom_id[i]];
     h->top[i] = tp;
 
-    // word = top_blen_btime_bsub_bent_bper_type_rcode
-    // (dns_pre_lda.scala:320-327; raw type/rcode field text).
-    word.clear();
-    append_int(word, tp);
-    word += '_';
-    append_int(word, bl);
-    word += '_';
-    append_int(word, bt);
-    word += '_';
-    append_int(word, bs);
-    word += '_';
-    append_int(word, be);
-    word += '_';
-    append_int(word, bp);
-    word += '_';
-    word += h->qtypes.arena[(size_t)h->qtype_id[i]];
-    word += '_';
-    word += h->qrcodes.arena[(size_t)h->qrcode_id[i]];
-    int32_t wid = h->words.intern(word);
+    int32_t qt = h->qtype_id[i], qr = h->qrcode_id[i];
+    bool cacheable =
+        (unsigned)bt < 256 && (unsigned)bl < 256 && (unsigned)bs < 256 &&
+        (unsigned)be < 256 && (unsigned)bp < 256 && (unsigned)tp < 4 &&
+        (uint32_t)qt < 2048 && (uint32_t)qr < 2048;
+    uint64_t wkey = 0;
+    int64_t* wslot = nullptr;
+    bool fresh = true;
+    if (cacheable) {
+      wkey = ((uint64_t)tp << 62) | ((uint64_t)bt << 54) |
+             ((uint64_t)bl << 46) | ((uint64_t)bs << 38) |
+             ((uint64_t)be << 30) | ((uint64_t)bp << 22) |
+             ((uint64_t)(uint32_t)qt << 11) | (uint64_t)(uint32_t)qr;
+      if (wkey != oni::FlatMap64::EMPTY)
+        wslot = &word_cache.probe(wkey, &fresh);
+    }
+    int32_t wid;
+    if (!fresh) {
+      wid = (int32_t)*wslot;
+    } else {
+      // word = top_blen_btime_bsub_bent_bper_type_rcode
+      // (dns_pre_lda.scala:320-327; raw type/rcode field text).
+      word.clear();
+      append_int(word, tp);
+      word += '_';
+      append_int(word, bl);
+      word += '_';
+      append_int(word, bt);
+      word += '_';
+      append_int(word, bs);
+      word += '_';
+      append_int(word, be);
+      word += '_';
+      append_int(word, bp);
+      word += '_';
+      word += h->qtypes.arena[(size_t)h->qtype_id[i]];
+      word += '_';
+      word += h->qrcodes.arena[(size_t)h->qrcode_id[i]];
+      wid = h->words.intern(word);
+      if (wslot) *wslot = wid;
+    }
     h->word_id[i] = wid;
 
     uint64_t key = ((uint64_t)(uint32_t)h->ip_id[i] << 32) | (uint32_t)wid;
-    auto it = pos.emplace(key, (int64_t)w_c.size());
-    if (it.second) {
+    int64_t& slot = pos.probe(key, &fresh);
+    if (fresh) {
+      slot = (int64_t)w_c.size();
       w_ip.push_back(h->ip_id[i]);
       w_w.push_back(wid);
       w_c.push_back(1);
     } else {
-      w_c[(size_t)it.first->second]++;
+      w_c[(size_t)slot]++;
     }
   }
   h->wc_ip = std::move(w_ip);

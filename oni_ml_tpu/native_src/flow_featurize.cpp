@@ -188,10 +188,10 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
   h->dw_id.resize(n);
 
   // First-seen-order (doc, word) counts; src map emitted before dest
-  // (flow_pre_lda.scala:366-373 union order).
-  std::unordered_map<uint64_t, int64_t> src_pos, dst_pos;
-  src_pos.reserve(n);
-  dst_pos.reserve(n);
+  // (flow_pre_lda.scala:366-373 union order).  FlatMap64 (common.h):
+  // unordered_map's node churn made these four probes the hottest
+  // block of the whole pipeline.
+  oni::FlatMap64 src_pos(n / 2), dst_pos(n / 2);
   std::vector<int32_t> s_ip, s_w, d_ip, d_w;
   std::vector<int64_t> s_c, d_c;
 
@@ -200,9 +200,9 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
   // the millions, so cache (wp_id, bins) -> (base, prefixed) word ids and
   // skip the string building on the hot path.  Port doubles are keyed by
   // bit pattern (our NaNs are the single NAN constant from to_double).
-  std::unordered_map<uint64_t, int32_t> wp_cache;   // port bits -> wp_id
+  oni::FlatMap64 wp_cache;     // port bits -> wp_id
+  oni::FlatMap64 word_cache;   // wp_id+bins -> (base, prefixed) packed
   struct WordIds { int32_t base, prefixed; };
-  std::unordered_map<uint64_t, WordIds> word_cache; // wp_id+bins -> ids
 
   std::string word;
   for (size_t i = 0; i < n; i++) {
@@ -240,13 +240,16 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
 
     uint64_t wp_bits;
     memcpy(&wp_bits, &word_port, 8);
-    auto wpit = wp_cache.find(wp_bits);
     int32_t wp_id;
-    if (wpit != wp_cache.end()) {
-      wp_id = wpit->second;
-    } else {
+    if (wp_bits == oni::FlatMap64::EMPTY) {
+      // A hostile "-nan(0xf...f)" field bit-patterns to the map's empty
+      // sentinel; skip the cache (the interner still dedupes).
       wp_id = h->words.intern(jvm_double(word_port));
-      wp_cache.emplace(wp_bits, wp_id);
+    } else {
+      bool fresh;
+      int64_t& slot = wp_cache.probe(wp_bits, &fresh);
+      if (fresh) slot = h->words.intern(jvm_double(word_port));
+      wp_id = (int32_t)slot;
     }
 
     bool src_prefixed =
@@ -257,13 +260,17 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
     // Bins are bounded by the cut counts; ffz_finish rejects cut lists
     // that would overflow the 12-bit fields.  A wp_id past 28 bits
     // (>268M distinct port strings) skips the cache instead of aliasing.
-    bool cacheable = (uint32_t)wp_id < (1u << 28);
     uint64_t wkey = ((uint64_t)(uint32_t)wp_id << 36) |
                     ((uint64_t)tb << 24) | ((uint64_t)bb << 12) | (uint64_t)pb;
-    auto wit = cacheable ? word_cache.find(wkey) : word_cache.end();
+    bool cacheable = (uint32_t)wp_id < (1u << 28) &&
+                     wkey != oni::FlatMap64::EMPTY;
+    bool fresh = true;
+    int64_t* wslot = nullptr;
+    if (cacheable) wslot = &word_cache.probe(wkey, &fresh);
     WordIds wi;
-    if (wit != word_cache.end()) {
-      wi = wit->second;
+    if (!fresh) {
+      wi.base = (int32_t)(uint32_t)(*wslot >> 32);
+      wi.prefixed = (int32_t)(uint32_t)*wslot;
     } else {
       word.clear();
       word += h->words.arena[(size_t)wp_id];
@@ -275,7 +282,8 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
       word += jvm_double((double)pb);
       wi.base = h->words.intern(word);
       wi.prefixed = h->words.intern("-1_" + word);
-      if (cacheable) word_cache.emplace(wkey, wi);
+      if (wslot)
+        *wslot = ((int64_t)(uint32_t)wi.base << 32) | (uint32_t)wi.prefixed;
     }
     int32_t src_wid = src_prefixed ? wi.prefixed : wi.base;
     int32_t dst_wid = dst_prefixed ? wi.prefixed : wi.base;
@@ -285,23 +293,25 @@ int ffz_finish(void* hv, const double* tc, int ntc, const double* bc,
 
     uint64_t ks = ((uint64_t)(uint32_t)h->sip_id[i] << 32) |
                   (uint32_t)src_wid;
-    auto its = src_pos.emplace(ks, (int64_t)s_c.size());
-    if (its.second) {
+    int64_t& sslot = src_pos.probe(ks, &fresh);
+    if (fresh) {
+      sslot = (int64_t)s_c.size();
       s_ip.push_back(h->sip_id[i]);
       s_w.push_back(src_wid);
       s_c.push_back(1);
     } else {
-      s_c[(size_t)its.first->second]++;
+      s_c[(size_t)sslot]++;
     }
     uint64_t kd = ((uint64_t)(uint32_t)h->dip_id[i] << 32) |
                   (uint32_t)dst_wid;
-    auto itd = dst_pos.emplace(kd, (int64_t)d_c.size());
-    if (itd.second) {
+    int64_t& dslot = dst_pos.probe(kd, &fresh);
+    if (fresh) {
+      dslot = (int64_t)d_c.size();
       d_ip.push_back(h->dip_id[i]);
       d_w.push_back(dst_wid);
       d_c.push_back(1);
     } else {
-      d_c[(size_t)itd.first->second]++;
+      d_c[(size_t)dslot]++;
     }
   }
 
