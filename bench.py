@@ -47,7 +47,7 @@ def _sync(x):
 
 
 def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
-             force_sparse=False, wmajor=True):
+             force_sparse=False, wmajor=True, warm_start=False):
     """Production fused-EM throughput at (K, V, B, L); returns
     (docs_per_sec, seconds_per_em_iter, used_dense, used_wmajor).
 
@@ -92,7 +92,7 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         num_docs=b, num_topics=k, num_terms=v, chunk=chunk,
         var_max_iters=var_max_iters, var_tol=1e-6, em_tol=0.0,
         estimate_alpha=True, compiler_options=compiler_options,
-        dense_wmajor=wmajor,
+        dense_wmajor=wmajor, warm_start=warm_start and use_dense,
     )
     res = run_chunk(log_beta, alpha, jnp.float32(np.nan), groups, chunk)
     _sync(res.lls[-1])

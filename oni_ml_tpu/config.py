@@ -54,6 +54,14 @@ class LDAConfig:
     dense_em: str = "auto"
     # Device-byte ceiling for the densified corpus under dense_em="auto".
     dense_hbm_budget: int = 2 * 1024**3
+    # Warm-start each EM iteration's variational fixed point from the
+    # previous iteration's gamma instead of the reference's fresh
+    # alpha + N_d/K init (dense path only).  Reaches the same optimum —
+    # measured: identical EM iteration count and final likelihood to
+    # ~1e-6 relative on a structured 60k-doc corpus, ~5-20% faster —
+    # but per-iteration likelihood.dat values differ from fresh-start
+    # lda-c semantics in late decimals, hence opt-in.
+    warm_start_gamma: bool = False
     # Store the dense corpus transposed ([W, B]) so the gamma-update
     # matmul's small-K output axis pads to the 8-sublane granularity
     # instead of the 128-lane tile (measured ~1.2x on the EM iteration;

@@ -137,6 +137,7 @@ def make_chunk_runner(
     m_step_fn: Callable | None = None,
     compiler_options: dict | None = None,
     dense_wmajor: bool = False,
+    warm_start: bool = False,
 ):
     """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
     n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
@@ -150,16 +151,17 @@ def make_chunk_runner(
     m_fn = m_step_fn or estep.m_step
     k, v = num_topics, num_terms
 
-    def em_iteration(log_beta, alpha, groups):
+    def em_iteration(log_beta, alpha, groups, gammas_prev, warm):
         dtype = log_beta.dtype
         total_ss = jnp.zeros((v, k), dtype)
         total_ll = jnp.zeros((), dtype)
         total_ass = jnp.zeros((), dtype)
         gammas = []
-        for group in groups:
+        for group, g_prev in zip(groups, gammas_prev):
 
-            def scan_body(carry, batch):
+            def scan_body(carry, batch_and_gamma):
                 ss, ll, ass = carry
+                batch, g_in = batch_and_gamma
                 if len(batch) == 2:            # dense group: (C [B,V], mask)
                     from ..ops import dense_estep
 
@@ -169,6 +171,8 @@ def make_chunk_runner(
                         var_max_iters=var_max_iters, var_tol=var_tol,
                         interpret=jax.default_backend() != "tpu",
                         wmajor=dense_wmajor,
+                        gamma_prev=g_in if warm_start else None,
+                        warm=warm,
                     )
                 else:                          # sparse group: (w, c, mask)
                     w, c, m = batch
@@ -183,7 +187,7 @@ def make_chunk_runner(
                 )
 
             (total_ss, total_ll, total_ass), g = jax.lax.scan(
-                scan_body, (total_ss, total_ll, total_ass), group
+                scan_body, (total_ss, total_ll, total_ass), (group, g_prev)
             )
             gammas.append(g)
         new_beta = m_fn(total_ss)
@@ -218,9 +222,11 @@ def make_chunk_runner(
             return (step < jnp.minimum(n_steps, chunk)) & ~converged
 
         def body(state):
-            log_beta, alpha, ll_prev, step, lls, _, _ = state
+            log_beta, alpha, ll_prev, step, lls, _, gammas_prev = state
+            # Warm start only once this run has produced a gamma (step>0);
+            # the initial zeros buffers must never seed the fixed point.
             new_beta, new_alpha, ll, gammas = em_iteration(
-                log_beta, alpha, groups
+                log_beta, alpha, groups, gammas_prev, step > 0
             )
             # The first-ever iteration (ll_prev = nan) never stops — the
             # reference's "no previous likelihood" case.  The host recomputes

@@ -560,6 +560,7 @@ class LDATrainer:
             m_step_fn=self._m_base,
             compiler_options=compiler_options,
             dense_wmajor=use_wmajor,
+            warm_start=use_dense and cfg.warm_start_gamma,
         )
 
         ll_prev_dev = jnp.asarray(

@@ -138,17 +138,23 @@ def densify(word_idx, counts, num_terms: int):
 
 
 def _dense_kernel(
-    alpha_ref, beta_ref, c_ref, mask_ref,
+    alpha_ref, warm_ref, beta_ref, c_ref, mask_ref, gamma_in_ref,
     gamma_ref, t_ref, tokll_ref, iters_ref,
     *, var_max_iters: int, var_tol: float,
 ):
     """One grid step = one block of BB documents; C block, q, and ratio
-    stay in VMEM for the whole fixed point."""
+    stay in VMEM for the whole fixed point.
+
+    warm_ref selects the fixed point's start: 0 = the reference's fresh
+    init alpha + N_d/K (lda-c semantics), 1 = resume from gamma_in_ref
+    (the previous EM iteration's posterior — same fixed point, fewer
+    iterations once beta stabilizes; config knob warm_start_gamma)."""
     k_topics = beta_ref.shape[0]
     beta = beta_ref[...]                       # [K, V] exp(log_beta)
     c = c_ref[...]                             # [BB, V]
     mask = mask_ref[...]                       # [BB, 1]
     alpha = alpha_ref[0, 0]
+    warm = warm_ref[0, 0]
     n_d = jnp.sum(c, axis=1, keepdims=True)
 
     def e_log_theta(gamma):
@@ -180,9 +186,10 @@ def _dense_kernel(
         _, it, delta = state
         return jnp.logical_and(it < var_max_iters, delta > var_tol)
 
-    gamma0 = (alpha + n_d / k_topics) + jnp.zeros(
+    fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
         (c.shape[0], k_topics), c.dtype
     )
+    gamma0 = jnp.where(warm != 0, gamma_in_ref[...], fresh0)
     gamma, iters, _ = jax.lax.while_loop(
         cond,
         body,
@@ -209,7 +216,7 @@ def _dense_kernel(
 
 
 def _dense_kernel_w(
-    alpha_ref, beta_ref, ct_ref, mask_ref,
+    alpha_ref, warm_ref, beta_ref, ct_ref, mask_ref, gamma_in_ref,
     gamma_ref, t_ref, tokll_ref, iters_ref,
     *, var_max_iters: int, var_tol: float,
 ):
@@ -226,6 +233,7 @@ def _dense_kernel_w(
     ct = ct_ref[...]                           # [W, BB]
     mask = mask_ref[...]                       # [1, BB]
     alpha = alpha_ref[0, 0]
+    warm = warm_ref[0, 0]
     n_d = jnp.sum(ct, axis=0, keepdims=True)   # [1, BB]
 
     def e_log_theta_t(gamma_t):
@@ -258,9 +266,10 @@ def _dense_kernel_w(
         _, it, delta = state
         return jnp.logical_and(it < var_max_iters, delta > var_tol)
 
-    gamma0 = (alpha + n_d / k_topics) + jnp.zeros(
+    fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
         (k_topics, ct.shape[1]), ct.dtype
     )
+    gamma0 = jnp.where(warm != 0, gamma_in_ref[...], fresh0)
     gamma_t, iters, _ = jax.lax.while_loop(
         cond,
         body,
@@ -293,6 +302,8 @@ def dense_fixed_point_w(
     var_tol: float,
     block: int | None = None,
     interpret: bool = False,
+    gamma_prev=None,            # [B, K] warm start (None = fresh init)
+    warm=None,                  # traced scalar bool/int gating gamma_prev
 ):
     """W-major twin of dense_fixed_point; same returns."""
     k_topics, v = exp_beta.shape
@@ -313,16 +324,27 @@ def dense_fixed_point_w(
     kernel = functools.partial(
         _dense_kernel_w, var_max_iters=var_max_iters, var_tol=var_tol
     )
+    dtype = dense_counts_t.dtype
+    if gamma_prev is None:
+        gamma_in = jnp.zeros((k_topics, b), dtype)
+        warm = jnp.asarray(0, jnp.int32)
+    else:
+        gamma_in = jnp.asarray(gamma_prev, dtype).T
+        warm = jnp.asarray(warm, jnp.int32)
     gamma_t, t, tokll, iters = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec(
                 (k_topics, v), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec((v, bb), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bb), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (k_topics, bb), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
         ],
         out_specs=[
             pl.BlockSpec(
@@ -335,9 +357,9 @@ def dense_fixed_point_w(
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((k_topics, b), dense_counts_t.dtype),
-            jax.ShapeDtypeStruct((k_topics, v), dense_counts_t.dtype),
-            jax.ShapeDtypeStruct((1, b), dense_counts_t.dtype),
+            jax.ShapeDtypeStruct((k_topics, b), dtype),
+            jax.ShapeDtypeStruct((k_topics, v), dtype),
+            jax.ShapeDtypeStruct((1, b), dtype),
             jax.ShapeDtypeStruct((grid, 1), jnp.int32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -345,10 +367,12 @@ def dense_fixed_point_w(
         ),
         interpret=interpret,
     )(
-        jnp.reshape(jnp.asarray(alpha, dense_counts_t.dtype), (1, 1)),
+        jnp.reshape(jnp.asarray(alpha, dtype), (1, 1)),
+        jnp.reshape(warm, (1, 1)),
         exp_beta,
         dense_counts_t,
         jnp.reshape(doc_mask, (1, b)),
+        gamma_in,
     )
     return gamma_t.T, t, tokll[0], iters.max()
 
@@ -362,6 +386,8 @@ def dense_fixed_point(
     var_tol: float,
     block: int | None = None,
     interpret: bool = False,
+    gamma_prev=None,            # [B, K] warm start (None = fresh init)
+    warm=None,                  # traced scalar bool/int gating gamma_prev
 ):
     """Returns (gamma [B, K], T [K, V], tok_ll [B], iters scalar)."""
     k_topics, v = exp_beta.shape
@@ -380,16 +406,27 @@ def dense_fixed_point(
     kernel = functools.partial(
         _dense_kernel, var_max_iters=var_max_iters, var_tol=var_tol
     )
+    dtype = dense_counts.dtype
+    if gamma_prev is None:
+        gamma_in = jnp.zeros((b, k_topics), dtype)
+        warm = jnp.asarray(0, jnp.int32)
+    else:
+        gamma_in = jnp.asarray(gamma_prev, dtype)
+        warm = jnp.asarray(warm, jnp.int32)
     gamma, t, tokll, iters = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec(
                 (k_topics, v), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec((bb, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (bb, k_topics), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
         ],
         out_specs=[
             pl.BlockSpec(
@@ -403,9 +440,9 @@ def dense_fixed_point(
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, k_topics), dense_counts.dtype),
-            jax.ShapeDtypeStruct((k_topics, v), dense_counts.dtype),
-            jax.ShapeDtypeStruct((b, 1), dense_counts.dtype),
+            jax.ShapeDtypeStruct((b, k_topics), dtype),
+            jax.ShapeDtypeStruct((k_topics, v), dtype),
+            jax.ShapeDtypeStruct((b, 1), dtype),
             jax.ShapeDtypeStruct((grid, 1), jnp.int32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -413,10 +450,12 @@ def dense_fixed_point(
         ),
         interpret=interpret,
     )(
-        jnp.reshape(jnp.asarray(alpha, dense_counts.dtype), (1, 1)),
+        jnp.reshape(jnp.asarray(alpha, dtype), (1, 1)),
+        jnp.reshape(warm, (1, 1)),
         exp_beta,
         dense_counts,
         jnp.reshape(doc_mask, (b, 1)),
+        gamma_in,
     )
     return gamma, t, tokll[:, 0], iters.max()
 
@@ -431,6 +470,8 @@ def e_step_dense(
     block: int | None = None,
     interpret: bool = False,
     wmajor: bool = False,       # dense_counts is [W, B] (densify .T)
+    gamma_prev=None,            # [B, K] warm start (None = fresh init)
+    warm=None,                  # traced scalar gating gamma_prev
 ) -> estep.EStepResult:
     """estep.e_step semantics over a pre-densified batch.
 
@@ -446,7 +487,7 @@ def e_step_dense(
     fp = dense_fixed_point_w if wmajor else dense_fixed_point
     gamma, t, tok_ll, iters = fp(
         exp_beta, alpha, dense_counts, doc_mask, var_max_iters, var_tol,
-        block=block, interpret=interpret,
+        block=block, interpret=interpret, gamma_prev=gamma_prev, warm=warm,
     )
     suff = (exp_beta * t)[:, :v].T             # [V, K]
     likelihood, alpha_ss = estep.batch_likelihood_from_tok(
