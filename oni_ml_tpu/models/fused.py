@@ -80,7 +80,8 @@ def stack_batches(
 
 
 def densify_groups(
-    groups: StackedGroups, num_terms: int, wmajor: bool = False
+    groups: StackedGroups, num_terms: int, wmajor: bool = False,
+    put: Callable | None = None,
 ) -> StackedGroups:
     """Convert stacked sparse groups to dense-counts groups for the
     gather/scatter-free E-step (ops/dense_estep.py).
@@ -100,6 +101,8 @@ def densify_groups(
     arrays = []
     for widx, cnts, mask in groups.arrays:
         dense = jax.jit(jax.vmap(one))(widx, cnts)
+        if put is not None:  # e.g. shard the doc axis over a mesh
+            dense = put(dense)
         arrays.append((dense, mask))
     return StackedGroups(tuple(arrays), groups.batch_slots)
 
@@ -138,6 +141,7 @@ def make_chunk_runner(
     compiler_options: dict | None = None,
     dense_wmajor: bool = False,
     warm_start: bool = False,
+    dense_e_step_fn: Callable | None = None,
 ):
     """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
     n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
@@ -151,6 +155,19 @@ def make_chunk_runner(
     m_fn = m_step_fn or estep.m_step
     k, v = num_topics, num_terms
 
+    def _default_dense(log_beta, alpha, dense, m, g_in, warm):
+        from ..ops import dense_estep
+
+        return dense_estep.e_step_dense(
+            log_beta, alpha, dense, m,
+            var_max_iters=var_max_iters, var_tol=var_tol,
+            interpret=jax.default_backend() != "tpu",
+            wmajor=dense_wmajor,
+            gamma_prev=g_in, warm=warm,
+        )
+
+    dense_fn = dense_e_step_fn or _default_dense
+
     def em_iteration(log_beta, alpha, groups, gammas_prev, warm):
         dtype = log_beta.dtype
         total_ss = jnp.zeros((v, k), dtype)
@@ -163,17 +180,7 @@ def make_chunk_runner(
                 ss, ll, ass = carry
                 batch, g_in = batch_and_gamma
                 if len(batch) == 2:            # dense group: (C [B,V], mask)
-                    from ..ops import dense_estep
-
-                    dense, m = batch
-                    res = dense_estep.e_step_dense(
-                        log_beta, alpha, dense, m,
-                        var_max_iters=var_max_iters, var_tol=var_tol,
-                        interpret=jax.default_backend() != "tpu",
-                        wmajor=dense_wmajor,
-                        gamma_prev=g_in if warm_start else None,
-                        warm=warm,
-                    )
+                    res = dense_fn(log_beta, alpha, *batch, g_in, warm)
                 else:                          # sparse group: (w, c, mask)
                     w, c, m = batch
                     res = e_fn(
@@ -225,8 +232,9 @@ def make_chunk_runner(
             log_beta, alpha, ll_prev, step, lls, _, gammas_prev = state
             # Warm start only once this run has produced a gamma (step>0);
             # the initial zeros buffers must never seed the fixed point.
+            warm = (step > 0) if warm_start else jnp.asarray(False)
             new_beta, new_alpha, ll, gammas = em_iteration(
-                log_beta, alpha, groups, gammas_prev, step > 0
+                log_beta, alpha, groups, gammas_prev, warm
             )
             # The first-ever iteration (ll_prev = nan) never stops — the
             # reference's "no previous likelihood" case.  The host recomputes

@@ -430,13 +430,24 @@ class LDATrainer:
             gamma_out[b.doc_index[sel]] = g[sel]
         return log_beta, alpha, it
 
+    def _local_batch(self, batch) -> int:
+        """Documents each data shard's kernel sees for one batch."""
+        if self.mesh is None:
+            return batch.word_idx.shape[0]
+        from ..parallel.mesh import DATA_AXIS
+
+        return batch.word_idx.shape[0] // self.mesh.shape[DATA_AXIS]
+
     def _use_dense(self, batches) -> bool:
         """Decide whether the fused loop runs the dense-corpus E-step
-        (ops/dense_estep.py).  Auto mode requires: a TPU backend, no mesh
-        (the dense kernel is not yet shard_map-wrapped), the stock E-step
-        (a custom e_step_fn must not be silently bypassed), VMEM-feasible
-        doc blocks for every batch shape, and the densified corpus under
-        the HBM budget."""
+        (ops/dense_estep.py).  Auto mode requires: a TPU backend, full
+        (unsharded) vocabulary, the stock E-step or this package's own
+        data-parallel wrapper (a user's custom e_step_fn must not be
+        silently bypassed), VMEM-feasible doc blocks for every PER-SHARD
+        batch, and the densified corpus under the HBM budget.  With a
+        data mesh the kernel runs under shard_map
+        (parallel.make_data_parallel_dense_e_step), suff-stats psum'd
+        over ICI."""
         from ..ops import dense_estep
 
         env = os.environ.get("ONI_ML_TPU_ESTEP", "")
@@ -450,11 +461,12 @@ class LDATrainer:
             )
         if mode == "off":
             return False
+        own_parallel = getattr(self._e_base, "_oni_data_parallel", False)
         incompatible = (
-            "a mesh is set (the dense kernel is not shard_map-wrapped yet)"
-            if self.mesh is not None
+            "the vocabulary is sharded (the dense kernel needs full V)"
+            if self.vocab_sharded
             else "a custom e_step_fn is installed"
-            if self._e_base is not estep.e_step
+            if self._e_base is not estep.e_step and not own_parallel
             else None
         )
         if incompatible:
@@ -462,8 +474,10 @@ class LDATrainer:
                 raise ValueError(f"dense E-step forced but {incompatible}")
             return False
         k, v = self.config.num_topics, self.num_terms
+        # Feasibility is per data shard: each device's kernel sees its
+        # local slice of the batch.
         feasible = all(
-            dense_estep.pick_block(b.word_idx.shape[0], v, k) is not None
+            dense_estep.pick_block(self._local_batch(b), v, k) is not None
             for b in batches
         )
         if mode == "on":
@@ -475,14 +489,18 @@ class LDATrainer:
             return True
         # Peak device memory during densify_groups holds BOTH the sparse
         # stacked arrays (scatter inputs) and the dense output, so budget
-        # the sum, not just the dense corpus.
+        # the sum, not just the dense corpus.  The budget is per DEVICE:
+        # a data mesh shards the doc axis, dividing both terms.
+        shards = 1 if self.mesh is None else self.mesh.shape[
+            __import__("oni_ml_tpu.parallel.mesh", fromlist=["DATA_AXIS"]).DATA_AXIS
+        ]
         sparse_bytes = sum(
             b.word_idx.size * 8 for b in batches  # int32 idx + f32 counts
-        )
+        ) // shards
         return (
             feasible
             and jax.default_backend() == "tpu"
-            and fused.dense_groups_bytes(batches, v) + sparse_bytes
+            and fused.dense_groups_bytes(batches, v) // shards + sparse_bytes
             <= self.config.dense_hbm_budget
         )
 
@@ -519,18 +537,44 @@ class LDATrainer:
         compiler_options = None
         use_dense = self._use_dense(batches)
         use_wmajor = False
+        dense_e_fn = None
         if use_dense:
+            from functools import partial as _partial
+
             from ..ops import dense_estep
 
-            # W-major needs the doc axis on the 128-lane dimension; fall
-            # back to row-major when any batch shape can't block that way.
+            # Feasibility checks run against the PER-SHARD batch (each
+            # data shard's kernel sees its local slice).  W-major needs
+            # the doc axis on the 128-lane dimension; fall back to
+            # row-major when any batch shape can't block that way.
             use_wmajor = cfg.dense_wmajor and all(
-                dense_estep.pick_block_w(b.word_idx.shape[0],
+                dense_estep.pick_block_w(self._local_batch(b),
                                          self.num_terms, k)
                 for b in batches
             )
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel import sharded
+                from ..parallel.mesh import DATA_AXIS as _DA
+
+                dense_sh = NamedSharding(
+                    self.mesh,
+                    P(None, None, _DA) if use_wmajor else P(None, _DA),
+                )
+                dense_put = lambda x: jax.device_put(x, dense_sh)  # noqa: E731
+                dense_e_fn = _partial(
+                    sharded.make_data_parallel_dense_e_step(
+                        self.mesh, wmajor=use_wmajor
+                    ),
+                    var_max_iters=cfg.var_max_iters,
+                    var_tol=cfg.var_tol,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            else:
+                dense_put = None
             groups = fused.densify_groups(
-                groups, self.num_terms, wmajor=use_wmajor
+                groups, self.num_terms, wmajor=use_wmajor, put=dense_put
             )
             # XLA drops the pallas kernel's own scoped-VMEM limit when the
             # call is fusion-wrapped inside a stacked-group scan; forward
@@ -538,7 +582,7 @@ class LDATrainer:
             # option only exists on the TPU compiler (CPU interpret runs
             # have no VMEM to limit).
             kibs = [
-                dense_estep.scoped_vmem_kib(b.word_idx.shape[0],
+                dense_estep.scoped_vmem_kib(self._local_batch(b),
                                             self.num_terms, k,
                                             wmajor=use_wmajor)
                 for b in batches
@@ -561,6 +605,7 @@ class LDATrainer:
             compiler_options=compiler_options,
             dense_wmajor=use_wmajor,
             warm_start=use_dense and cfg.warm_start_gamma,
+            dense_e_step_fn=dense_e_fn,
         )
 
         ll_prev_dev = jnp.asarray(

@@ -32,9 +32,10 @@ Scale limits: the dense path needs C on device ([stacked docs] x V x 4
 bytes — the driver's dense_hbm_budget gates this) and a VMEM-feasible
 doc block (`pick_block`; the 50-topic/50k-vocab config-3 shape fits at
 BB=64).  Shapes beyond either limit fall back to the sparse Pallas/XLA
-paths (ops/pallas_estep.py); mesh runs (data-parallel or vocab-sharded)
-also take the sparse path today — composing this kernel with the
-shard_map'd E-step is future work.
+paths (ops/pallas_estep.py).  Data-parallel meshes keep this kernel:
+parallel.make_data_parallel_dense_e_step shard_maps it over the doc
+axis with suff-stats psum'd over ICI.  Vocab-sharded runs need the full
+V per device and take the sparse path.
 
 Reference anchor: this replaces oni-lda-c's per-document inner loop
 (SURVEY.md §2.8, §3.3) — `lda est` E-step semantics are preserved

@@ -65,6 +65,72 @@ def make_data_parallel_e_step(mesh: Mesh):
         )
         return fn(log_beta, alpha, word_idx, counts, doc_mask)
 
+    wrapped._oni_data_parallel = True  # lets the trainer's dense-mode
+    return wrapped                     # check recognize its own wrapper
+
+
+def make_data_parallel_dense_e_step(mesh: Mesh, wmajor: bool = False):
+    """Dense-corpus E-step (ops/dense_estep.py) over batch-sharded dense
+    counts: each data shard runs the MXU kernel on its local documents,
+    suff-stats/likelihood psum over ICI — the dense analogue of
+    make_data_parallel_e_step, so multi-chip runs keep the flagship
+    kernel instead of falling back to the sparse path.
+
+    `dense` is the full densified batch ([B, W] row-major or [W, B]
+    W-major); the local batch is B / data_size, so dense feasibility
+    (pick_block / pick_block_w) must be checked against the PER-SHARD
+    batch by the caller.  gamma_prev/warm thread the warm-start state
+    exactly as in the single-device path."""
+    from ..ops import dense_estep
+
+    batch_axis = 1 if wmajor else 0
+
+    def local(log_beta, alpha, dense, doc_mask, gamma_prev, warm,
+              var_max_iters, var_tol, interpret):
+        res = dense_estep.e_step_dense(
+            log_beta, alpha, dense, doc_mask,
+            var_max_iters=var_max_iters, var_tol=var_tol,
+            interpret=interpret, wmajor=wmajor,
+            gamma_prev=gamma_prev, warm=warm,
+        )
+        return estep.EStepResult(
+            gamma=res.gamma,
+            suff_stats=jax.lax.psum(res.suff_stats, DATA_AXIS),
+            alpha_ss=jax.lax.psum(res.alpha_ss, DATA_AXIS),
+            likelihood=jax.lax.psum(res.likelihood, DATA_AXIS),
+            vi_iters=jax.lax.pmax(res.vi_iters, DATA_AXIS),
+        )
+
+    dense_spec = (
+        P(None, DATA_AXIS) if wmajor else P(DATA_AXIS, None)
+    )
+
+    def wrapped(log_beta, alpha, dense, doc_mask, gamma_prev, warm,
+                var_max_iters, var_tol, interpret=False):
+        if dense.shape[batch_axis] % mesh.shape[DATA_AXIS]:
+            raise ValueError(
+                f"batch {dense.shape[batch_axis]} not divisible by data "
+                f"axis {mesh.shape[DATA_AXIS]}"
+            )
+        fn = jax.shard_map(
+            partial(local, var_max_iters=var_max_iters, var_tol=var_tol,
+                    interpret=interpret),
+            mesh=mesh,
+            in_specs=(P(), P(), dense_spec, P(DATA_AXIS), P(DATA_AXIS),
+                      P()),
+            out_specs=estep.EStepResult(
+                gamma=P(DATA_AXIS),
+                suff_stats=P(),
+                alpha_ss=P(),
+                likelihood=P(),
+                vi_iters=P(),
+            ),
+            # pallas_call's out_shape carries no varying-mesh-axes info,
+            # so shard_map's vma check cannot see through it.
+            check_vma=False,
+        )
+        return fn(log_beta, alpha, dense, doc_mask, gamma_prev, warm)
+
     return wrapped
 
 

@@ -284,13 +284,16 @@ def test_dense_em_typo_raises():
         trainer._use_dense([batch])
 
 
-def test_forced_dense_with_mesh_raises():
+def test_forced_dense_with_vocab_sharding_raises():
+    """Dense mode composes with a data mesh but needs the full
+    vocabulary; a vocab-sharded trainer must reject it loudly."""
     from oni_ml_tpu.models.lda import LDATrainer
     from oni_ml_tpu.parallel import make_mesh
 
-    mesh = make_mesh(data=2, model=1)
+    mesh = make_mesh(data=2, model=2)
     trainer = LDATrainer(
-        LDAConfig(num_topics=4, dense_em="on"), num_terms=200, mesh=mesh
+        LDAConfig(num_topics=4, dense_em="on"), num_terms=200, mesh=mesh,
+        vocab_sharded=True,
     )
     batch = Batch(
         word_idx=np.zeros((16, 8), np.int32),
@@ -298,8 +301,92 @@ def test_forced_dense_with_mesh_raises():
         doc_mask=np.ones((16,), np.float32),
         doc_index=np.arange(16),
     )
-    with pytest.raises(ValueError, match="mesh"):
+    with pytest.raises(ValueError, match="vocabulary is sharded"):
         trainer._use_dense([batch])
+
+
+def test_dense_sharded_matches_single_device():
+    """The shard_map'd dense E-step (data-parallel mesh) must reproduce
+    the single-device dense result: psum'd suff-stats/likelihood equal,
+    gamma identical per document."""
+    import jax
+
+    from oni_ml_tpu.parallel import make_mesh, sharded
+
+    rng = np.random.default_rng(31)
+    b, l, v, k = 32, 16, 260, 4
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked=3)
+    log_beta = _log_beta(rng, k, v)
+    alpha = jnp.float32(2.5)
+    dense = dense_estep.densify(word_idx, counts, v)
+
+    single = dense_estep.e_step_dense(
+        log_beta, alpha, dense, doc_mask,
+        var_max_iters=15, var_tol=1e-6, interpret=True,
+    )
+    mesh = make_mesh(data=4, model=1, devices=jax.devices()[:4])
+    fn = sharded.make_data_parallel_dense_e_step(mesh, wmajor=False)
+    got = fn(
+        log_beta, alpha, dense, doc_mask,
+        jnp.zeros((b, k), jnp.float32), jnp.asarray(0, jnp.int32),
+        var_max_iters=15, var_tol=1e-6, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.gamma), np.asarray(single.gamma),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.suff_stats), np.asarray(single.suff_stats),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(got.likelihood), float(single.likelihood), rtol=1e-6
+    )
+
+    # W-major layout through the same wrapper
+    fn_w = sharded.make_data_parallel_dense_e_step(mesh, wmajor=True)
+    got_w = fn_w(
+        log_beta, alpha, dense.T, doc_mask,
+        jnp.zeros((b, k), jnp.float32), jnp.asarray(0, jnp.int32),
+        var_max_iters=15, var_tol=1e-6, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_w.gamma), np.asarray(single.gamma),
+        rtol=2e-3, atol=1e-3,
+    )
+    np.testing.assert_allclose(
+        float(got_w.likelihood), float(single.likelihood), rtol=1e-5
+    )
+
+
+def test_trainer_dense_data_mesh_matches_unsharded():
+    """End-to-end: train_corpus with dense forced on a data mesh must
+    match the unsharded dense run's trajectory."""
+    import jax
+
+    from oni_ml_tpu.models import train_corpus
+    from oni_ml_tpu.parallel import make_mesh
+
+    import reference_lda as ref
+    from test_lda import corpus_from_docs
+
+    docs, _ = ref.make_synthetic_corpus(num_docs=64, num_terms=200,
+                                        num_topics=3, seed=8)
+    corpus = corpus_from_docs(docs, 200)
+    base = LDAConfig(num_topics=3, em_max_iters=6, em_tol=0.0,
+                     batch_size=32, min_bucket_len=64, seed=4,
+                     fused_em_chunk=3, dense_em="on")
+    mesh = make_mesh(data=4, model=1, devices=jax.devices()[:4])
+    res_mesh = train_corpus(corpus, base, mesh=mesh)
+    res_single = train_corpus(corpus, base)
+    np.testing.assert_allclose(
+        [ll for ll, _ in res_mesh.likelihoods],
+        [ll for ll, _ in res_single.likelihoods],
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        res_mesh.log_beta, res_single.log_beta, rtol=5e-3, atol=5e-3
+    )
 
 
 def test_env_dense_does_not_leak_into_auto_dispatch(monkeypatch):
