@@ -142,6 +142,7 @@ def make_chunk_runner(
     dense_wmajor: bool = False,
     warm_start: bool = False,
     dense_e_step_fn: Callable | None = None,
+    dense_precision: str = "f32",
 ):
     """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
     n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
@@ -163,7 +164,7 @@ def make_chunk_runner(
             var_max_iters=var_max_iters, var_tol=var_tol,
             interpret=jax.default_backend() != "tpu",
             wmajor=dense_wmajor,
-            gamma_prev=g_in, warm=warm,
+            gamma_prev=g_in, warm=warm, precision=dense_precision,
         )
 
     dense_fn = dense_e_step_fn or _default_dense

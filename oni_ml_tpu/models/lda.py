@@ -491,9 +491,12 @@ class LDATrainer:
         # stacked arrays (scatter inputs) and the dense output, so budget
         # the sum, not just the dense corpus.  The budget is per DEVICE:
         # a data mesh shards the doc axis, dividing both terms.
-        shards = 1 if self.mesh is None else self.mesh.shape[
-            __import__("oni_ml_tpu.parallel.mesh", fromlist=["DATA_AXIS"]).DATA_AXIS
-        ]
+        if self.mesh is None:
+            shards = 1
+        else:
+            from ..parallel.mesh import DATA_AXIS
+
+            shards = self.mesh.shape[DATA_AXIS]
         sparse_bytes = sum(
             b.word_idx.size * 8 for b in batches  # int32 idx + f32 counts
         ) // shards
@@ -565,7 +568,8 @@ class LDATrainer:
                 dense_put = lambda x: jax.device_put(x, dense_sh)  # noqa: E731
                 dense_e_fn = _partial(
                     sharded.make_data_parallel_dense_e_step(
-                        self.mesh, wmajor=use_wmajor
+                        self.mesh, wmajor=use_wmajor,
+                        precision=cfg.dense_precision,
                     ),
                     var_max_iters=cfg.var_max_iters,
                     var_tol=cfg.var_tol,
@@ -606,6 +610,7 @@ class LDATrainer:
             dense_wmajor=use_wmajor,
             warm_start=use_dense and cfg.warm_start_gamma,
             dense_e_step_fn=dense_e_fn,
+            dense_precision=cfg.dense_precision,
         )
 
         ll_prev_dev = jnp.asarray(

@@ -69,7 +69,8 @@ def make_data_parallel_e_step(mesh: Mesh):
     return wrapped                     # check recognize its own wrapper
 
 
-def make_data_parallel_dense_e_step(mesh: Mesh, wmajor: bool = False):
+def make_data_parallel_dense_e_step(mesh: Mesh, wmajor: bool = False,
+                                    precision: str = "f32"):
     """Dense-corpus E-step (ops/dense_estep.py) over batch-sharded dense
     counts: each data shard runs the MXU kernel on its local documents,
     suff-stats/likelihood psum over ICI — the dense analogue of
@@ -91,7 +92,7 @@ def make_data_parallel_dense_e_step(mesh: Mesh, wmajor: bool = False):
             log_beta, alpha, dense, doc_mask,
             var_max_iters=var_max_iters, var_tol=var_tol,
             interpret=interpret, wmajor=wmajor,
-            gamma_prev=gamma_prev, warm=warm,
+            gamma_prev=gamma_prev, warm=warm, precision=precision,
         )
         return estep.EStepResult(
             gamma=res.gamma,

@@ -533,3 +533,46 @@ def test_fused_warm_start_matches_fresh_trajectory():
         np.exp(np.asarray(runs[False].log_beta)),
         rtol=1e-2, atol=1e-5,
     )
+
+
+@pytest.mark.parametrize("wmajor", [False, True])
+def test_bf16_precision_close_and_validated(wmajor):
+    """dense_precision="bf16" stores the fixed-point matmul operands in
+    bfloat16.  On TPU that is bit-identical to the default (XLA's
+    DEFAULT matmul precision already truncates f32 MXU inputs to bf16);
+    on the CPU test backend it emulates that truncation, so the result
+    must track the exact-f32 path within bf16 input-rounding error
+    while the f32 tail keeps the likelihood tight."""
+    rng = np.random.default_rng(5)
+    b, l, v, k = 16, 32, 260, 5
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v)
+    log_beta = _log_beta(rng, k, v)
+    dense = dense_estep.densify(word_idx, counts, v)
+    dense = dense.T if wmajor else dense
+
+    kw = dict(var_max_iters=20, var_tol=1e-6, interpret=True,
+              wmajor=wmajor)
+    exact = dense_estep.e_step_dense(
+        log_beta, jnp.float32(2.5), dense, doc_mask, **kw
+    )
+    half = dense_estep.e_step_dense(
+        log_beta, jnp.float32(2.5), dense, doc_mask,
+        precision="bf16", **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(half.gamma), np.asarray(exact.gamma),
+        rtol=5e-2, atol=5e-2,
+    )
+    np.testing.assert_allclose(
+        float(half.likelihood), float(exact.likelihood), rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(half.suff_stats), np.asarray(exact.suff_stats),
+        rtol=0.1, atol=5e-3,
+    )
+
+    with pytest.raises(ValueError, match="dense E-step precision"):
+        dense_estep.e_step_dense(
+            log_beta, jnp.float32(2.5), dense, doc_mask,
+            precision="fp8", **kw
+        )
