@@ -79,7 +79,7 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
 
     use_dense = not force_sparse and dense_estep.available(b, v, k)
     wmajor = wmajor and use_dense and (
-        dense_estep.pick_block_w(b, v, k) is not None
+        dense_estep.pick_block_w(b, v, k, precision) is not None
     )
     compiler_options = None
     if use_dense:
@@ -89,7 +89,8 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         if wmajor:
             dense = jnp.transpose(dense)
         groups = ((dense[None], doc_mask[None]),)
-        kib = dense_estep.scoped_vmem_kib(b, v, k, wmajor=wmajor)
+        kib = dense_estep.scoped_vmem_kib(b, v, k, wmajor=wmajor,
+                                          precision=precision)
         compiler_options = {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
     else:
         groups = ((word_idx[None], counts[None], doc_mask[None]),)

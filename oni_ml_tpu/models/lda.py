@@ -477,7 +477,9 @@ class LDATrainer:
         # Feasibility is per data shard: each device's kernel sees its
         # local slice of the batch.
         feasible = all(
-            dense_estep.pick_block(self._local_batch(b), v, k) is not None
+            dense_estep.pick_block(self._local_batch(b), v, k,
+                                   self.config.dense_precision)
+            is not None
             for b in batches
         )
         if mode == "on":
@@ -552,7 +554,8 @@ class LDATrainer:
             # row-major when any batch shape can't block that way.
             use_wmajor = cfg.dense_wmajor and all(
                 dense_estep.pick_block_w(self._local_batch(b),
-                                         self.num_terms, k)
+                                         self.num_terms, k,
+                                         cfg.dense_precision)
                 for b in batches
             )
             if self.mesh is not None:
@@ -588,7 +591,8 @@ class LDATrainer:
             kibs = [
                 dense_estep.scoped_vmem_kib(self._local_batch(b),
                                             self.num_terms, k,
-                                            wmajor=use_wmajor)
+                                            wmajor=use_wmajor,
+                                            precision=cfg.dense_precision)
                 for b in batches
             ]
             if any(kibs) and jax.default_backend() == "tpu":

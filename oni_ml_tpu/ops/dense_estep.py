@@ -57,9 +57,13 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.scipy.special import gammaln
 
 from . import estep
-from .pallas_estep import digamma_pos
+# newton_recip: the [BB, V] ratio = C/q divide was ~2/3 of the
+# fixed-point body's time (7.1 -> 2.1 us per iteration per 128-doc
+# block at V=8192, K=20); the matmuls themselves run at ~35 TF/s.
+from .pallas_estep import digamma_pos, gammaln_pos, newton_recip as _recip
 
 # VMEM working-set model: double-buffered C block + q + ratio (each
 # [BB, V] f32) + beta and the T accumulator (each [K, V] f32), plus
@@ -80,19 +84,6 @@ def _check_precision(precision: str) -> None:
         )
 
 
-def _recip(q: jnp.ndarray) -> jnp.ndarray:
-    """Newton-polished VPU reciprocal: approximate hardware reciprocal
-    (~1.6e-5 max rel error on v5e) plus one Newton step, landing at
-    ~1.4e-7 — about 1 ulp of f32, i.e. numerically interchangeable with
-    the exact divide.  The [BB, V] ratio = C/q divide was ~2/3 of the
-    fixed-point body's time (measured 7.1 -> 2.1 us per iteration per
-    128-doc block at V=8192, K=20); the matmuls themselves run at ~35
-    TF/s.  Interpret mode (CPU tests) computes the exact reciprocal, so
-    the polish is a no-op there."""
-    r0 = pl.reciprocal(q, approx=True)
-    return r0 * (2.0 - q * r0)
-
-
 def _cast_for(precision: str):
     """Matmul-operand cast for the fixed-point iterations.  "bf16" is a
     VMEM-bandwidth optimization, not a numerics trade on TPU: XLA's
@@ -108,47 +99,54 @@ def _cast_for(precision: str):
     return (lambda x: x.astype(dt)) if dt else (lambda x: x)
 
 
-def _vmem_estimate(bb: int, v: int, k: int) -> int:
-    return (4 * bb * v + 2 * k * v) * 4
+def _vmem_estimate(bb: int, v: int, k: int, precision: str = "f32") -> int:
+    est = (4 * bb * v + 2 * k * v) * 4
+    if precision == "bf16":
+        # bf16 copies of the ratio block, exp_et, and beta live alongside
+        # their f32 originals during the fixed point.
+        est += (bb * v + bb * k + k * v) * 2
+    return est
 
 
-def _vmem_limit(bb: int, v: int, k: int) -> int:
+def _vmem_limit(bb: int, v: int, k: int, precision: str = "f32") -> int:
     # Mosaic's real stack allocation runs ~1.6x the modeled working set
     # (measured: 56.2MB actual vs 34.9MB modeled at BB=256, V=8192, K=20);
     # 2x keeps headroom without hitting the 128MB physical VMEM.
-    est = _vmem_estimate(bb, v, k)
+    est = _vmem_estimate(bb, v, k, precision)
     return min(max(32 * 1024 * 1024, est * 2), 128 * 1024 * 1024)
 
 
-def scoped_vmem_kib(b: int, v: int, k: int,
-                    wmajor: bool = False) -> int | None:
+def scoped_vmem_kib(b: int, v: int, k: int, wmajor: bool = False,
+                    precision: str = "f32") -> int | None:
     """Scoped-VMEM KiB the dense kernel needs at pick_block's block size —
     for drivers to pass as the xla_tpu_scoped_vmem_limit_kib compiler
     option.  Needed because XLA drops the pallas_call's own
     CompilerParams vmem limit when the kernel is fusion-wrapped inside a
     multi-batch lax.scan (observed: a [NB>=2] stacked group compiles the
     kernel as kCustom fusion with the default 16MB scoped limit)."""
-    bb = pick_block_w(b, v, k) if wmajor else pick_block(b, v, k)
+    pick = pick_block_w if wmajor else pick_block
+    bb = pick(b, v, k, precision)
     if bb is None:
         return None
-    return _vmem_limit(bb, padded_width(v), k) // 1024
+    return _vmem_limit(bb, padded_width(v), k, precision) // 1024
 
 
-def pick_block(b: int, v: int, k: int) -> int | None:
+def pick_block(b: int, v: int, k: int, precision: str = "f32") -> int | None:
     """Largest power-of-two doc block (<= 256) dividing `b` whose
     estimated working set fits the VMEM ceiling.  None = infeasible."""
     w = padded_width(v)
     bb = 8
     best = None
     while bb <= min(b, 256) and b % bb == 0:
-        if _vmem_estimate(bb, w, k) > _VMEM_CEILING:
+        if _vmem_estimate(bb, w, k, precision) > _VMEM_CEILING:
             break
         best = bb
         bb *= 2
     return best
 
 
-def pick_block_w(b: int, v: int, k: int) -> int | None:
+def pick_block_w(b: int, v: int, k: int,
+                 precision: str = "f32") -> int | None:
     """Doc block for the W-major layout.  The doc axis is the LANE
     dimension of the C^T block there, so Mosaic requires it divisible by
     128 — or equal to the full batch (single-block grid).  None =
@@ -157,11 +155,13 @@ def pick_block_w(b: int, v: int, k: int) -> int | None:
     best = None
     bb = 128
     while bb <= min(b, 256) and b % bb == 0:
-        if _vmem_estimate(bb, w, k) > _VMEM_CEILING:
+        if _vmem_estimate(bb, w, k, precision) > _VMEM_CEILING:
             break
         best = bb
         bb *= 2
-    if best is None and b <= 256 and _vmem_estimate(b, w, k) <= _VMEM_CEILING:
+    if best is None and b <= 256 and (
+        _vmem_estimate(b, w, k, precision) <= _VMEM_CEILING
+    ):
         best = b  # block == full array: any lane extent is legal
     return best
 
@@ -187,7 +187,7 @@ def densify(word_idx, counts, num_terms: int):
 
 def _dense_kernel(
     alpha_ref, warm_ref, beta_ref, c_ref, mask_ref, gamma_in_ref,
-    gamma_ref, t_ref, tokll_ref, iters_ref,
+    gamma_ref, t_ref, docll_ref, ass_ref, iters_ref,
     *, var_max_iters: int, var_tol: float, precision: str = "f32",
 ):
     """One grid step = one block of BB documents; C block, q, and ratio
@@ -249,14 +249,23 @@ def _dense_kernel(
     )
 
     # Converged single-pass tail, all while C is still VMEM-resident:
-    # token ELBO term sum_v C*log(q) and the suff-stats factor T.
-    # Always full f32 off the converged gamma, whatever the iteration
-    # precision was.
-    exp_et = jnp.exp(e_log_theta(gamma))
+    # suff-stats factor T plus the ELBO's per-doc terms — the token term
+    # sum_v C*log(q) AND the gamma-Dirichlet terms (digamma/gammaln),
+    # computed here where the doc axis rides the vector lanes instead of
+    # on the XLA side's [B, K] layout (K=20 padded to 128 lanes made
+    # those transcendentals ~0.4 ms of every EM iteration).  Always full
+    # f32 off the converged gamma, whatever the iteration precision was.
+    e_lt = e_log_theta(gamma)
+    exp_et = jnp.exp(e_lt)
     q = qmat(exp_et, beta)
     ratio = (c * _recip(q)) * mask
     gamma_ref[...] = gamma
-    tokll_ref[...] = jnp.sum(c * jnp.log(q), axis=1, keepdims=True) * mask
+    tok = jnp.sum(c * jnp.log(q), axis=1, keepdims=True)
+    core = jnp.sum(
+        (alpha - gamma) * e_lt + gammaln_pos(gamma), axis=1, keepdims=True
+    ) - gammaln_pos(jnp.sum(gamma, axis=1, keepdims=True))
+    docll_ref[...] = (core + tok) * mask
+    ass_ref[...] = jnp.sum(e_lt, axis=1, keepdims=True) * mask
     t_part = jax.lax.dot_general(              # [K, BB] @ [BB, V]
         exp_et * mask, ratio, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -272,7 +281,7 @@ def _dense_kernel(
 
 def _dense_kernel_w(
     alpha_ref, warm_ref, beta_ref, ct_ref, mask_ref, gamma_in_ref,
-    gamma_ref, t_ref, tokll_ref, iters_ref,
+    gamma_ref, t_ref, docll_ref, ass_ref, iters_ref,
     *, var_max_iters: int, var_tol: float, precision: str = "f32",
 ):
     """W-major variant of _dense_kernel: the corpus block rides as
@@ -335,12 +344,21 @@ def _dense_kernel_w(
         (gamma0, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, ct.dtype)),
     )
 
-    # f32 tail off the converged gamma (see _dense_kernel).
-    exp_et_t = jnp.exp(e_log_theta_t(gamma_t))
+    # f32 tail off the converged gamma: suff-stats factor plus the full
+    # per-doc ELBO terms in the lane-efficient [K, BB] layout (see
+    # _dense_kernel).
+    e_lt = e_log_theta_t(gamma_t)
+    exp_et_t = jnp.exp(e_lt)
     q_t = qmat_t(exp_et_t, beta)
     ratio_t = (ct * _recip(q_t)) * mask
     gamma_ref[...] = gamma_t
-    tokll_ref[...] = jnp.sum(ct * jnp.log(q_t), axis=0, keepdims=True) * mask
+    tok = jnp.sum(ct * jnp.log(q_t), axis=0, keepdims=True)
+    core = jnp.sum(
+        (alpha - gamma_t) * e_lt + gammaln_pos(gamma_t),
+        axis=0, keepdims=True,
+    ) - gammaln_pos(jnp.sum(gamma_t, axis=0, keepdims=True))
+    docll_ref[...] = (core + tok) * mask
+    ass_ref[...] = jnp.sum(e_lt, axis=0, keepdims=True) * mask
     t_part = jax.lax.dot_general(                    # [K, BB] x [W, BB]
         exp_et_t * mask, ratio_t, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -370,7 +388,7 @@ def dense_fixed_point_w(
     """W-major twin of dense_fixed_point; same returns."""
     k_topics, v = exp_beta.shape
     b = dense_counts_t.shape[1]
-    bb = block or pick_block_w(b, v, k_topics)
+    bb = block or pick_block_w(b, v, k_topics, precision)
     if bb is None:
         raise ValueError(
             f"no W-major-feasible doc block for B={b}, V={v}, K={k_topics} "
@@ -394,7 +412,7 @@ def dense_fixed_point_w(
     else:
         gamma_in = jnp.asarray(gamma_prev, dtype).T
         warm = jnp.asarray(warm, jnp.int32)
-    gamma_t, t, tokll, iters = pl.pallas_call(
+    gamma_t, t, docll, ass, iters = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[
@@ -417,16 +435,18 @@ def dense_fixed_point_w(
                 (k_topics, v), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec((1, bb), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bb), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((k_topics, b), dtype),
             jax.ShapeDtypeStruct((k_topics, v), dtype),
             jax.ShapeDtypeStruct((1, b), dtype),
+            jax.ShapeDtypeStruct((1, b), dtype),
             jax.ShapeDtypeStruct((grid, 1), jnp.int32),
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_vmem_limit(bb, v, k_topics)
+            vmem_limit_bytes=_vmem_limit(bb, v, k_topics, precision)
         ),
         interpret=interpret,
     )(
@@ -437,7 +457,7 @@ def dense_fixed_point_w(
         jnp.reshape(doc_mask, (1, b)),
         gamma_in,
     )
-    return gamma_t.T, t, tokll[0], iters.max()
+    return gamma_t.T, t, docll[0], ass[0], iters.max()
 
 
 def dense_fixed_point(
@@ -456,7 +476,7 @@ def dense_fixed_point(
     """Returns (gamma [B, K], T [K, V], tok_ll [B], iters scalar)."""
     k_topics, v = exp_beta.shape
     b = dense_counts.shape[0]
-    bb = block or pick_block(b, v, k_topics)
+    bb = block or pick_block(b, v, k_topics, precision)
     if bb is None:
         raise ValueError(
             f"no VMEM-feasible doc block for B={b}, V={v}, K={k_topics}"
@@ -478,7 +498,7 @@ def dense_fixed_point(
     else:
         gamma_in = jnp.asarray(gamma_prev, dtype)
         warm = jnp.asarray(warm, jnp.int32)
-    gamma, t, tokll, iters = pl.pallas_call(
+    gamma, t, docll, ass, iters = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[
@@ -502,16 +522,18 @@ def dense_fixed_point(
                 (k_topics, v), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, k_topics), dtype),
             jax.ShapeDtypeStruct((k_topics, v), dtype),
             jax.ShapeDtypeStruct((b, 1), dtype),
+            jax.ShapeDtypeStruct((b, 1), dtype),
             jax.ShapeDtypeStruct((grid, 1), jnp.int32),
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_vmem_limit(bb, v, k_topics)
+            vmem_limit_bytes=_vmem_limit(bb, v, k_topics, precision)
         ),
         interpret=interpret,
     )(
@@ -522,7 +544,7 @@ def dense_fixed_point(
         jnp.reshape(doc_mask, (b, 1)),
         gamma_in,
     )
-    return gamma, t, tokll[:, 0], iters.max()
+    return gamma, t, docll[:, 0], ass[:, 0], iters.max()
 
 
 def e_step_dense(
@@ -552,15 +574,19 @@ def e_step_dense(
     if w != v:
         exp_beta = jnp.pad(exp_beta, ((0, 0), (0, w - v)))
     fp = dense_fixed_point_w if wmajor else dense_fixed_point
-    gamma, t, tok_ll, iters = fp(
+    gamma, t, docll, ass, iters = fp(
         exp_beta, alpha, dense_counts, doc_mask, var_max_iters, var_tol,
         block=block, interpret=interpret, gamma_prev=gamma_prev, warm=warm,
         precision=precision,
     )
     suff = (exp_beta * t)[:, :v].T             # [V, K]
-    likelihood, alpha_ss = estep.batch_likelihood_from_tok(
-        gamma, tok_ll, alpha, doc_mask
-    )
+    # The kernel emits the per-doc ELBO terms (token + gamma-Dirichlet)
+    # and sum_k E[log theta]; only the alpha-prior constant — identical
+    # for every real doc — remains for the host-side sum.
+    k_topics = log_beta.shape[0]
+    alpha_const = gammaln(k_topics * alpha) - k_topics * gammaln(alpha)
+    likelihood = docll.sum() + doc_mask.sum() * alpha_const
+    alpha_ss = ass.sum()
     return estep.EStepResult(gamma, suff, alpha_ss, likelihood, iters)
 
 

@@ -57,6 +57,40 @@ def _vmem_estimate(bb: int, l: int, k: int) -> int:
     return 2 * k * bb * l * 4 + 2 * k * bb * 128 * 4
 
 
+def newton_recip(q: jnp.ndarray) -> jnp.ndarray:
+    """Newton-polished VPU reciprocal: the hardware's approximate
+    reciprocal (~1.6e-5 max rel error on v5e) plus one Newton step,
+    landing ~1.4e-7 — about 1 ulp of f32, i.e. numerically
+    interchangeable with the exact divide at a third of its cost (the
+    vector divide dominated the fixed-point bodies).  Interpret mode
+    (CPU tests) computes the exact reciprocal, so the polish is a
+    no-op there."""
+    r0 = pl.reciprocal(q, approx=True)
+    return r0 * (2.0 - q * r0)
+
+
+def gammaln_pos(x: jnp.ndarray) -> jnp.ndarray:
+    """log Gamma(x) for strictly positive x, f32-accurate, elementwise
+    VPU ops only (usable inside Pallas kernels).  Same recurrence-shift
+    structure as digamma_pos: push x above 6 while accumulating the
+    product Gamma(x+n)/Gamma(x) = x(x+1)...(x+n-1), then Stirling."""
+    prod = jnp.ones_like(x)
+    for _ in range(7):
+        small = x < 6.0
+        prod = prod * jnp.where(small, x, 1.0)
+        x = x + jnp.where(small, 1.0, 0.0)
+    inv = 1.0 / x
+    inv2 = inv * inv
+    # 0.5*log(2*pi)
+    series = (
+        (x - 0.5) * jnp.log(x)
+        - x
+        + 0.9189385332046727
+        + inv * (1.0 / 12.0 - inv2 * (1.0 / 360.0 - inv2 / 1260.0))
+    )
+    return series - jnp.log(prod)
+
+
 def digamma_pos(x: jnp.ndarray) -> jnp.ndarray:
     """digamma for strictly positive x, f32-accurate.  Works inside
     Pallas kernels (elementwise VPU ops only)."""
@@ -98,7 +132,7 @@ def _fixed_point_kernel(
         phinorm = jnp.zeros_like(counts)
         for k in range(k_topics):               # K-unrolled VPU reduction
             phinorm = phinorm + slab_ref[k] * exp_et[:, k : k + 1]
-        ratio = counts / (phinorm + 1e-30)
+        ratio = counts * newton_recip(phinorm + 1e-30)
         cols = []
         for k in range(k_topics):
             t = jnp.sum(ratio * slab_ref[k], axis=1, keepdims=True)

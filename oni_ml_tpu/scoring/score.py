@@ -20,7 +20,7 @@ DnsFeatures) instead of re-running it the way the post scripts do.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 
 import jax
@@ -40,6 +40,12 @@ class ScoringModel:
     theta: np.ndarray            # [D+1, K], row D = fallback
     word_index: dict[str, int]
     p: np.ndarray                # [V+1, K], row V = fallback
+    # Lazy sorted-key lookup tables: (keys U-array sorted, rows int32).
+    # ip_rows/word_rows over the featurizer's interned tables (hundreds
+    # of thousands of uniques on a real day) ran a Python dict.get per
+    # key; a vectorized searchsorted is ~20x that.
+    _ip_lut: tuple | None = field(default=None, init=False, repr=False)
+    _word_lut: tuple | None = field(default=None, init=False, repr=False)
 
     @property
     def num_topics(self) -> int:
@@ -79,16 +85,54 @@ class ScoringModel:
         return cls.from_results(doc_names, doc_topic, vocab, word_topic, fallback)
 
     def ip_rows(self, ips: list[str]) -> np.ndarray:
-        fb = len(self.ip_index)
-        return np.fromiter(
-            (self.ip_index.get(ip, fb) for ip in ips), np.int32, len(ips)
-        )
+        if self._ip_lut is None:
+            self._ip_lut = _make_lut(self.ip_index)
+        return _lut_rows(self._ip_lut, ips, len(self.ip_index))
 
     def word_rows(self, words: list[str]) -> np.ndarray:
-        fb = len(self.word_index)
-        return np.fromiter(
-            (self.word_index.get(w, fb) for w in words), np.int32, len(words)
+        if self._word_lut is None:
+            self._word_lut = _make_lut(self.word_index)
+        return _lut_rows(self._word_lut, words, len(self.word_index))
+
+
+def _make_lut(index: dict[str, int]):
+    """dict -> ((sorted key U-array, row array) | None, oddball dict).
+
+    numpy's U dtype strips TRAILING NUL characters on conversion (only
+    trailing: 'a\\x00b' round-trips, 'a\\x00' becomes 'a'), which would
+    let a hostile key/query pair like 'foo\\x00' vs 'foo' collide in the
+    vectorized path.  Keys ending in NUL live in the oddball dict, and
+    _lut_rows routes NUL-terminated queries through it, so lookup
+    semantics stay exactly dict.get's."""
+    odd = {k: v for k, v in index.items() if k.endswith("\x00")}
+    plain = [(k, v) for k, v in index.items() if not k.endswith("\x00")]
+    if not plain:
+        return None, odd
+    keys = np.asarray([k for k, _ in plain], dtype=np.str_)
+    rows = np.asarray([v for _, v in plain], np.int32)
+    order = np.argsort(keys)
+    return (keys[order], rows[order]), odd
+
+
+def _lut_rows(lut_odd, queries: list[str], fallback_row: int) -> np.ndarray:
+    """Row per query via searchsorted; misses get the fallback row.
+    Queries keep their own U-width (numpy compares by code point, no
+    truncation) and NUL-terminated ones take the oddball dict, matching
+    dict/str lookup semantics exactly."""
+    lut, odd = lut_odd
+    if lut is None:
+        out = np.full(len(queries), fallback_row, np.int32)
+    else:
+        keys, rows = lut
+        q = np.asarray(queries, dtype=np.str_)
+        pos = np.clip(np.searchsorted(keys, q), 0, len(keys) - 1)
+        out = np.where(keys[pos] == q, rows[pos], fallback_row).astype(
+            np.int32
         )
+    for i, s in enumerate(queries):
+        if s and s[-1] == "\x00":
+            out[i] = odd.get(s, fallback_row)
+    return out
 
 
 @partial(jax.jit, donate_argnums=())

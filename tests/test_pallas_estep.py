@@ -42,6 +42,24 @@ def test_digamma_matches_scipy():
     )
 
 
+def test_gammaln_matches_scipy():
+    # Same regimes as digamma: the dense kernel evaluates gammaln at
+    # gamma entries (>= alpha, can be ~1e-3 after alpha Newton steps)
+    # and at row sums (up to ~alpha*K + N_d).
+    from jax.scipy.special import gammaln
+
+    # Per-range asserts: a global atol scaled by gammaln(5000) ~ 3.8e4
+    # would swamp the small-x regime entirely.
+    for lo, hi, n in [(1e-4, 0.1, 57), (0.1, 6.0, 100), (6.0, 5000.0, 100)]:
+        x = jnp.asarray(np.linspace(lo, hi, n), jnp.float32)
+        ours = np.asarray(pallas_estep.gammaln_pos(x))
+        ref = np.asarray(gammaln(x))
+        np.testing.assert_allclose(
+            ours, ref,
+            rtol=4e-6, atol=4e-6 * np.maximum(np.abs(ref), 1.0).max(),
+        )
+
+
 def test_e_step_parity_interpret(problem):
     lb, a, w, c, m = problem
     ref = estep.e_step(lb, a, w, c, m, var_max_iters=50, var_tol=1e-7,

@@ -190,3 +190,25 @@ def test_native_dns_emit_matches_python_bytes():
         ",".join(feats.featurized_row(i) + [str(s[i])]) + "\n" for i in order
     ).encode("utf-8")
     assert blob == want
+
+
+def test_model_row_lookup_matches_dict_semantics():
+    """The vectorized searchsorted LUT must reproduce dict.get exactly,
+    including hostile keys: numpy's U dtype strips TRAILING NULs on
+    conversion, so 'foo\\x00' and 'foo' would otherwise collide (raw
+    DNS names are legal inputs here)."""
+    k = 3
+    names = ["foo", "foo\x00", "a\x00b", "zz", "", "Ⴆ.example"]
+    theta = np.arange(len(names) * k, dtype=np.float64).reshape(-1, k)
+    model = ScoringModel.from_results(
+        names, theta, ["w"], np.ones((1, k)), fallback=0.1
+    )
+    queries = names + ["foo\x00\x00", "miss", "a", "a\x00", "\x00"]
+    fb = len(model.ip_index)
+    want = [model.ip_index.get(q, fb) for q in queries]
+    got = list(model.ip_rows(queries))
+    assert got == want
+
+    empty = ScoringModel.from_results([], np.zeros((0, k)), [],
+                                      np.zeros((0, k)), fallback=0.1)
+    assert list(empty.ip_rows(["x", "y\x00"])) == [0, 0]
