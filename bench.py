@@ -77,7 +77,8 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
     doc_mask = jnp.ones((b,), jnp.float32)
     alpha = jnp.float32(2.5)
 
-    use_dense = not force_sparse and dense_estep.available(b, v, k)
+    use_dense = not force_sparse and dense_estep.available(b, v, k,
+                                                           precision)
     wmajor = wmajor and use_dense and (
         dense_estep.pick_block_w(b, v, k, precision) is not None
     )

@@ -175,19 +175,35 @@ def make_chunk_runner(
         total_ll = jnp.zeros((), dtype)
         total_ass = jnp.zeros((), dtype)
         gammas = []
+
+        def run_batch(batch, g_in):
+            if len(batch) == 2:                # dense group: (C [B,V], mask)
+                return dense_fn(log_beta, alpha, *batch, g_in, warm)
+            w, c, m = batch                    # sparse group: (w, c, mask)
+            return e_fn(
+                log_beta, alpha, w, c, m,
+                var_max_iters=var_max_iters, var_tol=var_tol,
+            )
+
         for group, g_prev in zip(groups, gammas_prev):
+            if group[0].shape[0] == 1:
+                # Single-batch group (the common case after bucketing):
+                # call the E-step directly instead of a length-1
+                # lax.scan, whose slice-in/stack-out machinery adds
+                # fixed per-EM-iteration ops inside the chunk loop.
+                res = run_batch(
+                    tuple(a[0] for a in group), g_prev[0]
+                )
+                total_ss = total_ss + res.suff_stats
+                total_ll = total_ll + res.likelihood
+                total_ass = total_ass + res.alpha_ss
+                gammas.append(res.gamma[None])
+                continue
 
             def scan_body(carry, batch_and_gamma):
                 ss, ll, ass = carry
                 batch, g_in = batch_and_gamma
-                if len(batch) == 2:            # dense group: (C [B,V], mask)
-                    res = dense_fn(log_beta, alpha, *batch, g_in, warm)
-                else:                          # sparse group: (w, c, mask)
-                    w, c, m = batch
-                    res = e_fn(
-                        log_beta, alpha, w, c, m,
-                        var_max_iters=var_max_iters, var_tol=var_tol,
-                    )
+                res = run_batch(batch, g_in)
                 return (
                     (ss + res.suff_stats, ll + res.likelihood,
                      ass + res.alpha_ss),

@@ -473,7 +473,10 @@ def dense_fixed_point(
     warm=None,                  # traced scalar bool/int gating gamma_prev
     precision: str = "f32",
 ):
-    """Returns (gamma [B, K], T [K, V], tok_ll [B], iters scalar)."""
+    """Returns (gamma [B, K], T [K, V], docll [B], alpha_ss_part [B],
+    iters scalar) — docll is the full per-doc ELBO minus the alpha-prior
+    constant (token term + gamma-Dirichlet terms, masked), and
+    alpha_ss_part is the per-doc sum_k E[log theta] (masked)."""
     k_topics, v = exp_beta.shape
     b = dense_counts.shape[0]
     bb = block or pick_block(b, v, k_topics, precision)
@@ -590,6 +593,11 @@ def e_step_dense(
     return estep.EStepResult(gamma, suff, alpha_ss, likelihood, iters)
 
 
-def available(b: int, v: int, k: int) -> bool:
-    """True when the shapes admit a VMEM-feasible block on TPU."""
-    return jax.default_backend() == "tpu" and pick_block(b, v, k) is not None
+def available(b: int, v: int, k: int, precision: str = "f32") -> bool:
+    """True when the shapes admit a VMEM-feasible block on TPU (at the
+    precision the caller will actually run — bf16 mode needs more VMEM
+    for its half-width operand copies)."""
+    return (
+        jax.default_backend() == "tpu"
+        and pick_block(b, v, k, precision) is not None
+    )

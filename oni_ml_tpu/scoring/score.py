@@ -95,17 +95,31 @@ class ScoringModel:
         return _lut_rows(self._word_lut, words, len(self.word_index))
 
 
+# Vector-path width cap: numpy U arrays cost 4*maxlen bytes PER ELEMENT
+# (one 253-char DNS name would make every element ~1KB).  Real keys here
+# are short (IPs <= 45 chars, discretized words ~10-30); longer strings
+# are rare hostiles and take the dict path.  A query only ever equals a
+# key of its own length, so splitting by length preserves semantics.
+_MAX_LUT_CHARS = 48
+
+
+def _odd_key(s: str) -> bool:
+    """Keys/queries the vectorized path cannot represent faithfully:
+    numpy's U dtype strips TRAILING NUL characters on conversion (only
+    trailing: 'a\\x00b' round-trips, 'a\\x00' becomes 'a') — a hostile
+    'foo\\x00' would collide with 'foo' — and over-long strings would
+    blow up the fixed-width array."""
+    return len(s) > _MAX_LUT_CHARS or s.endswith("\x00")
+
+
 def _make_lut(index: dict[str, int]):
     """dict -> ((sorted key U-array, row array) | None, oddball dict).
 
-    numpy's U dtype strips TRAILING NUL characters on conversion (only
-    trailing: 'a\\x00b' round-trips, 'a\\x00' becomes 'a'), which would
-    let a hostile key/query pair like 'foo\\x00' vs 'foo' collide in the
-    vectorized path.  Keys ending in NUL live in the oddball dict, and
-    _lut_rows routes NUL-terminated queries through it, so lookup
-    semantics stay exactly dict.get's."""
-    odd = {k: v for k, v in index.items() if k.endswith("\x00")}
-    plain = [(k, v) for k, v in index.items() if not k.endswith("\x00")]
+    Oddball keys (_odd_key) live in a side dict; _lut_rows routes
+    oddball queries through it, so lookup semantics stay exactly
+    dict.get's."""
+    odd = {k: v for k, v in index.items() if _odd_key(k)}
+    plain = [(k, v) for k, v in index.items() if not _odd_key(k)]
     if not plain:
         return None, odd
     keys = np.asarray([k for k, _ in plain], dtype=np.str_)
@@ -117,21 +131,27 @@ def _make_lut(index: dict[str, int]):
 def _lut_rows(lut_odd, queries: list[str], fallback_row: int) -> np.ndarray:
     """Row per query via searchsorted; misses get the fallback row.
     Queries keep their own U-width (numpy compares by code point, no
-    truncation) and NUL-terminated ones take the oddball dict, matching
-    dict/str lookup semantics exactly."""
+    truncation); oddball queries (_odd_key) are blanked out of the
+    array — '' keeps its width small — and resolved via the side dict,
+    matching dict/str lookup semantics exactly."""
     lut, odd = lut_odd
+    odd_idx = [i for i, s in enumerate(queries) if _odd_key(s)]
     if lut is None:
         out = np.full(len(queries), fallback_row, np.int32)
     else:
         keys, rows = lut
-        q = np.asarray(queries, dtype=np.str_)
+        plain = queries
+        if odd_idx:
+            plain = list(queries)
+            for i in odd_idx:
+                plain[i] = ""   # keeps the array narrow; fixed up below
+        q = np.asarray(plain, dtype=np.str_)
         pos = np.clip(np.searchsorted(keys, q), 0, len(keys) - 1)
         out = np.where(keys[pos] == q, rows[pos], fallback_row).astype(
             np.int32
         )
-    for i, s in enumerate(queries):
-        if s and s[-1] == "\x00":
-            out[i] = odd.get(s, fallback_row)
+    for i in odd_idx:
+        out[i] = odd.get(queries[i], fallback_row)
     return out
 
 

@@ -198,12 +198,14 @@ def test_model_row_lookup_matches_dict_semantics():
     conversion, so 'foo\\x00' and 'foo' would otherwise collide (raw
     DNS names are legal inputs here)."""
     k = 3
-    names = ["foo", "foo\x00", "a\x00b", "zz", "", "Ⴆ.example"]
+    long_name = "x" * 250 + ".evil"   # past the vector-path width cap
+    names = ["foo", "foo\x00", "a\x00b", "zz", "", "Ⴆ.example", long_name]
     theta = np.arange(len(names) * k, dtype=np.float64).reshape(-1, k)
     model = ScoringModel.from_results(
         names, theta, ["w"], np.ones((1, k)), fallback=0.1
     )
-    queries = names + ["foo\x00\x00", "miss", "a", "a\x00", "\x00"]
+    queries = names + ["foo\x00\x00", "miss", "a", "a\x00", "\x00",
+                       long_name + "!", "y" * 300]
     fb = len(model.ip_index)
     want = [model.ip_index.get(q, fb) for q in queries]
     got = list(model.ip_rows(queries))
