@@ -1,0 +1,61 @@
+"""Smoke tests for the driver-facing bench entry points.
+
+bench.py is the round gate the driver runs on real hardware; these
+protect it from API drift (it imports deep into fused/dense/scoring).
+On the CPU test backend the dense gate is off, so bench_em exercises
+the sparse fused path; shapes are tiny to keep compiles cheap.
+"""
+
+import json
+
+import numpy as np
+
+
+def test_bench_em_sparse_smoke():
+    import bench
+
+    dps, t_iter, used_dense, used_wmajor = bench.bench_em(
+        4, 128, 32, 16, chunk=2, rounds=1, var_max_iters=3
+    )
+    assert np.isfinite(dps) and dps > 0
+    assert t_iter > 0
+    assert used_dense is False  # CPU backend: dense gate requires TPU
+    assert used_wmajor is False
+
+
+def test_bench_dns_scoring_smoke():
+    import bench
+
+    eps, p50 = bench.bench_dns_scoring(n_events=2000, reps=1)
+    assert np.isfinite(eps) and eps > 0
+    assert p50 > 0
+
+
+def test_em_utilization_fields():
+    import bench
+
+    util = bench.em_utilization(20, 8192, 4096, 5e-3)
+    assert set(util) == {
+        "achieved_tflops", "mxu_pct", "hbm_gbps", "hbm_pct"
+    }
+    assert all(v > 0 for v in util.values())
+
+
+def test_bench_main_emits_one_json_line(capsys, monkeypatch):
+    """main() must print exactly one JSON object with the driver's
+    required keys, whatever engine the backend picks."""
+    import bench
+
+    monkeypatch.setattr(
+        bench, "bench_em",
+        lambda *a, **k: (1000.0, 0.004, False, False),
+    )
+    monkeypatch.setattr(
+        bench, "bench_dns_scoring", lambda *a, **k: (5000.0, 0.08)
+    )
+    assert bench.main() == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"] == "lda_em_throughput"
