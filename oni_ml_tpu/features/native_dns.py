@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import Iterable, Sequence
+from typing import Sequence
 
 import numpy as np
 
@@ -218,10 +218,26 @@ class NativeDnsFeatures:
         self._lists = {}
 
 
-def _rows_to_blob(rows: Iterable[Sequence[str]]) -> bytes:
-    return (
-        "\n".join(_SEP.join(r) for r in rows) + "\n"
-    ).encode("utf-8") if rows else b""
+def _rows_to_blob_checked(rows: Sequence[Sequence[str]]):
+    """(blob | None): join rows for native ingest, detecting transport-
+    byte collisions in the same pass — None means some field embeds
+    '\\n', '\\r', or the '\\x1f' separator and the run must take the
+    Python path.  The per-row checks ride C-speed str scans on the
+    joined string (a field embedding the separator shows up as a
+    separator-count mismatch), replacing a per-field Python scan that
+    cost more than the native featurization itself."""
+    if not rows:
+        return b""
+    parts = []
+    sep = _SEP
+    for r in rows:
+        j = sep.join(r)
+        if r and (
+            "\n" in j or "\r" in j or j.count(sep) != len(r) - 1
+        ):
+            return None
+        parts.append(j)
+    return ("\n".join(parts) + "\n").encode("utf-8")
 
 
 def _featurize_native(
@@ -234,6 +250,16 @@ def _featurize_native(
     field embedding the \\x1f transport separator (the stored rows blob
     would re-split into misaligned columns) — the caller falls back to
     the Python path for the whole run."""
+    # Join + transport-byte-check every in-memory source BEFORE any
+    # ingest, so an unsafe feedback row cannot leave the handle
+    # half-ingested when the run falls back to the Python path.
+    blobs = {}
+    for src in (*sources, feedback_rows):
+        if not isinstance(src, str) and src:
+            blob = _rows_to_blob_checked(src)
+            if blob is None:
+                return None
+            blobs[id(src)] = blob
     h = lib.dfz_create()
     try:
         for src in sources:
@@ -243,14 +269,16 @@ def _featurize_native(
                         lib.dfz_error(h).decode("utf-8", "replace")
                     )
             elif src:
-                blob = _rows_to_blob(src)
+                blob = blobs.pop(id(src))
                 lib.dfz_ingest_rows(h, blob, len(blob))
+                del blob  # one blob alive at a time; peak RSS matters
         if lib.dfz_unsafe(h):
             return None
         lib.dfz_mark_raw(h)
         if feedback_rows:
-            blob = _rows_to_blob(feedback_rows)
+            blob = blobs.pop(id(feedback_rows))
             lib.dfz_ingest_rows(h, blob, len(blob))
+            del blob
 
         n = lib.dfz_num_events(h)
         tstamp = _copy(lib.dfz_tstamp(h), n, np.float64)
@@ -338,18 +366,11 @@ def featurize_dns_sources(
     and the run falls back the same way.
     """
 
-    def _unsafe(rows) -> bool:
-        return any(
-            "\n" in field or _SEP in field or "\r" in field
-            for row in rows
-            for field in row
-        )
-
     lib = _LIB.load()
-    if lib is not None and not any(
-        _unsafe(src) for src in (*sources, feedback_rows)
-        if not isinstance(src, str)
-    ):
+    if lib is not None:
+        # _featurize_native returns None when any in-memory field embeds
+        # a transport byte ('\n', '\r', '\x1f') or native CSV ingest
+        # detects an embedded separator — the whole run then falls back.
         feats = _featurize_native(lib, sources, feedback_rows, top_domains)
         if feats is not None:
             return feats
