@@ -152,6 +152,38 @@ def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True,
     }
 
 
+def bench_online_svi(k=20, v=8192, b=4096, l=128, steps=24, warm=8):
+    """Steady-state streaming SVI throughput (BASELINE.json config 5):
+    docs/sec through OnlineLDATrainer.step at the headline micro-batch
+    shape.  The first `warm` steps absorb compile + densify warmup; the
+    trainer's dense_em='auto' picks the dense MXU E-step on TPU."""
+    from oni_ml_tpu.config import OnlineLDAConfig
+    from oni_ml_tpu.io import Batch
+    from oni_ml_tpu.models import OnlineLDATrainer
+
+    rng = np.random.default_rng(1)
+    cfg = OnlineLDAConfig(num_topics=k, batch_size=b)
+    tr = OnlineLDATrainer(cfg, num_terms=v, total_docs=b * steps)
+    batches = [
+        Batch(
+            word_idx=rng.integers(0, v, size=(b, l)).astype(np.int32),
+            counts=rng.integers(1, 5, size=(b, l)).astype(np.float32),
+            doc_index=np.arange(b, dtype=np.int32),
+            doc_mask=np.ones((b,), np.float32),
+        )
+        for _ in range(4)
+    ]
+    for i in range(warm):
+        tr.step(batches[i % len(batches)])
+    _sync(tr.lam)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        info = tr.step(batches[i % len(batches)])
+    _sync(info.likelihood)
+    dt = time.perf_counter() - t0
+    return b * steps / dt
+
+
 def bench_dns_scoring(n_events=400_000, reps=3):
     """Full score_dns stage (model-row resolution, batched device dots,
     threshold/sort, native CSV emit) over a synthetic day; returns
@@ -212,6 +244,9 @@ def main() -> int:
     # Config-3 scale (BASELINE.json: 50 topics, full vocabulary).
     docs50k, _, dense50k, _ = bench_em(50, 50_000, 2048, 128, rounds=3)
 
+    # Config-5: streaming SVI steady state at the headline shape.
+    svi_dps = bench_online_svi()
+
     # DNS scoring stage (BASELINE.md "DNS scoring p50").
     score_eps, score_p50 = bench_dns_scoring()
 
@@ -234,6 +269,10 @@ def main() -> int:
                         "value": round(docs50k, 1),
                         "unit": "docs/sec",
                         "engine": "dense" if dense50k else "sparse",
+                    },
+                    "lda_online_svi": {
+                        "value": round(svi_dps, 1),
+                        "unit": "docs/sec",
                     },
                     "dns_scoring": {
                         "value": round(score_eps, 1),

@@ -102,6 +102,13 @@ class OnlineLDAConfig:
     seed: int = 0
     # Checkpoint (lambda, step) every N micro-batch steps (0 = disabled).
     checkpoint_every: int = 0
+    # Dense-corpus E-step for micro-batches (ops/dense_estep.py):
+    # "auto" uses it on TPU when the (B, V) shape fits VMEM blocks —
+    # for streaming, the one densify scatter per micro-batch replaces a
+    # beta-slab gather in EVERY fixed-point iteration, so it pays for
+    # itself immediately; "on"/"off" force.  Single-process only (the
+    # data-parallel mesh path keeps the shard_map'd sparse E-step).
+    dense_em: str = "auto"
 
 
 @dataclass(frozen=True)

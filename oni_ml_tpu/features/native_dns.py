@@ -250,16 +250,11 @@ def _featurize_native(
     field embedding the \\x1f transport separator (the stored rows blob
     would re-split into misaligned columns) — the caller falls back to
     the Python path for the whole run."""
-    # Join + transport-byte-check every in-memory source BEFORE any
-    # ingest, so an unsafe feedback row cannot leave the handle
-    # half-ingested when the run falls back to the Python path.
-    blobs = {}
-    for src in (*sources, feedback_rows):
-        if not isinstance(src, str) and src:
-            blob = _rows_to_blob_checked(src)
-            if blob is None:
-                return None
-            blobs[id(src)] = blob
+    # In-memory sources are joined + transport-byte-checked one at a
+    # time as they are ingested (one blob alive at once — peak RSS
+    # matters for multi-source days).  An unsafe field mid-run simply
+    # returns None: the finally below destroys the half-ingested
+    # handle and the caller falls back to the Python path.
     h = lib.dfz_create()
     try:
         for src in sources:
@@ -269,14 +264,18 @@ def _featurize_native(
                         lib.dfz_error(h).decode("utf-8", "replace")
                     )
             elif src:
-                blob = blobs.pop(id(src))
+                blob = _rows_to_blob_checked(src)
+                if blob is None:
+                    return None
                 lib.dfz_ingest_rows(h, blob, len(blob))
-                del blob  # one blob alive at a time; peak RSS matters
+                del blob
         if lib.dfz_unsafe(h):
             return None
         lib.dfz_mark_raw(h)
         if feedback_rows:
-            blob = blobs.pop(id(feedback_rows))
+            blob = _rows_to_blob_checked(feedback_rows)
+            if blob is None:
+                return None
             lib.dfz_ingest_rows(h, blob, len(blob))
             del blob
 

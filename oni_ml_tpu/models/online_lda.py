@@ -181,21 +181,90 @@ class OnlineLDATrainer:
 
             self._lam = jax.device_put(self._lam, replicated(mesh))
 
+        if config.dense_em not in ("auto", "on", "off"):
+            raise ValueError(
+                f"OnlineLDAConfig.dense_em={config.dense_em!r}: expected "
+                "'auto', 'on', or 'off'"
+            )
+        self._custom_e_fn = e_step_fn is not None
         base = e_step_fn or estep.e_step
         self._e_fn = partial(
             base, var_max_iters=config.var_max_iters, var_tol=config.var_tol
         )
+        # One jitted update per micro-batch shape: the dense-vs-sparse
+        # choice and the scoped-VMEM compiler option both depend on B,
+        # which is only known when the first batch of a shape arrives.
+        self._updates: dict = {}
 
-        @partial(jax.jit, donate_argnums=(0,))
+    def _use_dense(self, b: int) -> bool:
+        from ..ops import dense_estep
+
+        cfg = self.config
+        if cfg.dense_em == "off" or self._custom_e_fn or self.mesh is not None:
+            if cfg.dense_em == "on":
+                raise ValueError(
+                    "dense_em='on' needs the default single-process "
+                    "E-step (no mesh, no custom e_step_fn)"
+                )
+            return False
+        feasible = dense_estep.pick_block(b, self.num_terms,
+                                          cfg.num_topics) is not None
+        if cfg.dense_em == "on":
+            if not feasible:
+                raise ValueError(
+                    f"dense_em forced but B={b}, V={self.num_terms}, "
+                    f"K={cfg.num_topics} has no VMEM-feasible doc block"
+                )
+            return True
+        return feasible and jax.default_backend() == "tpu"
+
+    def _get_update(self, b: int, l: int):
+        key = (b, l)
+        got = self._updates.get(key)
+        if got is not None:
+            return got
+        from ..ops import dense_estep
+
+        cfg = self.config
+        total_docs = self.total_docs
+        use_dense = self._use_dense(b)
+        compiler_options = None
+        if use_dense:
+            v, k = self.num_terms, cfg.num_topics
+            wmajor = dense_estep.pick_block_w(b, v, k) is not None
+            kib = dense_estep.scoped_vmem_kib(b, v, k, wmajor=wmajor)
+            if jax.default_backend() == "tpu" and kib:
+                # The pallas_call's own VMEM limit can be dropped when
+                # XLA fusion-wraps the kernel (see scoped_vmem_kib).
+                compiler_options = {
+                    "xla_tpu_scoped_vmem_limit_kib": str(kib)
+                }
+
+            def e_fn(elog_beta, alpha, word_idx, counts, doc_mask):
+                dense = dense_estep.densify(word_idx, counts, v)
+                if wmajor:
+                    dense = dense.T
+                return dense_estep.e_step_dense(
+                    elog_beta, alpha, dense, doc_mask,
+                    cfg.var_max_iters, cfg.var_tol,
+                    interpret=jax.default_backend() != "tpu",
+                    wmajor=wmajor,
+                )
+        else:
+            e_fn = self._e_fn
+
         def update(lam, rho, word_idx, counts, doc_mask):
-            res = self._e_fn(expected_log_beta(lam), self._alpha, word_idx,
-                             counts, doc_mask)
+            res = e_fn(expected_log_beta(lam), self._alpha, word_idx,
+                       counts, doc_mask)
             batch_docs = jnp.maximum(doc_mask.sum(), 1.0)
-            lam_hat = config.eta + (total_docs / batch_docs) * res.suff_stats.T
+            lam_hat = cfg.eta + (total_docs / batch_docs) * res.suff_stats.T
             new_lam = (1.0 - rho) * lam + rho * lam_hat
             return new_lam, res.likelihood, res.gamma
 
-        self._update = update
+        jitted = jax.jit(update, donate_argnums=(0,),
+                         compiler_options=compiler_options)
+        self._updates[key] = jitted
+        return jitted
 
     @property
     def lam(self) -> jnp.ndarray:
@@ -230,7 +299,8 @@ class OnlineLDATrainer:
         rho = float((cfg.tau0 + t) ** (-cfg.kappa))
         dtype = jnp.dtype(cfg.compute_dtype)
         widx, cnts, mask = self._put_batch(batch)
-        self._lam, ll, _ = self._update(
+        update = self._get_update(widx.shape[0], widx.shape[1])
+        self._lam, ll, _ = update(
             self._lam, jnp.asarray(rho, dtype), widx, cnts, mask
         )
         self.step_count += 1

@@ -53,9 +53,17 @@ def test_bench_main_emits_one_json_line(capsys, monkeypatch):
     monkeypatch.setattr(
         bench, "bench_dns_scoring", lambda *a, **k: (5000.0, 0.08)
     )
+    monkeypatch.setattr(bench, "bench_online_svi", lambda *a, **k: 2000.0)
     assert bench.main() == 0
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     rec = json.loads(out[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["metric"] == "lda_em_throughput"
+
+
+def test_bench_online_svi_smoke():
+    import bench
+
+    dps = bench.bench_online_svi(k=4, v=256, b=64, l=16, steps=4, warm=2)
+    assert np.isfinite(dps) and dps > 0

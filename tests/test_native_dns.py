@@ -212,3 +212,19 @@ def test_csv_with_separator_byte_falls_back(tmp_path):
     clean.write_text(",".join(rows[1]) + "\n")
     feats2 = native_dns.featurize_dns_sources([str(clean)], top_domains=TOP)
     assert isinstance(feats2, native_dns.NativeDnsFeatures)
+
+
+def test_duplicate_in_memory_sources_ingest_twice():
+    """The same row-list object passed as two sources (or as source and
+    feedback) must ingest once per occurrence — a regression guard for
+    blob bookkeeping keyed on object identity."""
+    rows = [
+        ["t", str(1454000000 + i), "100", f"10.0.0.{i % 5}",
+         f"s{i}.example.com", "1", "1", "0"]
+        for i in range(20)
+    ]
+    feats = native_dns.featurize_dns_sources([rows, rows])
+    assert feats.num_raw_events == 40
+    fb = native_dns.featurize_dns_sources([rows], feedback_rows=rows)
+    assert fb.num_raw_events == 20          # feedback rows are not raw
+    assert fb.num_events == 40

@@ -259,3 +259,38 @@ def test_stream_extends_without_restart():
         trainer.step(b)
     assert trainer.step_count == steps_after_h1 + (len(batches) - half)
     assert not np.allclose(np.asarray(trainer.lam), lam_h1)
+
+
+def test_dense_micro_batch_matches_sparse():
+    """dense_em="on" must track the default sparse E-step trajectory:
+    same natural-gradient updates up to dense-vs-sparse float
+    reassociation (the dense path is the TPU fast path; CPU runs it in
+    interpret mode)."""
+    docs, _ = ref.make_synthetic_corpus(num_docs=48, num_terms=40,
+                                        num_topics=3, seed=5)
+    corpus = corpus_from_docs(docs, 40)
+    runs = {}
+    for mode in ("off", "on"):
+        cfg = OnlineLDAConfig(num_topics=4, batch_size=16,
+                              min_bucket_len=64, seed=3, dense_em=mode)
+        tr = OnlineLDATrainer(cfg, corpus.num_terms, total_docs=48)
+        for b in make_batches(corpus, cfg.batch_size, cfg.min_bucket_len):
+            tr.step(b)
+        runs[mode] = np.asarray(tr.lam)
+    np.testing.assert_allclose(runs["on"], runs["off"], rtol=2e-3, atol=2e-3)
+
+
+def test_dense_em_validation():
+    with pytest.raises(ValueError, match="dense_em"):
+        OnlineLDATrainer(
+            OnlineLDAConfig(num_topics=4, dense_em="dense"),
+            num_terms=50, total_docs=10,
+        )
+    # forced dense + custom e_step_fn is contradictory
+    tr = OnlineLDATrainer(
+        OnlineLDAConfig(num_topics=4, dense_em="on"),
+        num_terms=50, total_docs=10,
+        e_step_fn=lambda *a, **k: None,
+    )
+    with pytest.raises(ValueError, match="dense_em='on'"):
+        tr._use_dense(16)
