@@ -54,6 +54,7 @@ def test_bench_main_emits_one_json_line(capsys, monkeypatch):
         bench, "bench_dns_scoring", lambda *a, **k: (5000.0, 0.08)
     )
     monkeypatch.setattr(bench, "bench_online_svi", lambda *a, **k: 2000.0)
+    monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: True)
     assert bench.main() == 0
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
@@ -67,3 +68,11 @@ def test_bench_online_svi_smoke():
 
     dps = bench.bench_online_svi(k=4, v=256, b=64, l=16, steps=4, warm=2)
     assert np.isfinite(dps) and dps > 0
+
+
+def test_bench_main_aborts_cleanly_when_backend_wedged(capsys, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: False)
+    assert bench.main() == 1
+    assert capsys.readouterr().out.strip() == ""  # no fake JSON line
