@@ -301,3 +301,28 @@ def test_runner_rejects_bad_date():
 
     with pytest.raises(SystemExit):
         main(["2016", "flow"])
+
+
+def test_runner_perf_flags(flow_day, capsys):
+    """--warm-start / --dense-precision must reach LDAConfig and the run
+    must still produce the full stage sequence (on CPU the dense path is
+    gated off, so these only steer config — the semantics knobs are
+    exercised by tests/test_dense_estep.py)."""
+    cfg, tmp_path = flow_day
+    from oni_ml_tpu.runner.ml_ops import _build_config, build_parser, main
+
+    args = build_parser().parse_args([
+        "20160122", "flow", "1.1", "--warm-start",
+        "--dense-precision", "bf16",
+    ])
+    built = _build_config(args)
+    assert built.lda.warm_start_gamma is True
+    assert built.lda.dense_precision == "bf16"
+
+    rc = main([
+        "20160122", "flow", "1.1",
+        "--data-dir", str(tmp_path), "--flow-path", cfg.flow_path,
+        "--topics", "4", "--em-max-iters", "3", "--batch-size", "32",
+        "--warm-start", "--dense-precision", "bf16", "--force",
+    ])
+    assert rc == 0
