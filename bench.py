@@ -46,21 +46,11 @@ def _sync(x):
     return float(jax.tree_util.tree_leaves(x)[0].ravel()[0])
 
 
-def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
-             force_sparse=False, wmajor=True, warm_start=False,
-             precision="bf16"):
-    """Production fused-EM throughput at (K, V, B, L); returns
-    (docs_per_sec, seconds_per_em_iter, used_dense, used_wmajor).
-
-    chunk EM iterations run device-resident per host call; chunk=32
-    amortizes the host<->device round-trip (which dominates at chunk=8
-    under the tunneled PJRT backend: measured 331k -> 744k docs/s going
-    8 -> 32 on the headline config, flat 32 -> 64).
-
-    precision="bf16" stores the dense kernel's matmul operands
-    half-width.  On TPU this is bit-identical to f32 (XLA DEFAULT
-    matmul precision already feeds the MXU bf16-truncated inputs) and
-    ~10% faster, so the headline uses it."""
+def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
+              force_sparse=False, wmajor=True, warm_start=False,
+              precision="bf16"):
+    """Shared corpus/dense-path/runner setup for the EM benches:
+    returns (log_beta, groups, run_chunk, use_dense, used_wmajor)."""
     import jax
     import jax.numpy as jnp
 
@@ -75,7 +65,6 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
     word_idx = jnp.asarray(rng.integers(0, v, size=(b, l)), jnp.int32)
     counts = jnp.asarray(rng.integers(1, 5, size=(b, l)), jnp.float32)
     doc_mask = jnp.ones((b,), jnp.float32)
-    alpha = jnp.float32(2.5)
 
     use_dense = not force_sparse and dense_estep.available(b, v, k,
                                                            precision)
@@ -98,11 +87,37 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
 
     run_chunk = fused.make_chunk_runner(
         num_docs=b, num_topics=k, num_terms=v, chunk=chunk,
-        var_max_iters=var_max_iters, var_tol=1e-6, em_tol=0.0,
+        var_max_iters=var_max_iters, var_tol=1e-6, em_tol=em_tol,
         estimate_alpha=True, compiler_options=compiler_options,
         dense_wmajor=wmajor, warm_start=warm_start and use_dense,
         dense_precision=precision if use_dense else "f32",
     )
+    return log_beta, groups, run_chunk, use_dense, wmajor
+
+
+def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
+             force_sparse=False, wmajor=True, warm_start=False,
+             precision="bf16"):
+    """Production fused-EM throughput at (K, V, B, L); returns
+    (docs_per_sec, seconds_per_em_iter, used_dense, used_wmajor).
+
+    chunk EM iterations run device-resident per host call; chunk=32
+    amortizes the host<->device round-trip (which dominates at chunk=8
+    under the tunneled PJRT backend: measured 331k -> 744k docs/s going
+    8 -> 32 on the headline config, flat 32 -> 64).
+
+    precision="bf16" stores the dense kernel's matmul operands
+    half-width.  On TPU this is bit-identical to f32 (XLA DEFAULT
+    matmul precision already feeds the MXU bf16-truncated inputs) and
+    ~10% faster, so the headline uses it."""
+    import jax.numpy as jnp
+
+    log_beta, groups, run_chunk, use_dense, wmajor = _setup_em(
+        k, v, b, l, chunk=chunk, var_max_iters=var_max_iters, em_tol=0.0,
+        force_sparse=force_sparse, wmajor=wmajor, warm_start=warm_start,
+        precision=precision,
+    )
+    alpha = jnp.float32(2.5)
     res = run_chunk(log_beta, alpha, jnp.float32(np.nan), groups, chunk)
     _sync(res.lls[-1])
     # Second warmup: the first post-compile dispatch over the tunneled
@@ -119,6 +134,41 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         best = min(best, (time.perf_counter() - t0) / chunk)
     assert np.isfinite(ll)
     return b / best, best, use_dense, wmajor
+
+
+def bench_convergence(k=20, v=8192, b=4096, l=128, em_tol=1e-4,
+                      max_iters=256, chunk=32, precision="bf16"):
+    """Wall-clock from random init to |d(ll)/ll| < em_tol at the
+    headline shape — BASELINE.json's first named metric ("netflow LDA
+    wall-clock to convergence").  Compile time is excluded via a
+    zero-step warmup call; the measured span covers every EM iteration,
+    M-step, alpha Newton update, and chunk-boundary host sync the
+    production driver performs."""
+    import jax.numpy as jnp
+
+    log_beta, groups, run_chunk, _, _ = _setup_em(
+        k, v, b, l, chunk=chunk, var_max_iters=20, em_tol=em_tol,
+        precision=precision,
+    )
+    # Compile warmup without executing any EM iteration.
+    res = run_chunk(log_beta, jnp.float32(2.5), jnp.float32(np.nan),
+                    groups, 0)
+    _sync(res.steps_done)
+
+    t0 = time.perf_counter()
+    log_b, alpha, ll_prev = log_beta, jnp.float32(2.5), jnp.float32(np.nan)
+    iters = 0
+    done = 0
+    while iters < max_iters:
+        res = run_chunk(log_b, alpha, ll_prev, groups,
+                        min(chunk, max_iters - iters))
+        log_b, alpha, ll_prev = res.log_beta, res.alpha, res.ll_prev
+        done = int(_sync(res.steps_done))
+        iters += done
+        if bool(np.asarray(res.converged)) or done == 0:
+            break
+    seconds = time.perf_counter() - t0
+    return seconds, iters, float(_sync(res.lls[max(done - 1, 0)]))
 
 
 def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True,
@@ -264,6 +314,9 @@ def main() -> int:
     # Config-5: streaming SVI steady state at the headline shape.
     svi_dps = bench_online_svi()
 
+    # Wall-clock to convergence (BASELINE.json's first named metric).
+    conv_s, conv_iters, conv_ll = bench_convergence()
+
     # DNS scoring stage (BASELINE.md "DNS scoring p50").
     score_eps, score_p50 = bench_dns_scoring()
 
@@ -290,6 +343,12 @@ def main() -> int:
                     "lda_online_svi": {
                         "value": round(svi_dps, 1),
                         "unit": "docs/sec",
+                    },
+                    "lda_em_convergence": {
+                        "value": round(conv_s, 3),
+                        "unit": "seconds",
+                        "em_iters": conv_iters,
+                        "final_ll": round(conv_ll, 1),
                     },
                     "dns_scoring": {
                         "value": round(score_eps, 1),

@@ -55,6 +55,9 @@ def test_bench_main_emits_one_json_line(capsys, monkeypatch):
     )
     monkeypatch.setattr(bench, "bench_online_svi", lambda *a, **k: 2000.0)
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "bench_convergence", lambda *a, **k: (1.5, 20, -1e5)
+    )
     assert bench.main() == 0
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
@@ -76,3 +79,12 @@ def test_bench_main_aborts_cleanly_when_backend_wedged(capsys, monkeypatch):
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: False)
     assert bench.main() == 1
     assert capsys.readouterr().out.strip() == ""  # no fake JSON line
+
+
+def test_bench_convergence_smoke():
+    import bench
+
+    s, iters, ll = bench.bench_convergence(
+        k=4, v=128, b=32, l=16, em_tol=1e-3, max_iters=24, chunk=8
+    )
+    assert s > 0 and 0 < iters <= 24 and np.isfinite(ll)
