@@ -122,6 +122,10 @@ char* dns_emit(
     int64_t i = order[j];
     size_t start = out.size();
     out.append(seg(rows_blob, row_off, i));
+    // \x1f -> ',' as a plain byte loop: separators land every ~8
+    // bytes in a DNS row, so a memchr-per-hit scan is SLOWER here
+    // (measured 0.87s vs 0.69s on the 400k-event scoring stage —
+    // per-call overhead dominates at that hit density).
     for (size_t q = start; q < out.size(); q++)
       if (out[q] == '\x1f') out[q] = ',';
     out += ',';
