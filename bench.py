@@ -11,8 +11,11 @@ achieved TFLOP/s / GB/s against the chip peaks, so the number is
 auditable against the roofline instead of free-floating.
 
 Secondary metrics (carried as extra keys on the single JSON line the
-driver records): config-3 scale (K=50, V=50k — BASELINE.json config 3)
+driver records): the opt-in warm-start engine, config-3 scale (K=50,
+V=50k — BASELINE.json config 3), streaming SVI steady state (config
+5), wall-clock to convergence (BASELINE.json's first named metric),
 and DNS scoring throughput/p50 (BASELINE.md names "DNS scoring p50").
+A wedged device grant aborts cleanly instead of hanging the driver.
 
 The reference publishes no numbers (BASELINE.md), so vs_baseline is
 against our own recorded history: round-1's pre-fused stepwise driver
