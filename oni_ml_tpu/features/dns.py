@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Iterable, Sequence
 
 import numpy as np
+from ..io.formats import contract_open as _open
 
 from .quantiles import DECILES, QUINTILES, bin_values, ecdf_cuts
 
@@ -123,7 +124,7 @@ def load_top_domains(path: str) -> frozenset[str]:
     'rank,domain' line, truncated at its first dot
     (dns_pre_lda.scala:62-66): '1,google.com' -> 'google'."""
     out = set()
-    with open(path) as f:
+    with _open(path) as f:
         for line in f:
             line = line.strip()
             if not line:

@@ -16,6 +16,7 @@ unknown fields are the reference's "##" filler.
 from __future__ import annotations
 
 import os
+from ..io.formats import contract_open as _open
 
 # flow_scores.csv schema (flow_pre_lda.scala:150-171)
 _FLOW_FB_SEV = 0
@@ -64,7 +65,7 @@ def read_flow_feedback_rows(
     no feedback (the reference checks existence, flow_pre_lda.scala:253)."""
     if not os.path.exists(path):
         return []
-    with open(path) as f:
+    with _open(path) as f:
         lines = f.read().splitlines()[1:]  # drop header
     out: list[str] = []
     for line in lines:
@@ -91,7 +92,7 @@ def read_dns_feedback_rows(
     qry_class, qry_type, qry_rcode — dns_pre_lda.scala:124-134)."""
     if not os.path.exists(path):
         return []
-    with open(path) as f:
+    with _open(path) as f:
         lines = f.read().splitlines()[1:]
     out: list[list[str]] = []
     for line in lines:

@@ -31,8 +31,8 @@ def iter_raw_lines(path: str, chunk_size: int = 1 << 22) -> Iterator[str]:
             for ln in lines:
                 if ln.endswith(b"\r"):
                     ln = ln[:-1]
-                yield ln.decode("utf-8")
+                yield ln.decode("utf-8", "surrogateescape")
         if pending:
             if pending.endswith(b"\r"):
                 pending = pending[:-1]
-            yield pending.decode("utf-8")
+            yield pending.decode("utf-8", "surrogateescape")

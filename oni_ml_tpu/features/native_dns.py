@@ -114,7 +114,10 @@ def _table(lib, h, which: int) -> list[str]:
         lib.dfz_table_blob(h, which), lib.dfz_table_blob_len(h, which)
     )
     off = _copy(lib.dfz_table_offsets(h, which), cnt + 1, np.int64)
-    return [blob[off[i]:off[i + 1]].decode("utf-8") for i in range(cnt)]
+    return [
+        blob[off[i]:off[i + 1]].decode("utf-8", "surrogateescape")
+        for i in range(cnt)
+    ]
 
 
 class NativeDnsFeatures:
@@ -159,7 +162,7 @@ class NativeDnsFeatures:
 
     def row(self, i: int) -> list[str]:
         raw = self.rows_blob[self.row_off[i]:self.row_off[i + 1]]
-        return raw.decode("utf-8").split(_SEP)
+        return raw.decode("utf-8", "surrogateescape").split(_SEP)
 
     def client_ip(self, i: int) -> str:
         return self.ip_table[self.ip_id[i]]
@@ -237,7 +240,13 @@ def _rows_to_blob_checked(rows: Sequence[Sequence[str]]):
         ):
             return None
         parts.append(j)
-    return ("\n".join(parts) + "\n").encode("utf-8")
+    try:
+        return ("\n".join(parts) + "\n").encode("utf-8")
+    except UnicodeEncodeError:
+        # Lone surrogates (surrogateescape-decoded raw wire bytes) are
+        # not UTF-8-encodable; route the run to the Python path, which
+        # handles the str values directly.
+        return None
 
 
 def _featurize_native(
@@ -292,7 +301,9 @@ def _featurize_native(
         entropy_cuts = ecdf_cuts(entropy[entropy > 0], QUINTILES)
         numperiods_cuts = ecdf_cuts(n_parts[n_parts > 0], QUINTILES)
 
-        top_blob = "\n".join(sorted(top_domains)).encode("utf-8")
+        top_blob = "\n".join(sorted(top_domains)).encode(
+            "utf-8", "surrogateescape"
+        )
 
         def fp(a):
             return np.ascontiguousarray(a, np.float64).ctypes.data_as(_F64P)

@@ -111,7 +111,8 @@ def _table(lib, h, which: int) -> list[str]:
     blob = ctypes.string_at(lib.ffz_table_blob(h, which), blob_len)
     off = _copy(lib.ffz_table_offsets(h, which), cnt + 1, np.int64)
     return [
-        blob[off[i]:off[i + 1]].decode("utf-8") for i in range(cnt)
+        blob[off[i]:off[i + 1]].decode("utf-8", "surrogateescape")
+        for i in range(cnt)
     ]
 
 
@@ -158,7 +159,7 @@ class NativeFlowFeatures:
 
     def row(self, i: int) -> list[str]:
         raw = self.lines_blob[self.line_off[i]:self.line_off[i + 1]]
-        return raw.decode("utf-8").split(",")
+        return raw.decode("utf-8", "surrogateescape").split(",")
 
     def sip(self, i: int) -> str:
         return self.ip_table[self.sip_id[i]]
@@ -246,7 +247,9 @@ def _featurize_native(
             raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
         lib.ffz_mark_raw(h)
         if feedback_rows:
-            blob = ("\n".join(feedback_rows) + "\n").encode("utf-8")
+            blob = ("\n".join(feedback_rows) + "\n").encode(
+                "utf-8", "surrogateescape"
+            )
             lib.ffz_ingest_buffer(h, blob, len(blob))
         n = lib.ffz_num_events(h)
         num_time = _copy(lib.ffz_num_time(h), n, np.float64)

@@ -27,6 +27,7 @@ import numpy as np
 
 from .flow import FLOW_COLUMNS, NUM_FLOW_COLUMNS, _to_double
 from .quantiles import DECILES, QUINTILES, ecdf_cuts
+from ..io.formats import contract_open as _open
 
 
 def write_flow_qtiles(
@@ -45,7 +46,7 @@ def write_flow_qtiles(
 def read_flow_qtiles(path: str):
     """Returns (time_cuts, ibyt_cuts, ipkt_cuts) — the argument order of
     featurize_flow's `precomputed_cuts`."""
-    with open(path) as f:
+    with _open(path) as f:
         line = f.read().strip()
     parts = line.split(",")
     if len(parts) != 3:

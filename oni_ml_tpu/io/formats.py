@@ -28,19 +28,29 @@ from typing import Iterable, Iterator, Sequence, TextIO
 
 import numpy as np
 
+
+def contract_open(path: str, mode: str = "r"):
+    """Pinned text-mode open for every file contract: UTF-8 with
+    surrogateescape, so strings derived from hostile raw wire bytes
+    (IPs, DNS-name fragments) round-trip byte-for-byte through the
+    stage-boundary files instead of crashing the pipeline, and so
+    output bytes never depend on the host locale."""
+    return open(path, mode, encoding="utf-8", errors="surrogateescape")
+
+
 # ---------------------------------------------------------------------------
 # word_counts triples ("ip,word,count")
 # ---------------------------------------------------------------------------
 
 
 def write_word_counts(path: str, triples: Iterable[tuple[str, str, int]]) -> None:
-    with open(path, "w") as f:
+    with contract_open(path, "w") as f:
         for ip, word, count in triples:
             f.write(f"{ip},{word},{count}\n")
 
 
 def read_word_counts(path: str) -> Iterator[tuple[str, str, int]]:
-    with open(path) as f:
+    with contract_open(path) as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:
@@ -59,14 +69,14 @@ def read_word_counts(path: str) -> Iterator[tuple[str, str, int]]:
 
 def write_words_dat(path: str, vocab: Sequence[str]) -> None:
     """0-based ``idx,word`` lines in id order (lda_pre.py:38-41)."""
-    with open(path, "w") as f:
+    with contract_open(path, "w") as f:
         for i, w in enumerate(vocab):
             f.write(f"{i},{w}\n")
 
 
 def read_words_dat(path: str) -> list[str]:
     vocab: list[str] = []
-    with open(path) as f:
+    with contract_open(path) as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:
@@ -80,14 +90,14 @@ def read_words_dat(path: str) -> list[str]:
 
 def write_doc_dat(path: str, doc_names: Sequence[str]) -> None:
     """1-based ``idx,ip`` lines in id order (lda_pre.py:66-73)."""
-    with open(path, "w") as f:
+    with contract_open(path, "w") as f:
         for i, d in enumerate(doc_names):
             f.write(f"{i + 1},{d}\n")
 
 
 def read_doc_dat(path: str) -> list[str]:
     docs: list[str] = []
-    with open(path) as f:
+    with contract_open(path) as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:
@@ -111,7 +121,7 @@ def write_model_dat(
     counts: np.ndarray,
 ) -> None:
     """CSR corpus -> LDA-C lines ``N w1:c1 ... wN:cN`` (lda_pre.py:84-94)."""
-    with open(path, "w") as f:
+    with contract_open(path, "w") as f:
         for d in range(len(doc_ptr) - 1):
             lo, hi = int(doc_ptr[d]), int(doc_ptr[d + 1])
             parts = [str(hi - lo)]
@@ -125,7 +135,7 @@ def read_model_dat(path: str) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     ptr = [0]
     widx: list[int] = []
     cnts: list[int] = []
-    with open(path) as f:
+    with contract_open(path) as f:
         for line in f:
             fields = line.split()
             if not fields:
@@ -175,7 +185,7 @@ def read_gamma(path: str) -> np.ndarray:
 
 
 def write_other(path: str, num_topics: int, num_terms: int, alpha: float) -> None:
-    with open(path, "w") as f:
+    with contract_open(path, "w") as f:
         f.write(f"num_topics {num_topics}\n")
         f.write(f"num_terms {num_terms}\n")
         f.write(f"alpha {alpha:5.10f}\n")
@@ -183,7 +193,7 @@ def write_other(path: str, num_topics: int, num_terms: int, alpha: float) -> Non
 
 def read_other(path: str) -> dict:
     out: dict = {}
-    with open(path) as f:
+    with contract_open(path) as f:
         for line in f:
             key, val = line.split()
             out[key] = float(val) if key == "alpha" else int(val)
@@ -211,7 +221,7 @@ def write_doc_results(path: str, doc_names: Sequence[str], gamma: np.ndarray) ->
     gamma = np.asarray(gamma, dtype=np.float64)
     k = gamma.shape[1]
     zero_str = " ".join(["0.0"] * k)
-    with open(path, "w") as f:
+    with contract_open(path, "w") as f:
         for name, row in zip(doc_names, gamma):
             total = row.sum()
             if total > 0:
@@ -224,7 +234,7 @@ def write_doc_results(path: str, doc_names: Sequence[str], gamma: np.ndarray) ->
 def read_doc_results(path: str) -> tuple[list[str], np.ndarray]:
     names: list[str] = []
     rows: list[np.ndarray] = []
-    with open(path) as f:
+    with contract_open(path) as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:
@@ -242,7 +252,7 @@ def write_word_results(path: str, vocab: Sequence[str], log_beta: np.ndarray) ->
     # exp+normalize in a numerically safe way: subtract the row max first.
     shifted = np.exp(log_beta - log_beta.max(axis=1, keepdims=True))
     p_wgz = (shifted / shifted.sum(axis=1, keepdims=True)).T  # V x K
-    with open(path, "w") as f:
+    with contract_open(path, "w") as f:
         for word, row in zip(vocab, p_wgz):
             f.write(f"{word}," + " ".join(str(v) for v in row) + "\n")
 
@@ -250,7 +260,7 @@ def write_word_results(path: str, vocab: Sequence[str], log_beta: np.ndarray) ->
 def read_word_results(path: str) -> tuple[list[str], np.ndarray]:
     words: list[str] = []
     rows: list[np.ndarray] = []
-    with open(path) as f:
+    with contract_open(path) as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:

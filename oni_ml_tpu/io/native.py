@@ -98,9 +98,11 @@ def load_corpus(paths: str | list[str]):
             nb = lib.oni_names_bytes(h, which)
             buf = ctypes.create_string_buffer(int(nb))
             lib.oni_fill_names(h, which, buf)
-            # strict decode: non-UTF-8 input fails here, up front, exactly
-            # like the text-mode Python reader (not later in Corpus.save)
-            raw = buf.raw[:nb].decode("utf-8")
+            # surrogateescape, matching the Python reader (io/formats
+            # _open): hostile raw wire bytes in IPs/words round-trip
+            # byte-for-byte through words.dat/doc.dat instead of
+            # crashing the corpus stage.
+            raw = buf.raw[:nb].decode("utf-8", "surrogateescape")
             return raw.split("\n")[:-1]  # trailing separator
 
         return Corpus(

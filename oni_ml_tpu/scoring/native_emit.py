@@ -65,7 +65,7 @@ def available() -> bool:
 def _table_blob(strs: list[str]) -> tuple[bytes, np.ndarray]:
     """Re-encode a decoded string table into (blob, offsets) — tables
     hold unique strings only, so this is tiny next to the row count."""
-    enc = [s.encode("utf-8") for s in strs]
+    enc = [s.encode("utf-8", "surrogateescape") for s in strs]
     off = np.zeros(len(enc) + 1, np.int64)
     if enc:
         np.cumsum([len(e) for e in enc], out=off[1:])

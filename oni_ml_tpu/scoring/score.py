@@ -242,7 +242,9 @@ def score_flow_csv(
     stage is emit otherwise), bit-identical to the Python loop."""
     blob, rows, scores = _flow_scored(features, model, threshold)
     if blob is None:
-        blob = "".join(r + "\n" for r in rows).encode("utf-8")
+        blob = "".join(r + "\n" for r in rows).encode(
+            "utf-8", "surrogateescape"
+        )
     return blob, scores
 
 
@@ -260,7 +262,10 @@ def score_flow(
     data without feedback injection)."""
     blob, rows, scores = _flow_scored(features, model, threshold)
     if rows is None:
-        rows = blob.decode("utf-8").split("\n")[:-1] if blob else []
+        rows = (
+            blob.decode("utf-8", "surrogateescape").split("\n")[:-1]
+            if blob else []
+        )
     return rows, scores
 
 
@@ -299,7 +304,9 @@ def score_dns_csv(
     """DNS scoring as one CSV buffer (see score_flow_csv)."""
     blob, rows, scores = _dns_scored(features, model, threshold)
     if blob is None:
-        blob = "".join(r + "\n" for r in rows).encode("utf-8")
+        blob = "".join(r + "\n" for r in rows).encode(
+            "utf-8", "surrogateescape"
+        )
     return blob, scores
 
 
@@ -311,5 +318,8 @@ def score_dns(
     columns + score.  Only raw events are scored (see score_flow)."""
     blob, rows, scores = _dns_scored(features, model, threshold)
     if rows is None:
-        rows = blob.decode("utf-8").split("\n")[:-1] if blob else []
+        rows = (
+            blob.decode("utf-8", "surrogateescape").split("\n")[:-1]
+            if blob else []
+        )
     return rows, scores

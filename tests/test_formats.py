@@ -153,3 +153,28 @@ def test_make_batches_covers_all_docs():
             lo, hi = c.doc_ptr[d], c.doc_ptr[d + 1]
             assert b.counts[i].sum() == c.counts[lo:hi].sum()
     assert sorted(seen) == list(range(c.num_docs))
+
+
+def test_non_utf8_round_trips_python_reader(tmp_path):
+    """Hostile raw wire bytes must survive the word_counts -> corpus ->
+    words.dat round trip via surrogateescape in the pure-Python reader
+    (the native reader's twin assertion lives in test_native_ingest.py,
+    which is skipped without g++)."""
+    import os
+
+    from oni_ml_tpu.io.corpus import Corpus
+
+    path = str(tmp_path / "wc.dat")
+    with open(path, "wb") as f:
+        f.write(b"1.2.3.4,w\xe9rd,5\n")
+    os.environ["ONI_ML_TPU_NO_NATIVE"] = "1"
+    try:
+        c = Corpus.from_word_counts_file(path)
+    finally:
+        del os.environ["ONI_ML_TPU_NO_NATIVE"]
+    assert c.vocab == ["w\udce9rd"]
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    c.save(out)
+    with open(os.path.join(out, "words.dat"), "rb") as f:
+        assert f.read() == b"0,w\xe9rd\n"

@@ -91,12 +91,23 @@ def test_count_overflow_raises(tmp_path):
         native.load_corpus(path)
 
 
-def test_non_utf8_raises(tmp_path):
+def test_non_utf8_round_trips(tmp_path):
+    """Raw wire bytes that are not valid UTF-8 (hostile DNS names, odd
+    IP field contents) must flow through the corpus stage byte-for-byte
+    via surrogateescape — in BOTH readers — not crash it."""
     path = str(tmp_path / "wc.dat")
+    payload = b"1.2.3.4,w\xe9rd,5\n"
     with open(path, "wb") as f:
-        f.write(b"1.2.3.4,w\xe9rd,5\n")
-    with pytest.raises(UnicodeDecodeError):
-        native.load_corpus(path)
+        f.write(payload)
+    c = native.load_corpus(path)
+    assert c.vocab == ["w\udce9rd"]
+    out = str(tmp_path / "out")
+    os.makedirs(out, exist_ok=True)
+    c.save(out)
+    with open(os.path.join(out, "words.dat"), "rb") as f:
+        assert f.read() == b"0,w\xe9rd\n"
+    # (Python-reader parity for the same bytes lives in test_formats.py,
+    # which runs even without the native build.)
 
 
 def test_malformed_line_raises(tmp_path):
