@@ -214,3 +214,28 @@ def test_model_row_lookup_matches_dict_semantics():
     empty = ScoringModel.from_results([], np.zeros((0, k)), [],
                                       np.zeros((0, k)), fallback=0.1)
     assert list(empty.ip_rows(["x", "y\x00"])) == [0, 0]
+
+
+def test_surrogate_bytes_flow_through_scoring():
+    """A DNS day containing a non-UTF-8 raw name (surrogateescape
+    str) must score without crashing, and the emitted CSV must carry
+    the ORIGINAL raw bytes."""
+    from oni_ml_tpu.features.native_dns import featurize_dns_sources
+    from oni_ml_tpu.scoring import score_dns_csv
+
+    rows = [
+        ["t", str(1454000000 + i), "100", f"10.0.0.{i % 4}",
+         "evil\udce9\udc80.bad" if i == 3 else f"s{i % 5}.ok.com",
+         "1", "1", "0"]
+        for i in range(40)
+    ]
+    feats = featurize_dns_sources([rows])   # falls back to Python path
+    vocab = sorted(set(feats.word))
+    ips = sorted({feats.client_ip(i) for i in range(feats.num_events)})
+    model = ScoringModel.from_results(
+        ips, np.full((len(ips), 4), 0.25), vocab,
+        np.full((len(vocab), 4), 0.25), fallback=0.1,
+    )
+    blob, scores = score_dns_csv(feats, model, threshold=np.inf)
+    assert len(scores) == 40
+    assert b"evil\xe9\x80.bad" in blob
