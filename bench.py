@@ -69,12 +69,11 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
     counts = jnp.asarray(rng.integers(1, 5, size=(b, l)), jnp.float32)
     doc_mask = jnp.ones((b,), jnp.float32)
 
-    use_dense = not force_sparse and dense_estep.available(b, v, k,
-                                                           precision)
-    wmajor = wmajor and use_dense and (
-        dense_estep.pick_block_w(b, v, k, precision) is not None
+    use_dense, use_wmajor, compiler_options = dense_estep.plan(
+        b, v, k, precision, wmajor=wmajor
     )
-    compiler_options = None
+    use_dense = use_dense and not force_sparse
+    wmajor = use_dense and use_wmajor
     if use_dense:
         dense = jax.jit(
             lambda w, c: dense_estep.densify(w, c, v)
@@ -82,10 +81,8 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
         if wmajor:
             dense = jnp.transpose(dense)
         groups = ((dense[None], doc_mask[None]),)
-        kib = dense_estep.scoped_vmem_kib(b, v, k, wmajor=wmajor,
-                                          precision=precision)
-        compiler_options = {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
     else:
+        compiler_options = None
         groups = ((word_idx[None], counts[None], doc_mask[None]),)
 
     run_chunk = fused.make_chunk_runner(

@@ -231,14 +231,7 @@ class OnlineLDATrainer:
         compiler_options = None
         if use_dense:
             v, k = self.num_terms, cfg.num_topics
-            wmajor = dense_estep.pick_block_w(b, v, k) is not None
-            kib = dense_estep.scoped_vmem_kib(b, v, k, wmajor=wmajor)
-            if jax.default_backend() == "tpu" and kib:
-                # The pallas_call's own VMEM limit can be dropped when
-                # XLA fusion-wraps the kernel (see scoped_vmem_kib).
-                compiler_options = {
-                    "xla_tpu_scoped_vmem_limit_kib": str(kib)
-                }
+            _, wmajor, compiler_options = dense_estep.plan(b, v, k)
 
             def e_fn(elog_beta, alpha, word_idx, counts, doc_mask):
                 dense = dense_estep.densify(word_idx, counts, v)

@@ -593,6 +593,31 @@ def e_step_dense(
     return estep.EStepResult(gamma, suff, alpha_ss, likelihood, iters)
 
 
+def plan(b: int, v: int, k: int, precision: str = "f32",
+         wmajor: bool = True):
+    """One-stop dense-path decision for single-batch drivers (the
+    online trainer and the bench; the batch trainer plans per shard
+    over multiple batch shapes and keeps its own logic): returns
+    (feasible, use_wmajor, compiler_options).
+
+    feasible — available(): a VMEM-feasible doc block exists on this
+    backend (TPU only); use_wmajor — the W-major layout's 128-lane
+    doc-block constraint holds (backend-independent, so forced-dense
+    interpret runs keep W-major coverage; callers store the corpus
+    transposed when set); compiler_options — the
+    xla_tpu_scoped_vmem_limit_kib dict drivers must pass to jax.jit,
+    or None (TPU only; see scoped_vmem_kib)."""
+    feasible = available(b, v, k, precision)
+    use_wmajor = wmajor and pick_block_w(b, v, k, precision) is not None
+    options = None
+    if feasible:
+        kib = scoped_vmem_kib(b, v, k, wmajor=use_wmajor,
+                              precision=precision)
+        if kib:
+            options = {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
+    return feasible, use_wmajor, options
+
+
 def available(b: int, v: int, k: int, precision: str = "f32") -> bool:
     """True when the shapes admit a VMEM-feasible block on TPU (at the
     precision the caller will actually run — bf16 mode needs more VMEM
