@@ -92,13 +92,14 @@ def compute_flow_qtiles(lines: Iterable[str], skip_header: bool = True):
 
 def main(argv: list[str] | None = None) -> int:
     args = sys.argv[1:] if argv is None else argv
-    if len(args) != 2:
+    wants_help = bool(args) and args[0] in ("-h", "--help")
+    if wants_help or len(args) != 2:
         print(
             "usage: python -m oni_ml_tpu.features.qtiles "
             "<raw_flow.csv> <out_qtiles>",
-            file=sys.stderr,
+            file=sys.stdout if wants_help else sys.stderr,
         )
-        return 2
+        return 0 if wants_help else 2
     with open(args[0]) as f:
         time_cuts, ibyt_cuts, ipkt_cuts = compute_flow_qtiles(
             line.rstrip("\n") for line in f
