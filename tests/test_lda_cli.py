@@ -96,3 +96,16 @@ def test_cli_rejects_bad_argv(capsys):
     assert lda_cli.main([
         "est", "2.5", "4", "s.txt", "20", "m.dat", "seeded", "out",
     ]) == 2
+
+
+def test_help_flag_exits_zero(capsys):
+    from oni_ml_tpu.runner.lda_cli import main as lda_main
+    from oni_ml_tpu.features.qtiles import main as qtiles_main
+
+    assert lda_main(["--help"]) == 0
+    assert "usage" in capsys.readouterr().out
+    assert qtiles_main(["-h"]) == 0
+    assert "usage" in capsys.readouterr().out
+    # empty argv stays the error path
+    assert lda_main([]) == 2
+    assert qtiles_main([]) == 2
