@@ -76,13 +76,13 @@ def config_from_settings(path: str, alpha: float, k: int) -> LDAConfig:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    wants_help = argv and argv[0] in ("-h", "--help")
+    wants_help = bool(argv) and argv[0] in ("-h", "--help")
     if wants_help or len(argv) != 8 or argv[0] != "est":
         print(
             "usage: python -m oni_ml_tpu.runner.lda_cli est <alpha> "
             "<num_topics> <settings.txt> <nproc-ignored> <model.dat> "
             "random <out_dir>",
-            file=sys.stderr if not wants_help else sys.stdout,
+            file=sys.stdout if wants_help else sys.stderr,
         )
         return 0 if wants_help else 2
     _, alpha_s, k_s, settings_path, _nproc, corpus_path, init, out_dir = argv
