@@ -576,3 +576,40 @@ def test_bf16_precision_close_and_validated(wmajor):
             log_beta, jnp.float32(2.5), dense, doc_mask,
             precision="fp8", **kw
         )
+
+
+def test_trainer_dense_precision_bf16_tracks_f32():
+    """LDAConfig.dense_precision='bf16' through the full batch trainer:
+    on the CPU test backend it emulates the TPU's MXU input truncation,
+    so the trained model must track the f32 run within bf16 rounding
+    while the EM structure (iteration count, finite lls) is identical."""
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    rng = np.random.default_rng(6)
+    b, l, v = 16, 16, 200
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v)
+    batch = Batch(
+        word_idx=np.asarray(word_idx),
+        counts=np.asarray(counts),
+        doc_mask=np.asarray(doc_mask),
+        doc_index=np.arange(b),
+    )
+    results = {}
+    for prec in ("f32", "bf16"):
+        cfg = LDAConfig(
+            num_topics=4, em_max_iters=5, em_tol=0.0,
+            var_max_iters=20, fused_em_chunk=3, seed=1,
+            dense_em="on", dense_precision=prec,
+        )
+        results[prec] = LDATrainer(cfg, num_terms=v).fit([batch], num_docs=b)
+
+    f32, bf16 = results["f32"], results["bf16"]
+    assert len(f32.likelihoods) == len(bf16.likelihoods)
+    np.testing.assert_allclose(
+        bf16.log_beta, f32.log_beta, rtol=5e-2, atol=5e-2
+    )
+    np.testing.assert_allclose(
+        [ll for ll, _ in bf16.likelihoods],
+        [ll for ll, _ in f32.likelihoods],
+        rtol=1e-2,
+    )
