@@ -83,6 +83,7 @@ class RunContext:
     mesh: object = None
     vocab_sharded: bool = False
     online: bool = False
+    eval_quality: bool = False
     metrics: list = field(default_factory=list)
 
     def path(self, name: str) -> str:
@@ -275,10 +276,40 @@ def stage_lda(ctx: RunContext) -> dict:
             ctx.path("word_results.csv"), corpus.vocab, result.log_beta
         )
     lls = [ll for ll, _ in result.likelihoods]
-    return {
+    out = {
         "em_iters": result.em_iters,
         "final_likelihood": lls[-1] if lls else None,
         "alpha": result.alpha,
+    }
+    if ctx.eval_quality and _is_coordinator():
+        out.update(_completion_score(ctx, result.log_beta, result.alpha,
+                                     corpus))
+    return out
+
+
+def _completion_score(ctx: RunContext, log_beta, alpha, corpus=None) -> dict:
+    """Document-completion score of the day's model (models/evaluate.py):
+    gamma fits on each doc's even token slots, the odd slots score under
+    the predictive distribution.  Run over the TRAINING day, this is a
+    drift-monitoring number comparable across days — NOT a true held-out
+    score (the odd tokens helped fit beta, so it is optimistic; for
+    hyperparameter selection use models.evaluate on an excluded corpus
+    split)."""
+    import math
+
+    from ..io import make_batches
+    from ..models.evaluate import held_out_per_token_ll
+
+    if corpus is None:
+        corpus = Corpus.from_model_dat(
+            ctx.path("model.dat"), ctx.path("words.dat"), ctx.path("doc.dat")
+        )
+    score = held_out_per_token_ll(
+        log_beta, alpha, make_batches(corpus, ctx.config.lda.batch_size)
+    )
+    return {
+        "completion_per_token_ll": score,
+        "completion_perplexity": math.exp(-score),
     }
 
 
@@ -351,6 +382,7 @@ def run_pipeline(
     vocab_sharded: bool = False,
     online: bool = False,
     publish: str | None = None,
+    eval_quality: bool = False,
 ) -> list[dict]:
     """Run (or resume) the pipeline for one day.  Completed stages are
     skipped unless `force`; `stages` restricts to a subset (they still run
@@ -366,6 +398,7 @@ def run_pipeline(
         mesh=mesh,
         vocab_sharded=vocab_sharded,
         online=online,
+        eval_quality=eval_quality,
     )
     import jax
 
@@ -389,7 +422,16 @@ def run_pipeline(
             skip = _coord_decision(skip)
         if skip:
             if is_coord:
-                ctx.emit({"stage": stage.value, "skipped": "outputs exist"})
+                record = {"stage": stage.value, "skipped": "outputs exist"}
+                if stage is Stage.LDA and ctx.eval_quality:
+                    # The eval only needs the saved model; a resumed run
+                    # still gets its day-quality number.
+                    other = formats.read_other(ctx.path("final.other"))
+                    log_beta = formats.read_beta(ctx.path("final.beta"))
+                    record.update(
+                        _completion_score(ctx, log_beta, other["alpha"])
+                    )
+                ctx.emit(record)
             continue
         err: Exception | None = None
         if is_coord or stage is Stage.LDA:
@@ -517,6 +559,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="feedback duplication (default: DUPFACTOR env or 1000)",
     )
     p.add_argument(
+        "--eval-quality", action="store_true",
+        help="score the day's model by document completion "
+        "(per-token log-likelihood / perplexity on each doc's "
+        "odd token slots; models/evaluate.py) and record it in the "
+        "lda stage metrics — a drift-monitoring number comparable "
+        "across days, optimistic vs a true held-out split",
+    )
+    p.add_argument(
         "--warm-start", action="store_true",
         help="seed each EM iteration's variational fixed point from the "
         "previous gamma (same optimum, fewer inner iterations; "
@@ -617,6 +667,7 @@ def main(argv: list[str] | None = None) -> int:
             vocab_sharded=vocab_sharded,
             online=args.online,
             publish=args.publish,
+            eval_quality=args.eval_quality,
         )
     return 0
 

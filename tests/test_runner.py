@@ -326,3 +326,25 @@ def test_runner_perf_flags(flow_day, capsys):
         "--warm-start", "--dense-precision", "bf16", "--force",
     ])
     assert rc == 0
+
+
+def test_eval_quality_flag_records_held_out_metrics(flow_day):
+    cfg, tmp_path = flow_day
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    metrics = run_pipeline(cfg, "20160122", "flow", force=True,
+                           eval_quality=True)
+    lda = next(m for m in metrics if m["stage"] == "lda")
+    assert np.isfinite(lda["completion_per_token_ll"])
+    assert lda["completion_per_token_ll"] < 0
+    assert lda["completion_perplexity"] > 1
+
+    # Resumed run (lda stage skipped): the metric still appears,
+    # computed from the saved final.beta/final.other.
+    metrics2 = run_pipeline(cfg, "20160122", "flow", eval_quality=True)
+    lda2 = next(m for m in metrics2 if m["stage"] == "lda")
+    assert lda2.get("skipped") == "outputs exist"
+    np.testing.assert_allclose(
+        lda2["completion_per_token_ll"], lda["completion_per_token_ll"],
+        rtol=1e-6,
+    )
