@@ -58,19 +58,29 @@ class LDAConfig:
     # previous iteration's gamma instead of the reference's fresh
     # alpha + N_d/K init (dense path only).  Reaches the same optimum —
     # measured: identical EM iteration count and final likelihood to
-    # ~1e-6 relative on a structured 60k-doc corpus, ~5-20% faster —
-    # but per-iteration likelihood.dat values differ from fresh-start
-    # lda-c semantics in late decimals, hence opt-in.
-    warm_start_gamma: bool = False
+    # ~1e-6 relative on a structured 60k-doc corpus, ~5-20% faster;
+    # per-iteration likelihood trajectory pinned to the fresh-start run
+    # within 1e-3 relative and the final state to 1e-5
+    # (tests/test_dense_estep.py::test_fused_warm_start_matches_fresh_
+    # trajectory).  Default ON; mid-run likelihood.dat values can differ
+    # from fresh-start lda-c semantics in late decimals, so the lda-c
+    # drop-in CLI (runner/lda_cli.py) and anyone needing bit-parity pin
+    # this False.
+    warm_start_gamma: bool = True
     # Storage dtype for the dense fixed-point matmul OPERANDS: "f32"
-    # (default) or "bf16".  On TPU this changes NO results — XLA's
-    # DEFAULT matmul precision already truncates f32 MXU inputs to bf16
-    # (single systolic pass; accumulation stays f32) — it only stores
-    # the [W, BB]-sized operands half-width in VMEM, measured ~10% off
-    # the E-step at the headline shape.  On CPU backends (tests,
-    # interpret mode) f32 matmuls are exact, so "bf16" there emulates
-    # the TPU's input truncation instead.  The suff-stats / ELBO tail
-    # pass always runs full-width off the converged gamma.
+    # (default) or "bf16".  Under XLA's DEFAULT matmul precision on
+    # current single-pass-bf16-MXU TPUs (measured on v5e) this changes
+    # NO results — that default already truncates f32 MXU inputs to
+    # bf16 (accumulation stays f32) — it only stores the [W, BB]-sized
+    # operands half-width in VMEM, measured ~10% off the E-step at the
+    # headline shape.  The equivalence does NOT survive a process-wide
+    # jax.default_matmul_precision("highest"/"float32") override or a
+    # hardware/XLA default change; ops/dense_estep.plan() checks the
+    # active default and refuses bf16 when it isn't DEFAULT.  On CPU
+    # backends (tests, interpret mode) f32 matmuls are exact, so "bf16"
+    # there emulates the TPU's input truncation instead.  The
+    # suff-stats / ELBO tail pass always runs full-width off the
+    # converged gamma.
     dense_precision: str = "f32"
     # Store the dense corpus transposed ([W, B]) so the gamma-update
     # matmul's small-K output axis pads to the 8-sublane granularity

@@ -211,6 +211,18 @@ class NativeDnsFeatures:
             self.word_table[self.word_id[i]],
         ]
 
+    def spill_rows(self, path: str) -> None:
+        """Move the projected-rows blob to a mmap-backed file
+        (features/blob.py): pickling stores the path, not the bytes.
+        DNS sources arrive as in-memory rows, so unlike the flow
+        featurizer's ingest-time spill this is post-hoc — it bounds the
+        pickle and everything after the pre stage, not the featurize
+        peak itself."""
+        if isinstance(self.rows_blob, (bytes, bytearray)):
+            from .blob import spill_bytes
+
+            self.rows_blob = spill_bytes(self.rows_blob, path)
+
     def __getstate__(self):
         state = dict(self.__dict__)
         state.pop("_lists")

@@ -72,6 +72,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.ffz_table_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.ffz_lines_blob.restype = ctypes.c_void_p
     lib.ffz_lines_blob.argtypes = [ctypes.c_void_p]
+    lib.ffz_set_spill.restype = ctypes.c_int
+    lib.ffz_set_spill.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ffz_spill_flush.restype = ctypes.c_int64
+    lib.ffz_spill_flush.argtypes = [ctypes.c_void_p]
     lib.ffz_line_offsets.restype = _I64P
     lib.ffz_line_offsets.argtypes = [ctypes.c_void_p]
     for fn, res in [
@@ -223,6 +227,17 @@ class NativeFlowFeatures:
             for i, w, c in zip(self.wc_ip, self.wc_word, self.wc_count)
         ]
 
+    def spill_lines(self, path: str) -> None:
+        """Move the raw-lines blob to a mmap-backed file (features/blob.py)
+        so pickling this container stores the path, not the bytes, and
+        post-featurize RSS drops to the numeric arrays.  No-op when the
+        blob was already spilled at ingest (featurize_flow_file
+        spill_path)."""
+        if isinstance(self.lines_blob, (bytes, bytearray)):
+            from .blob import spill_bytes
+
+            self.lines_blob = spill_bytes(self.lines_blob, path)
+
     # -- pickling (features.pkl survives without the native lib) ------------
 
     def __getstate__(self):
@@ -240,9 +255,14 @@ def _featurize_native(
     path: str,
     feedback_rows: Sequence[str],
     precomputed_cuts=None,
+    spill_path: str | None = None,
 ) -> NativeFlowFeatures:
     h = lib.ffz_create(1)
     try:
+        if spill_path is not None and lib.ffz_set_spill(
+            h, os.fsencode(spill_path)
+        ) < 0:
+            raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
         if lib.ffz_ingest_file(h, os.fsencode(path)) < 0:
             raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
         lib.ffz_mark_raw(h)
@@ -250,7 +270,8 @@ def _featurize_native(
             blob = ("\n".join(feedback_rows) + "\n").encode(
                 "utf-8", "surrogateescape"
             )
-            lib.ffz_ingest_buffer(h, blob, len(blob))
+            if lib.ffz_ingest_buffer(h, blob, len(blob)) < 0:
+                raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
         n = lib.ffz_num_events(h)
         num_time = _copy(lib.ffz_num_time(h), n, np.float64)
         ibyt = _copy(lib.ffz_ibyt(h), n, np.float64)
@@ -277,10 +298,20 @@ def _featurize_native(
         ):
             raise ValueError(lib.ffz_error(h).decode("utf-8", "replace"))
         nwc = lib.ffz_wc_len(h)
-        return NativeFlowFeatures(
-            lines_blob=ctypes.string_at(
+        if spill_path is not None:
+            from .blob import MmapBlob
+
+            if lib.ffz_spill_flush(h) < 0:  # short write: offsets would
+                raise OSError(             # point past the end of the file
+                    lib.ffz_error(h).decode("utf-8", "replace")
+                )
+            lines = MmapBlob(spill_path)
+        else:
+            lines = ctypes.string_at(
                 lib.ffz_lines_blob(h), lib.ffz_lines_blob_len(h)
-            ),
+            )
+        return NativeFlowFeatures(
+            lines_blob=lines,
             line_off=_copy(lib.ffz_line_offsets(h), n + 1, np.int64),
             ip_table=_table(lib, h, 0),
             word_table=_table(lib, h, 1),
@@ -309,11 +340,20 @@ def featurize_flow_file(
     path: str,
     feedback_rows: Sequence[str] = (),
     precomputed_cuts=None,
+    spill_path: str | None = None,
 ) -> "NativeFlowFeatures | FlowFeatures":
-    """Featurize a raw netflow CSV file, native when possible."""
+    """Featurize a raw netflow CSV file, native when possible.
+
+    `spill_path` streams kept raw rows to that file during ingest
+    instead of holding them in RAM (features/blob.py MmapBlob): RSS
+    stays bounded by the numeric per-event arrays, and pickling the
+    returned container stores the spill path, not the bytes.  The
+    Python fallback keeps rows in memory (it exists for environments
+    without a C++ toolchain, where day-scale data is not expected)."""
     lib = _LIB.load()
     if lib is not None:
-        return _featurize_native(lib, path, feedback_rows, precomputed_cuts)
+        return _featurize_native(lib, path, feedback_rows, precomputed_cuts,
+                                 spill_path=spill_path)
     from .lineio import iter_raw_lines
 
     return featurize_flow(

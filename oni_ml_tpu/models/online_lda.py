@@ -186,6 +186,14 @@ class OnlineLDATrainer:
                 f"OnlineLDAConfig.dense_em={config.dense_em!r}: expected "
                 "'auto', 'on', or 'off'"
             )
+        if config.dense_em == "on" and (e_step_fn is not None
+                                        or mesh is not None):
+            # Fail at construction, not at the first step() call: a
+            # misconfigured streaming job should die before startup.
+            raise ValueError(
+                "dense_em='on' needs the default single-process "
+                "E-step (no mesh, no custom e_step_fn)"
+            )
         self._custom_e_fn = e_step_fn is not None
         base = e_step_fn or estep.e_step
         self._e_fn = partial(
@@ -194,18 +202,26 @@ class OnlineLDATrainer:
         # One jitted update per micro-batch shape: the dense-vs-sparse
         # choice and the scoped-VMEM compiler option both depend on B,
         # which is only known when the first batch of a shape arrives.
+        # LRU-bounded (see _get_update): callers should bucket/pad
+        # micro-batch shapes (io.make_batches does) — naturally ragged
+        # streams would otherwise accumulate one compiled program per
+        # distinct (B, L) without limit.
         self._updates: dict = {}
+
+    # Max distinct (B, L) compiled updates kept resident.  io.make_batches
+    # produces one B and a handful of power-of-two L buckets, so a real
+    # deployment never evicts; the bound only protects long-running jobs
+    # fed un-bucketed ragged micro-batches from unbounded compile-cache
+    # growth (evicting the least-recently-used program costs a recompile
+    # if that shape ever returns).
+    _UPDATE_CACHE_MAX = 32
 
     def _use_dense(self, b: int) -> bool:
         from ..ops import dense_estep
 
         cfg = self.config
+        # dense_em='on' with a mesh/custom e_fn is rejected in __init__.
         if cfg.dense_em == "off" or self._custom_e_fn or self.mesh is not None:
-            if cfg.dense_em == "on":
-                raise ValueError(
-                    "dense_em='on' needs the default single-process "
-                    "E-step (no mesh, no custom e_step_fn)"
-                )
             return False
         feasible = dense_estep.pick_block(b, self.num_terms,
                                           cfg.num_topics) is not None
@@ -220,8 +236,9 @@ class OnlineLDATrainer:
 
     def _get_update(self, b: int, l: int):
         key = (b, l)
-        got = self._updates.get(key)
+        got = self._updates.pop(key, None)
         if got is not None:
+            self._updates[key] = got      # re-insert: most recently used
             return got
         from ..ops import dense_estep
 
@@ -256,6 +273,8 @@ class OnlineLDATrainer:
 
         jitted = jax.jit(update, donate_argnums=(0,),
                          compiler_options=compiler_options)
+        while len(self._updates) >= self._UPDATE_CACHE_MAX:
+            self._updates.pop(next(iter(self._updates)))
         self._updates[key] = jitted
         return jitted
 

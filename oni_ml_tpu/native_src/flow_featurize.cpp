@@ -59,6 +59,9 @@ struct Ffz {
   std::string header;
 
   std::string lines;                   // stripped kept rows, concatenated
+  FILE* spill = nullptr;               // when set, rows stream here
+  int64_t spill_len = 0;               // instead of the in-RAM blob
+  bool spill_err = false;              // short write (ENOSPC etc.)
   std::vector<int64_t> line_off{0};
   std::vector<double> time_, ibyt_, ipkt_, c10_, c11_;
   Interner ips;
@@ -102,8 +105,22 @@ struct Ffz {
     }
     if (nf != NCOLS) return;
 
-    lines.append(line.data(), line.size());
-    line_off.push_back((int64_t)lines.size());
+    if (spill) {
+      // Raw rows are only re-read at emit time (for flagged events);
+      // streaming them to the spill file keeps RSS bounded by the
+      // numeric arrays however many days are ingested.  A short write
+      // (ENOSPC mid-way through a 30-day ingest) must surface as an
+      // error, not as offsets pointing past the end of the file.
+      if (fwrite(line.data(), 1, line.size(), spill) != line.size()) {
+        spill_err = true;
+        error = "short write to raw-lines spill file (disk full?)";
+      }
+      spill_len += (int64_t)line.size();
+      line_off.push_back(spill_len);
+    } else {
+      lines.append(line.data(), line.size());
+      line_off.push_back((int64_t)lines.size());
+    }
     double h = to_double(f[C_HOUR]), m = to_double(f[C_MIN]),
            s = to_double(f[C_SEC]);
     time_.push_back(h + m / 60.0 + s / 3600.0);
@@ -139,7 +156,40 @@ void* ffz_create(int skip_header) {
   h->skip_header = skip_header != 0;
   return h;
 }
-void ffz_destroy(void* h) { delete (Ffz*)h; }
+void ffz_destroy(void* hv) {
+  Ffz* h = (Ffz*)hv;
+  if (h->spill) fclose(h->spill);
+  delete h;
+}
+
+// Route kept raw rows to `path` instead of RAM.  Call before any
+// ingest; returns -1 (with ffz_error set) when the file can't open.
+// ffz_spill_flush makes the bytes visible to a reader (mmap) — the
+// handle stays open so later ingests (feedback rows) keep appending.
+int ffz_set_spill(void* hv, const char* path) {
+  Ffz* h = (Ffz*)hv;
+  if (h->spill) fclose(h->spill);
+  h->spill = fopen(path, "wb");
+  if (!h->spill) {
+    h->error = std::string("cannot open spill file ") + path;
+    return -1;
+  }
+  return 0;
+}
+
+// Returns the spilled byte count, or -1 when any write/flush failed
+// (ffz_error describes it) — callers must not mmap a short file.
+int64_t ffz_spill_flush(void* hv) {
+  Ffz* h = (Ffz*)hv;
+  if (h->spill) {
+    if (fflush(h->spill) != 0 || ferror(h->spill)) {
+      h->spill_err = true;
+      if (h->error.empty())
+        h->error = "flush of raw-lines spill file failed (disk full?)";
+    }
+  }
+  return h->spill_err ? -1 : h->spill_len;
+}
 const char* ffz_error(void* h) { return ((Ffz*)h)->error.c_str(); }
 
 int64_t ffz_ingest_file(void* hv, const char* path) {
@@ -147,13 +197,13 @@ int64_t ffz_ingest_file(void* hv, const char* path) {
   bool ok = oni::stream_file(path, h->error, [h](const char* p, int64_t n) {
     h->ingest_buffer(p, n);
   });
-  return ok ? (int64_t)h->time_.size() : -1;
+  return (ok && !h->spill_err) ? (int64_t)h->time_.size() : -1;
 }
 
 int64_t ffz_ingest_buffer(void* hv, const char* buf, int64_t len) {
   Ffz* h = (Ffz*)hv;
   h->ingest_buffer(buf, len);
-  return (int64_t)h->time_.size();
+  return h->spill_err ? -1 : (int64_t)h->time_.size();
 }
 
 void ffz_mark_raw(void* hv) {
@@ -367,9 +417,13 @@ const int64_t* ffz_table_offsets(void* hv, int which) {
   return t.offsets.data();
 }
 
-const char* ffz_lines_blob(void* hv) { return ((Ffz*)hv)->lines.data(); }
+const char* ffz_lines_blob(void* hv) {
+  Ffz* h = (Ffz*)hv;
+  return h->spill ? nullptr : h->lines.data();  // spilled: read the file
+}
 int64_t ffz_lines_blob_len(void* hv) {
-  return (int64_t)((Ffz*)hv)->lines.size();
+  Ffz* h = (Ffz*)hv;
+  return h->spill ? h->spill_len : (int64_t)h->lines.size();
 }
 const int64_t* ffz_line_offsets(void* hv) {
   return ((Ffz*)hv)->line_off.data();

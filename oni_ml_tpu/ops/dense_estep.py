@@ -82,6 +82,23 @@ def _check_precision(precision: str) -> None:
             "LDAConfig.dense_precision); expected one of "
             f"{'/'.join(_PRECISIONS)}"
         )
+    if precision == "bf16":
+        # The "bf16 changes no results" equivalence (config.py
+        # dense_precision) holds only under XLA's DEFAULT matmul
+        # precision, where f32 MXU inputs are already bf16-truncated.
+        # A process/context default of "highest"/"float32" would make
+        # the f32 path genuinely full-precision and the bf16 operand
+        # storage a silent numerics change — refuse instead.
+        override = getattr(jax.config, "jax_default_matmul_precision", None)
+        if override is not None and str(override).upper() not in (
+            "DEFAULT", "BFLOAT16", "FASTEST",
+        ):
+            raise ValueError(
+                "dense_precision='bf16' requires XLA's DEFAULT matmul "
+                f"precision; the active default is {override!r} (set via "
+                "jax.default_matmul_precision), under which bf16 operand "
+                "storage would change results. Use dense_precision='f32'."
+            )
 
 
 def _cast_for(precision: str):
@@ -175,13 +192,22 @@ def padded_width(num_terms: int) -> int:
     return -(-num_terms // 128) * 128
 
 
-def densify(word_idx, counts, num_terms: int):
-    """[B, L] token lists -> [B, padded_width(V)] dense counts.  One
-    scatter, run once per batch group and amortized over every EM
-    iteration (padded tokens carry count 0, so they contribute nothing
-    to column 0)."""
+def densify(word_idx, counts, num_terms: int, width: int | None = None):
+    """[B, L] token lists -> [B, W] dense counts.  One scatter, run once
+    per batch group and amortized over every EM iteration (padded tokens
+    carry count 0, so they contribute nothing to column 0).
+
+    W defaults to padded_width(V) — the 128-lane tile the Pallas kernel
+    needs.  The XLA-level vocab-sharded dense path passes an explicit
+    `width` (the model-axis-divisible padded vocab) instead: XLA has no
+    lane-tile requirement, and matching the sharded beta width exactly
+    keeps shard ownership aligned with the sparse plan's."""
+    if width is None:
+        width = padded_width(num_terms)
+    elif width < num_terms:
+        raise ValueError(f"width {width} < num_terms {num_terms}")
     b = word_idx.shape[0]
-    dense = jnp.zeros((b, padded_width(num_terms)), counts.dtype)
+    dense = jnp.zeros((b, width), counts.dtype)
     return dense.at[jnp.arange(b)[:, None], word_idx].add(counts)
 
 
@@ -606,7 +632,12 @@ def plan(b: int, v: int, k: int, precision: str = "f32",
     interpret runs keep W-major coverage; callers store the corpus
     transposed when set); compiler_options — the
     xla_tpu_scoped_vmem_limit_kib dict drivers must pass to jax.jit,
-    or None (TPU only; see scoped_vmem_kib)."""
+    or None (TPU only; see scoped_vmem_kib).
+
+    Also validates `precision` eagerly (including the bf16
+    matmul-precision-override refusal) so drivers fail at plan time,
+    not deep inside a trace."""
+    _check_precision(precision)
     feasible = available(b, v, k, precision)
     use_wmajor = wmajor and pick_block_w(b, v, k, precision) is not None
     options = None

@@ -71,7 +71,13 @@ def read_settings(path: str) -> dict:
 
 
 def config_from_settings(path: str, alpha: float, k: int) -> LDAConfig:
-    return LDAConfig(num_topics=k, alpha_init=alpha, **read_settings(path))
+    # warm_start_gamma pinned off: this CLI is the drop-in for
+    # oni-lda-c (ml_ops.sh:80), whose E-step fresh-initializes gamma
+    # every EM iteration — warm start reaches the same optimum but
+    # shifts mid-run likelihood.dat values in late decimals, and this
+    # surface promises the reference's exact semantics.
+    return LDAConfig(num_topics=k, alpha_init=alpha,
+                     warm_start_gamma=False, **read_settings(path))
 
 
 def main(argv: list[str] | None = None) -> int:

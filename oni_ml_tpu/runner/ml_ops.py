@@ -202,8 +202,14 @@ def stage_pre(ctx: RunContext) -> dict:
             cuts = read_flow_qtiles(cfg.qtiles_path)
         from ..features.native_flow import featurize_flow_file
 
+        # Raw rows stream to a spill file during ingest: RSS stays
+        # bounded by the numeric arrays, and features.pkl references the
+        # file instead of embedding the whole day's bytes (config-3
+        # 30-day corpora do not fit RAM; the scorer mmaps rows back in
+        # on demand at emit time).
         features = featurize_flow_file(
-            cfg.flow_path, feedback_rows=fb_rows, precomputed_cuts=cuts
+            cfg.flow_path, feedback_rows=fb_rows, precomputed_cuts=cuts,
+            spill_path=ctx.path("raw_lines.bin"),
         )
     else:
         fb_rows = read_dns_feedback_rows(
@@ -222,6 +228,11 @@ def stage_pre(ctx: RunContext) -> dict:
             _dns_sources(cfg.dns_path), top_domains=top,
             feedback_rows=fb_rows,
         )
+        if hasattr(features, "spill_rows"):
+            # Post-hoc spill (DNS sources arrive in memory): keeps the
+            # projected-rows bytes out of features.pkl and out of RSS
+            # for every stage after pre.
+            features.spill_rows(ctx.path("raw_lines.bin"))
     with open(ctx.path("features.pkl"), "wb") as f:
         pickle.dump(features, f, protocol=pickle.HIGHEST_PROTOCOL)
     triples = features.word_counts()
@@ -316,6 +327,18 @@ def _completion_score(ctx: RunContext, log_beta, alpha, corpus=None) -> dict:
 def stage_score(ctx: RunContext) -> dict:
     with open(ctx.path("features.pkl"), "rb") as f:
         features = pickle.load(f)
+    # Spilled raw rows (stage_pre) are referenced by path; fail with a
+    # recoverable message if the spill file vanished since.
+    for attr in ("lines_blob", "rows_blob"):
+        blob = getattr(features, attr, None)
+        if blob is not None and hasattr(blob, "path") and not os.path.exists(
+            blob.path
+        ):
+            raise FileNotFoundError(
+                f"features.pkl references spilled raw rows at {blob.path}, "
+                "which no longer exists — re-run the pre stage "
+                "(--stages pre --force)"
+            )
     sc = ctx.config.scoring
     fallback = sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback
     model = ScoringModel.from_files(
@@ -567,17 +590,20 @@ def build_parser() -> argparse.ArgumentParser:
         "across days, optimistic vs a true held-out split",
     )
     p.add_argument(
-        "--warm-start", action="store_true",
+        "--warm-start", action=argparse.BooleanOptionalAction, default=True,
         help="seed each EM iteration's variational fixed point from the "
-        "previous gamma (same optimum, fewer inner iterations; "
-        "likelihood.dat differs from fresh-start lda-c semantics in "
-        "late decimals)",
+        "previous gamma (same optimum, fewer inner iterations; default "
+        "on — use --no-warm-start for the reference's fresh-start "
+        "likelihood.dat semantics, whose mid-run values differ in late "
+        "decimals)",
     )
     p.add_argument(
         "--dense-precision", choices=["f32", "bf16"], default="f32",
         help="dense E-step matmul operand storage; bf16 is bit-identical "
-        "on TPU (DEFAULT matmul precision already truncates MXU inputs) "
-        "and ~10%% faster",
+        "under XLA's DEFAULT matmul precision on current TPUs (measured "
+        "on v5e; that default already truncates MXU inputs — refused if "
+        "a jax.default_matmul_precision override is active) and ~10%% "
+        "faster",
     )
     p.add_argument(
         "--online", action="store_true",

@@ -91,6 +91,13 @@ def _collect(lib, ptr, out_len) -> bytes:
         lib.emit_free(ptr)
 
 
+def _blob_arg(blob):
+    """bytes pass through; MmapBlob (spilled raw lines, features/blob.py)
+    hands over the address of its read-only mapping — the emitter only
+    reads, and the OS pages rows in on demand."""
+    return blob.as_c_char_p() if hasattr(blob, "as_c_char_p") else blob
+
+
 def flow_emit(features, src_scores, dest_scores, order) -> bytes | None:
     """Scored-CSV buffer for NativeFlowFeatures, or None when the
     native library is unavailable."""
@@ -118,7 +125,7 @@ def flow_emit(features, src_scores, dest_scores, order) -> bytes | None:
     ]
     out_len = ctypes.c_int64(0)
     ptr = lib.flow_emit(
-        features.lines_blob, _i64p(holds[0]),
+        _blob_arg(features.lines_blob), _i64p(holds[0]),
         ip_blob, _i64p(holds[1]),
         word_blob, _i64p(holds[2]),
         _i32p(holds[3]), _i32p(holds[4]),
@@ -155,7 +162,7 @@ def dns_emit(features, scores, order) -> bytes | None:
     ]
     out_len = ctypes.c_int64(0)
     ptr = lib.dns_emit(
-        features.rows_blob, _i64p(holds[0]),
+        _blob_arg(features.rows_blob), _i64p(holds[0]),
         dom_blob, _i64p(holds[1]),
         sub_blob, _i64p(holds[2]),
         word_blob, _i64p(holds[3]),

@@ -243,6 +243,9 @@ def test_trainer_dense_mode_matches_sparse():
         cfg = LDAConfig(
             num_topics=4, em_max_iters=6, em_tol=0.0,
             var_max_iters=20, fused_em_chunk=3, seed=1, dense_em=mode,
+            # This test pins dense-vs-sparse NUMERICS; warm start (dense
+            # only) would make the trajectories differ by design.
+            warm_start_gamma=False,
         )
         trainer = LDATrainer(cfg, num_terms=v)
         results[mode] = trainer.fit([batch], num_docs=b - 2)
@@ -578,6 +581,21 @@ def test_bf16_precision_close_and_validated(wmajor):
         )
 
 
+def test_bf16_refused_under_matmul_precision_override():
+    """The 'bf16 changes no results' promise only holds under XLA's
+    DEFAULT matmul precision; a process-wide "highest"/"float32"
+    override must be refused, not silently degraded (ADVICE r2)."""
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "float32")
+    try:
+        with pytest.raises(ValueError, match="DEFAULT matmul precision"):
+            dense_estep._check_precision("bf16")
+    finally:
+        jax.config.update("jax_default_matmul_precision", None)
+    dense_estep._check_precision("bf16")  # back to DEFAULT: accepted
+
+
 def test_trainer_dense_precision_bf16_tracks_f32():
     """LDAConfig.dense_precision='bf16' through the full batch trainer:
     on the CPU test backend it emulates the TPU's MXU input truncation,
@@ -600,6 +618,9 @@ def test_trainer_dense_precision_bf16_tracks_f32():
             num_topics=4, em_max_iters=5, em_tol=0.0,
             var_max_iters=20, fused_em_chunk=3, seed=1,
             dense_em="on", dense_precision=prec,
+            # This test pins bf16-vs-f32 NUMERICS; warm start compounds
+            # start-point differences across EM iterations by design.
+            warm_start_gamma=False,
         )
         results[prec] = LDATrainer(cfg, num_terms=v).fit([batch], num_docs=b)
 

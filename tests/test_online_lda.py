@@ -286,11 +286,29 @@ def test_dense_em_validation():
             OnlineLDAConfig(num_topics=4, dense_em="dense"),
             num_terms=50, total_docs=10,
         )
-    # forced dense + custom e_step_fn is contradictory
-    tr = OnlineLDATrainer(
-        OnlineLDAConfig(num_topics=4, dense_em="on"),
-        num_terms=50, total_docs=10,
-        e_step_fn=lambda *a, **k: None,
-    )
+    # forced dense + custom e_step_fn is contradictory — and must fail
+    # at construction, not at the first step() (ADVICE r2)
     with pytest.raises(ValueError, match="dense_em='on'"):
-        tr._use_dense(16)
+        OnlineLDATrainer(
+            OnlineLDAConfig(num_topics=4, dense_em="on"),
+            num_terms=50, total_docs=10,
+            e_step_fn=lambda *a, **k: None,
+        )
+
+
+def test_update_cache_is_bounded():
+    """The per-(B, L) jitted-update cache must not grow without bound
+    when fed un-bucketed ragged micro-batch shapes (ADVICE r2)."""
+    tr = OnlineLDATrainer(
+        OnlineLDAConfig(num_topics=4, dense_em="off"),
+        num_terms=50, total_docs=10_000,
+    )
+    cap = tr._UPDATE_CACHE_MAX
+    for l in range(1, cap + 10):
+        tr._get_update(8, l)
+    assert len(tr._updates) == cap
+    # LRU: a hit refreshes recency, so the hit survives the next insert.
+    first_kept = (8, 10)
+    tr._get_update(*first_kept)
+    tr._get_update(8, cap + 10)
+    assert first_kept in tr._updates

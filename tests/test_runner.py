@@ -304,26 +304,30 @@ def test_runner_rejects_bad_date():
 
 
 def test_runner_perf_flags(flow_day, capsys):
-    """--warm-start / --dense-precision must reach LDAConfig and the run
-    must still produce the full stage sequence (on CPU the dense path is
-    gated off, so these only steer config — the semantics knobs are
+    """--[no-]warm-start / --dense-precision must reach LDAConfig and the
+    run must still produce the full stage sequence (on CPU the dense path
+    is gated off, so these only steer config — the semantics knobs are
     exercised by tests/test_dense_estep.py)."""
     cfg, tmp_path = flow_day
     from oni_ml_tpu.runner.ml_ops import _build_config, build_parser, main
 
     args = build_parser().parse_args([
-        "20160122", "flow", "1.1", "--warm-start",
-        "--dense-precision", "bf16",
+        "20160122", "flow", "1.1", "--dense-precision", "bf16",
     ])
     built = _build_config(args)
-    assert built.lda.warm_start_gamma is True
+    assert built.lda.warm_start_gamma is True      # default on
     assert built.lda.dense_precision == "bf16"
+
+    fresh = _build_config(build_parser().parse_args([
+        "20160122", "flow", "1.1", "--no-warm-start",
+    ]))
+    assert fresh.lda.warm_start_gamma is False
 
     rc = main([
         "20160122", "flow", "1.1",
         "--data-dir", str(tmp_path), "--flow-path", cfg.flow_path,
         "--topics", "4", "--em-max-iters", "3", "--batch-size", "32",
-        "--warm-start", "--dense-precision", "bf16", "--force",
+        "--no-warm-start", "--dense-precision", "bf16", "--force",
     ])
     assert rc == 0
 
@@ -348,3 +352,37 @@ def test_eval_quality_flag_records_held_out_metrics(flow_day):
         lda2["completion_per_token_ll"], lda["completion_per_token_ll"],
         rtol=1e-6,
     )
+
+
+def test_pre_stage_spills_raw_lines(flow_day):
+    """stage_pre streams raw rows to raw_lines.bin (native path):
+    features.pkl must reference the spill file, not embed the bytes,
+    and a vanished spill file must fail the score stage with a
+    recoverable message (VERDICT r2 weak-item 2)."""
+    import pickle
+
+    from oni_ml_tpu.features import native_flow
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    if not native_flow.available():
+        pytest.skip("native flow featurizer unavailable")
+    cfg, tmp_path = flow_day
+    run_pipeline(cfg, "20160122", "flow", force=True)
+    day = tmp_path / "20160122"
+    spill = day / "raw_lines.bin"
+    assert spill.exists() and spill.stat().st_size > 0
+    with open(day / "features.pkl", "rb") as f:
+        feats = pickle.load(f)
+    from oni_ml_tpu.features.blob import MmapBlob
+
+    assert isinstance(feats.lines_blob, MmapBlob)
+    # The pickle references the spill path; raw row bytes must not be
+    # embedded (a distinctive slice of the spilled blob is absent).
+    probe = spill.read_bytes()[:64]
+    assert probe not in (day / "features.pkl").read_bytes()
+    # Resume with the spill file gone: the score stage must say how to
+    # recover instead of crashing deep in emit.
+    (day / "flow_results.csv").unlink()
+    spill.unlink()
+    with pytest.raises(FileNotFoundError, match="re-run the pre stage"):
+        run_pipeline(cfg, "20160122", "flow", stages=["score"])
