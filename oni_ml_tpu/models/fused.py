@@ -81,7 +81,7 @@ def stack_batches(
 
 def densify_groups(
     groups: StackedGroups, num_terms: int, wmajor: bool = False,
-    put: Callable | None = None,
+    put: Callable | None = None, width: int | None = None,
 ) -> StackedGroups:
     """Convert stacked sparse groups to dense-counts groups for the
     gather/scatter-free E-step (ops/dense_estep.py).
@@ -91,11 +91,12 @@ def densify_groups(
     the transposed layout the W-major kernel consumes.  The scatter runs
     ONCE here and is amortized over every EM iteration of the run — that
     amortization is the whole point (a per-iteration scatter is what the
-    dense path exists to avoid)."""
+    dense path exists to avoid).  `width` overrides the dense width (the
+    vocab-sharded XLA path matches it to the sharded beta width)."""
     from ..ops import dense_estep
 
     def one(w, c):
-        d = dense_estep.densify(w, c, num_terms)
+        d = dense_estep.densify(w, c, num_terms, width=width)
         return d.T if wmajor else d
 
     arrays = []

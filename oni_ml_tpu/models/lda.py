@@ -440,14 +440,15 @@ class LDATrainer:
 
     def _use_dense(self, batches) -> bool:
         """Decide whether the fused loop runs the dense-corpus E-step
-        (ops/dense_estep.py).  Auto mode requires: a TPU backend, full
-        (unsharded) vocabulary, the stock E-step or this package's own
-        data-parallel wrapper (a user's custom e_step_fn must not be
-        silently bypassed), VMEM-feasible doc blocks for every PER-SHARD
-        batch, and the densified corpus under the HBM budget.  With a
-        data mesh the kernel runs under shard_map
-        (parallel.make_data_parallel_dense_e_step), suff-stats psum'd
-        over ICI."""
+        (ops/dense_estep.py).  Auto mode requires: a TPU backend, the
+        stock E-step or this package's own sharded wrappers (a user's
+        custom e_step_fn must not be silently bypassed), VMEM-feasible
+        doc blocks for every PER-SHARD batch, and the densified corpus
+        under the HBM budget.  With a data mesh the Pallas kernel runs
+        under shard_map (parallel.make_data_parallel_dense_e_step),
+        suff-stats psum'd over ICI; with a vocab-sharded trainer the
+        XLA-level make_vocab_sharded_dense_e_step plan applies instead
+        (_use_dense_vocab_sharded)."""
         from ..ops import dense_estep
 
         env = os.environ.get("ONI_ML_TPU_ESTEP", "")
@@ -462,10 +463,10 @@ class LDATrainer:
         if mode == "off":
             return False
         own_parallel = getattr(self._e_base, "_oni_data_parallel", False)
+        if self.vocab_sharded:
+            return self._use_dense_vocab_sharded(batches, mode)
         incompatible = (
-            "the vocabulary is sharded (the dense kernel needs full V)"
-            if self.vocab_sharded
-            else "a custom e_step_fn is installed"
+            "a custom e_step_fn is installed"
             if self._e_base is not estep.e_step and not own_parallel
             else None
         )
@@ -509,6 +510,49 @@ class LDATrainer:
             <= self.config.dense_hbm_budget
         )
 
+    def _use_dense_vocab_sharded(self, batches, mode) -> bool:
+        """Gate for the vocab-sharded dense plan
+        (parallel.make_vocab_sharded_dense_e_step): an XLA-level matmul
+        fixed point with C and beta sharded over `model` — config 4's
+        MXU path.  No Pallas/VMEM feasibility applies (XLA tiles any
+        shape); the auto-mode gate is device memory: each data shard
+        materializes its [B/d, W] densify transient before the model
+        axis splits it, and the run keeps a resident [docs/d, W/m]
+        corpus slice per device."""
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        d = self.mesh.shape[DATA_AXIS]
+        m = self.mesh.shape[MODEL_AXIS]
+        own_vocab = getattr(self._e_base, "_oni_vocab_sharded", False)
+        incompatible = (
+            "the vocabulary is sharded and the installed e_step_fn is "
+            "not this package's vocab-sharded plan"
+            if not own_vocab
+            else f"padded vocab {self.num_terms} not divisible by "
+            f"model axis {m}"
+            if self.num_terms % m
+            else None
+        )
+        if incompatible:
+            if mode == "on":
+                raise ValueError(f"dense E-step forced but {incompatible}")
+            return False
+        if mode == "on":
+            return True
+        if jax.default_backend() != "tpu":
+            return False
+        total_docs = sum(b.word_idx.shape[0] for b in batches)
+        sparse_bytes = sum(b.word_idx.size * 8 for b in batches) // d
+        transient = (
+            max(b.word_idx.shape[0] for b in batches) // d
+            * self.num_terms * 4
+        )
+        resident = total_docs // d * (self.num_terms // m) * 4
+        return (
+            transient + resident + sparse_bytes
+            <= self.config.dense_hbm_budget
+        )
+
     def _fused_loop(
         self, batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
         likelihoods, ll_file, progress, checkpoint_path, gamma_out,
@@ -543,7 +587,33 @@ class LDATrainer:
         use_dense = self._use_dense(batches)
         use_wmajor = False
         dense_e_fn = None
-        if use_dense:
+        if use_dense and self.vocab_sharded:
+            from functools import partial as _partial
+
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import sharded
+            from ..parallel.mesh import DATA_AXIS as _DA, MODEL_AXIS as _MA
+
+            # XLA-level vocab-sharded dense plan: stacked dense groups
+            # [NB, B, W] shard docs over `data` and vocab columns over
+            # `model`; width == the (model-divisible) padded vocab, so
+            # suff-stats land exactly in the sparse plan's shard layout
+            # and the vocab-sharded m_step consumes them unchanged.
+            dense_sh = NamedSharding(self.mesh, P(None, _DA, _MA))
+            dense_e_fn = _partial(
+                sharded.make_vocab_sharded_dense_e_step(
+                    self.mesh, precision=cfg.dense_precision
+                ),
+                var_max_iters=cfg.var_max_iters,
+                var_tol=cfg.var_tol,
+            )
+            groups = fused.densify_groups(
+                groups, self.num_terms, wmajor=False,
+                put=lambda x: jax.device_put(x, dense_sh),
+                width=self.num_terms,
+            )
+        elif use_dense:
             from functools import partial as _partial
 
             from ..ops import dense_estep

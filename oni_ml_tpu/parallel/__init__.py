@@ -9,7 +9,12 @@ from .mesh import (
     replicated,
     vocab_sharding,
 )
-from .sharded import make_data_parallel_e_step, make_vocab_sharded_fns, pad_vocab
+from .sharded import (
+    make_data_parallel_e_step,
+    make_vocab_sharded_dense_e_step,
+    make_vocab_sharded_fns,
+    pad_vocab,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -22,6 +27,7 @@ __all__ = [
     "replicated",
     "vocab_sharding",
     "make_data_parallel_e_step",
+    "make_vocab_sharded_dense_e_step",
     "make_vocab_sharded_fns",
     "pad_vocab",
 ]

@@ -135,6 +135,177 @@ def make_data_parallel_dense_e_step(mesh: Mesh, wmajor: bool = False,
     return wrapped
 
 
+def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
+    """Dense-corpus E-step with the VOCABULARY sharded over `model` and
+    documents over `data` — BASELINE.json config 4 (high-cardinality DNS
+    vocab, dns_pre_lda.scala:320-326) at MXU density.
+
+    Each device owns C_l [B/d, W/m] (its doc rows x its vocab columns)
+    and beta_l [K, W/m]; the densified corpus never exists whole on any
+    chip, so huge-V corpora that blow the single-chip HBM budget shard
+    down to fit.  Per fixed-point iteration the only collective is the
+    gamma-update contraction s = psum_model(ratio_l @ beta_l^T) — a
+    [B/d, K] array (K=20: a few KB), riding ICI — because q[b, w] and
+    ratio[b, w] are local to the vocab shard that owns column w, while
+    gamma/exp_et are replicated across the model axis (every shard in a
+    model group computes them identically from the psum'd s, so no
+    broadcast is ever materialized).  This mirrors the sparse
+    vocab-sharded plan's slab psum (local_e_step above) but moves the
+    arithmetic from gather/scatter to XLA matmuls on the MXU; at config-4
+    scale the corpus streams from HBM each iteration regardless, so an
+    XLA-level loop costs nothing over a Pallas kernel and composes with
+    sharding for free.
+
+    The batch trainer selects this plan automatically
+    (models/lda.py _use_dense_vocab_sharded) when the trainer is
+    vocab-sharded, dense_em allows it, and the per-device corpus slices
+    fit the HBM budget; the per-EM-iteration semantics are pinned to the
+    unwrapped dense kernel by
+    tests/test_sharded.py::test_vocab_sharded_DENSE_e_step_parity and
+    end-to-end by test_full_training_parity_vocab_sharded_dense.
+
+    Semantics match ops/dense_estep.e_step_dense (same fresh init, same
+    q + 1e-30 guard, same masked-delta stop, full-f32 tail with in-loop
+    optional bf16 operand storage, warm start via gamma_prev/warm).
+    Requirements: dense width == log_beta width, both divisible by the
+    model-axis size; batch divisible by the data-axis size.  Pad the
+    vocab with pad_vocab + LOG_ZERO beta columns — padded C columns are
+    zero so every contraction over them is exact.
+    """
+    from jax.scipy.special import digamma, gammaln
+
+    from ..ops import dense_estep
+
+    d_sz, m_sz = mesh.shape[DATA_AXIS], mesh.shape[MODEL_AXIS]
+    dense_estep._check_precision(precision)
+    cast = dense_estep._cast_for(precision)
+
+    def local(log_beta_l, alpha, c_l, doc_mask, gamma_prev, warm,
+              var_max_iters, var_tol):
+        k = log_beta_l.shape[0]
+        beta_l = jnp.exp(log_beta_l)               # [K, W_l]
+        beta_m = cast(beta_l)
+        mask_col = doc_mask[:, None]
+        n_d = jax.lax.psum(c_l.sum(axis=1), MODEL_AXIS)   # [B_l]
+
+        def e_log_theta(gamma):
+            return digamma(gamma) - digamma(gamma.sum(1, keepdims=True))
+
+        def qmat(exp_et, b):
+            return jax.lax.dot_general(
+                exp_et, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) + 1e-30
+
+        def body(state):
+            gamma, it, _ = state
+            exp_et = jnp.exp(e_log_theta(gamma))   # [B_l, K] (replicated
+            q = qmat(cast(exp_et), beta_m)         #  across model)
+            ratio = c_l / q
+            s = jax.lax.psum(                      # [B_l, K]: THE collective
+                jax.lax.dot_general(
+                    cast(ratio), beta_m, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ),
+                MODEL_AXIS,
+            )
+            gamma_new = alpha + exp_et * s
+            # gamma is bit-identical across the model group, so every
+            # shard reaches the same stop decision — the psum inside the
+            # loop stays collective-consistent.
+            delta = jnp.max(
+                jnp.mean(jnp.abs(gamma_new - gamma), axis=1) * doc_mask
+            )
+            return gamma_new, it + 1, delta
+
+        def cond(state):
+            _, it, delta = state
+            return jnp.logical_and(it < var_max_iters, delta > var_tol)
+
+        fresh0 = alpha + (n_d / k)[:, None] + jnp.zeros(
+            (c_l.shape[0], k), c_l.dtype
+        )
+        gamma0 = jnp.where(warm != 0, gamma_prev, fresh0)
+        # delta varies over `data` (each data row stops independently);
+        # the initial scalar must carry the same varying-axes type.
+        delta0 = jax.lax.pcast(
+            jnp.asarray(jnp.inf, c_l.dtype), DATA_AXIS, to="varying"
+        )
+        gamma, iters, _ = jax.lax.while_loop(
+            cond, body,
+            (gamma0, jnp.asarray(0, jnp.int32), delta0),
+        )
+
+        # Full-f32 tail off the converged gamma (dense-kernel semantics).
+        e_lt = e_log_theta(gamma)
+        exp_et = jnp.exp(e_lt)
+        q = qmat(exp_et, beta_l)
+        ratio = (c_l / q) * mask_col
+        t_l = jax.lax.dot_general(                 # [K, W_l]
+            exp_et * mask_col, ratio, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        suff_l = (beta_l * t_l).T                  # [W_l, K]
+        # Token ELBO term spans the sharded vocab axis: psum over model.
+        # The gamma-Dirichlet terms and alpha_ss are per-doc quantities
+        # computed identically on every model shard: psum over data ONLY
+        # (a model psum would count them m times).
+        tok = jax.lax.psum(
+            jnp.sum(c_l * jnp.log(q) * mask_col), MODEL_AXIS
+        )
+        core = jnp.sum(
+            (
+                jnp.sum((alpha - gamma) * e_lt + gammaln(gamma), axis=1)
+                - gammaln(gamma.sum(axis=1))
+            )
+            * doc_mask
+        )
+        alpha_const = gammaln(k * alpha) - k * gammaln(alpha)
+        ll = core + tok + doc_mask.sum() * alpha_const
+        ass = jnp.sum(e_lt.sum(axis=1) * doc_mask)
+        return estep.EStepResult(
+            gamma=gamma,
+            suff_stats=jax.lax.psum(suff_l, DATA_AXIS),
+            alpha_ss=jax.lax.psum(ass, DATA_AXIS),
+            likelihood=jax.lax.psum(ll, DATA_AXIS),
+            vi_iters=jax.lax.pmax(iters, DATA_AXIS),
+        )
+
+    def wrapped(log_beta, alpha, dense, doc_mask, gamma_prev, warm,
+                var_max_iters, var_tol):
+        b, w = dense.shape
+        if b % d_sz:
+            raise ValueError(
+                f"batch {b} not divisible by data axis {d_sz}"
+            )
+        if w % m_sz:
+            raise ValueError(
+                f"dense width {w} not divisible by model axis {m_sz} "
+                "(pad with parallel.pad_vocab)"
+            )
+        if log_beta.shape[1] != w:
+            raise ValueError(
+                f"log_beta width {log_beta.shape[1]} != dense width {w} "
+                "(pad log_beta with LOG_ZERO columns to match)"
+            )
+        fn = jax.shard_map(
+            partial(local, var_max_iters=var_max_iters, var_tol=var_tol),
+            mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS), P(), P(DATA_AXIS, MODEL_AXIS),
+                      P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=estep.EStepResult(
+                gamma=P(DATA_AXIS),
+                suff_stats=P(MODEL_AXIS, None),
+                alpha_ss=P(),
+                likelihood=P(),
+                vi_iters=P(),
+            ),
+        )
+        return fn(log_beta, alpha, dense, doc_mask, gamma_prev, warm)
+
+    return wrapped
+
+
 def make_vocab_sharded_fns(mesh: Mesh):
     """Returns (e_step_fn, m_step_fn) with beta/suff-stats vocab-sharded
     over `model` and batches sharded over `data`.
@@ -215,6 +386,11 @@ def make_vocab_sharded_fns(mesh: Mesh):
         )
         return fn(suff)
 
+    # Lets the trainer's dense-mode check recognize this package's own
+    # vocab-sharded plan (a user's custom e_step_fn must never be
+    # silently bypassed by the dense path).
+    e_step_fn._oni_vocab_sharded = True
+    m_step_fn._oni_vocab_sharded = True
     return e_step_fn, m_step_fn
 
 
