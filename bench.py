@@ -289,6 +289,35 @@ def bench_dns_scoring(n_events=400_000, reps=3):
     return n_events / p50, p50
 
 
+def _write_flow_day(f, n_events, n_src=4000, n_dst=2000, seed=11,
+                    chunk=200_000):
+    """Write a synthetic 27-column netflow day (no header) to an open
+    text file, chunked so multi-million-event days don't hold every
+    line in RAM."""
+    rng = np.random.default_rng(seed)
+    svc = np.asarray([80, 443, 22, 53, 8080, 25])
+    for start in range(0, n_events, chunk):
+        m = min(chunk, n_events - start)
+        hours = rng.integers(0, 24, size=m)
+        mins = rng.integers(0, 60, size=m)
+        secs = rng.integers(0, 60, size=m)
+        sip_i = rng.integers(0, n_src, size=m)
+        dip_i = rng.integers(0, n_dst, size=m)
+        sports = rng.integers(1024, 60000, size=m)
+        dports = svc[rng.integers(0, len(svc), size=m)]
+        ipkts = rng.integers(1, 100, size=m)
+        ibyts = rng.integers(40, 100_000, size=m)
+        f.write("\n".join(
+            "2016-01-22,1453420800,2016,1,22,"
+            f"{hours[i]},{mins[i]},{secs[i]},0.0,"
+            f"10.0.{sip_i[i] >> 8}.{sip_i[i] & 255},"
+            f"10.1.{dip_i[i] >> 8}.{dip_i[i] & 255},"
+            f"{sports[i]},{dports[i]},TCP,,0,0,{ipkts[i]},{ibyts[i]},"
+            "0,0,0,0,0,0,0,"
+            for i in range(m)
+        ) + "\n")
+
+
 def bench_flow_scoring(n_events=400_000, reps=3):
     """Full score_flow stage over a synthetic day — the reference's
     PRIMARY workload (flow_post_lda.scala:227-248): per event TWO
@@ -306,30 +335,10 @@ def bench_flow_scoring(n_events=400_000, reps=3):
 
     rng = np.random.default_rng(11)
     k = 20
-    n_src, n_dst = 4000, 2000
-    svc = np.asarray([80, 443, 22, 53, 8080, 25])
-    hours = rng.integers(0, 24, size=n_events)
-    mins = rng.integers(0, 60, size=n_events)
-    secs = rng.integers(0, 60, size=n_events)
-    sip_i = rng.integers(0, n_src, size=n_events)
-    dip_i = rng.integers(0, n_dst, size=n_events)
-    sports = rng.integers(1024, 60000, size=n_events)
-    dports = svc[rng.integers(0, len(svc), size=n_events)]
-    ipkts = rng.integers(1, 100, size=n_events)
-    ibyts = rng.integers(40, 100_000, size=n_events)
-    lines = [
-        "2016-01-22,1453420800,2016,1,22,"
-        f"{hours[i]},{mins[i]},{secs[i]},0.0,"
-        f"10.0.{sip_i[i] >> 8}.{sip_i[i] & 255},"
-        f"10.1.{dip_i[i] >> 8}.{dip_i[i] & 255},"
-        f"{sports[i]},{dports[i]},TCP,,0,0,{ipkts[i]},{ibyts[i]},"
-        "0,0,0,0,0,0,0,"
-        for i in range(n_events)
-    ]
     fd, path = tempfile.mkstemp(suffix=".csv")
     try:
         with os.fdopen(fd, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            _write_flow_day(f, n_events)
         feats = featurize_flow_file(path)
     finally:
         os.unlink(path)
@@ -357,6 +366,54 @@ def bench_flow_scoring(n_events=400_000, reps=3):
     p50 = float(np.median(times))
     assert len(blob) and len(scores)
     return n_events / p50, p50
+
+
+def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
+                       em_max_iters=40):
+    """One full `run_pipeline` day — the reference's actual unit of work
+    (`./ml_ops.sh YYYYMMDD flow`, timed per stage at ml_ops.sh:57-108):
+    featurize + word counts, corpus build, LDA to convergence, scoring +
+    emit, on a synthetic ~5M-event flow day.  Returns (total_seconds,
+    {stage: seconds}, events_per_sec) so any host-side stage that comes
+    to dominate the device work is visible in the breakdown."""
+    import shutil
+    import tempfile
+
+    from oni_ml_tpu.config import (
+        FeedbackConfig,
+        LDAConfig,
+        PipelineConfig,
+        ScoringConfig,
+    )
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    work = tempfile.mkdtemp(prefix="oni_e2e_")
+    _E2E_WORKDIRS.append(work)  # watchdog hard-exit cleans these up
+    try:
+        raw = os.path.join(work, "flow_day.csv")
+        with open(raw, "w") as f:
+            _write_flow_day(f, n_events, n_src=n_src, n_dst=n_dst)
+        cfg = PipelineConfig(
+            data_dir=work,
+            flow_path=raw,
+            lda=LDAConfig(batch_size=4096, em_max_iters=em_max_iters),
+            feedback=FeedbackConfig(),
+            # Reference-like tiny TOL: almost nothing emitted — the
+            # emit-heavy path is measured by bench_flow_scoring.
+            scoring=ScoringConfig(threshold=1e-20),
+        )
+        t0 = time.perf_counter()
+        metrics = run_pipeline(cfg, "20160122", "flow", force=True)
+        total = time.perf_counter() - t0
+        stages = {
+            m["stage"]: round(m["wall_s"], 2)
+            for m in metrics
+            if "wall_s" in m
+        }
+        return total, stages, n_events / total
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+        _E2E_WORKDIRS.remove(work)
 
 
 def _backend_responsive(attempt_timeouts=(120.0, 180.0, 240.0),
@@ -436,6 +493,12 @@ class _Record:
                 print(json.dumps(self.data), flush=True)
 
 
+# Temp workdirs the watchdog must remove before os._exit (which skips
+# finally: blocks — a wedged pipeline_e2e would otherwise orphan a
+# multi-hundred-MB synthetic day in /tmp on every over-budget run).
+_E2E_WORKDIRS: list = []
+
+
 def _with_watchdog(record: _Record, budget_s: float):
     """Hard deadline for the whole bench: if any phase wedges past the
     budget, flush the best record and exit 0 (with a headline) or 1
@@ -443,11 +506,15 @@ def _with_watchdog(record: _Record, budget_s: float):
     from a hung device call."""
 
     def fire():
+        import shutil
+
         print(
             f"bench: watchdog fired after {budget_s:.0f}s — emitting "
             "best-known record and exiting",
             file=sys.stderr,
         )
+        for d in list(_E2E_WORKDIRS):
+            shutil.rmtree(d, ignore_errors=True)
         record.emit()
         os._exit(0 if record.data is not None else 1)
 
@@ -459,8 +526,11 @@ def _with_watchdog(record: _Record, budget_s: float):
 
 def main() -> int:
     record = _Record()
+    # Budget covers headline + 8 secondaries incl. the 5M-event
+    # pipeline_e2e day (~2-4 min on TPU); secondaries run cheapest-risk
+    # first so a watchdog exit keeps the most evidence.
     watchdog = _with_watchdog(record, budget_s=float(
-        os.environ.get("BENCH_BUDGET_S", 1500)
+        os.environ.get("BENCH_BUDGET_S", 2400)
     ))
 
     if not _backend_responsive():
@@ -551,13 +621,39 @@ def main() -> int:
         return {"value": round(flow_eps, 1), "unit": "events/sec",
                 "p50_seconds": round(flow_p50, 3), "n_events": 400_000}
 
+    # Config-4 scale (BASELINE.json: high-cardinality DNS vocab,
+    # dns_pre_lda.scala:320-326).  At V=512k the dense corpus cannot fit
+    # one chip's VMEM blocks/HBM budget, so the single-chip measured
+    # story is the sparse gather path; the multi-chip design for this
+    # config is parallel.make_vocab_sharded_dense_e_step (C and beta
+    # column-sharded over `model`, [B, K] psum per fixed-point
+    # iteration), correctness-pinned on the virtual mesh.
+    def sec_config4():
+        docs4, _, dense4, _ = bench_em(20, 524_288, 2048, 128, rounds=2,
+                                       warm_start=True)
+        return {"value": round(docs4, 1), "unit": "docs/sec",
+                "v": 524_288,
+                "engine": "dense" if dense4 else "sparse",
+                "multichip_plan": "vocab_sharded_dense"}
+
+    # The reference's actual unit of work: one full day start-to-finish
+    # (`./ml_ops.sh YYYYMMDD flow`, ml_ops.sh:57-108), with the stage
+    # breakdown exposing any host-side stage that dominates.
+    def sec_pipeline_e2e():
+        total, stages, eps = bench_pipeline_e2e()
+        return {"value": round(total, 1), "unit": "seconds",
+                "events_per_sec": round(eps, 1), "n_events": 5_000_000,
+                "stages": stages}
+
     secondaries = [
         ("lda_em_throughput_fresh_start", sec_fresh_start),
         ("lda_em_throughput_k50_v50k", sec_k50_v50k),
+        ("lda_em_throughput_config4_v512k", sec_config4),
         ("lda_online_svi", sec_online_svi),
         ("lda_em_convergence", sec_convergence),
         ("dns_scoring", sec_dns_scoring),
         ("flow_scoring", sec_flow_scoring),
+        ("pipeline_e2e", sec_pipeline_e2e),
     ]
     for name, fn in secondaries:
         try:

@@ -109,6 +109,26 @@ class Corpus:
         ]
         return cls(docs, vocab, ptr, widx, cnts)
 
+    def select(self, doc_indices) -> "Corpus":
+        """Sub-corpus of the given documents (shared vocabulary, same
+        word ids — models trained on a subset stay comparable/usable
+        against the full corpus).  Used by the runner's --eval-holdout
+        split."""
+        doc_indices = np.asarray(doc_indices, np.int64)
+        lens = self.doc_lengths()[doc_indices]
+        ptr = np.zeros(len(doc_indices) + 1, np.int64)
+        np.cumsum(lens, out=ptr[1:])
+        widx = np.empty(int(ptr[-1]), self.word_idx.dtype)
+        cnts = np.empty(int(ptr[-1]), self.counts.dtype)
+        for j, d in enumerate(doc_indices):
+            lo, hi = int(self.doc_ptr[d]), int(self.doc_ptr[d + 1])
+            widx[ptr[j]:ptr[j + 1]] = self.word_idx[lo:hi]
+            cnts[ptr[j]:ptr[j + 1]] = self.counts[lo:hi]
+        return Corpus(
+            [self.doc_names[int(d)] for d in doc_indices],
+            self.vocab, ptr, widx, cnts,
+        )
+
     # -- serialization (reference contracts) --------------------------------
 
     def save(self, directory: str) -> None:

@@ -55,8 +55,40 @@ def _batch_held_out(log_beta, alpha, word_idx, counts, doc_mask,
     theta = res.gamma / res.gamma.sum(-1, keepdims=True)
     beta_bt = estep.gather_beta(log_beta, word_idx)  # [B, L, K] probabilities
     p = jnp.einsum("bk,blk->bl", theta, beta_bt)
-    ll = (ho * jnp.log(jnp.maximum(p, 1e-300))).sum(-1) * doc_mask
+    # Floor must be representable in float32: on a TRUE held-out split a
+    # word can be absent from training entirely (every topic at the
+    # LOG_ZERO floor -> p underflows to exactly 0f), and a subnormal
+    # floor like 1e-300 flushes to 0, yielding log(0)*0 = NaN for
+    # observed-half slots.  1e-30 charges unseen words ~-69 nats.
+    ll = (ho * jnp.log(jnp.maximum(p, 1e-30))).sum(-1) * doc_mask
     return ll.sum(), (ho.sum(-1) * doc_mask).sum()
+
+
+def hash_split(doc_names: Sequence[str], frac: float,
+               salt: str = "holdout") -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic (train_idx, held_idx) split by document-name hash.
+
+    Hashing the NAME (the IP in this pipeline) rather than the index
+    keeps a document's membership stable across days and corpus
+    orderings — the property that makes held-out scores comparable
+    day-over-day.  crc32 is stable across processes and platforms
+    (unlike Python's salted hash())."""
+    import zlib
+
+    if not 0.0 < frac < 1.0:
+        raise ValueError(f"holdout fraction must be in (0, 1); got {frac}")
+    cut = int(frac * 2**32)
+    held = np.fromiter(
+        (
+            zlib.crc32(f"{salt}:{name}".encode("utf-8", "surrogateescape"))
+            < cut
+            for name in doc_names
+        ),
+        dtype=bool,
+        count=len(doc_names),
+    )
+    idx = np.arange(len(doc_names))
+    return idx[~held], idx[held]
 
 
 def held_out_per_token_ll(

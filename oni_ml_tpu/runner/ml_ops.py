@@ -84,6 +84,7 @@ class RunContext:
     vocab_sharded: bool = False
     online: bool = False
     eval_quality: bool = False
+    eval_holdout: float = 0.0
     metrics: list = field(default_factory=list)
 
     def path(self, name: str) -> str:
@@ -258,15 +259,20 @@ def stage_lda(ctx: RunContext) -> dict:
     corpus = Corpus.from_model_dat(
         ctx.path("model.dat"), ctx.path("words.dat"), ctx.path("doc.dat")
     )
+    held_metrics = {}
     if ctx.online:
         if ctx.vocab_sharded:
             raise ValueError(
                 "--online supports data-parallel meshes only "
                 "(vocab sharding is batch-mode)"
             )
+        if ctx.eval_holdout:
+            raise ValueError("--eval-holdout is batch-mode only")
         result = train_corpus_online(
             corpus, ctx.config.online_lda, out_dir=ctx.day_dir, mesh=ctx.mesh
         )
+    elif ctx.eval_holdout:
+        result, held_metrics = _train_with_holdout(ctx, corpus)
     else:
         result = train_corpus(
             corpus,
@@ -295,7 +301,96 @@ def stage_lda(ctx: RunContext) -> dict:
     if ctx.eval_quality and _is_coordinator():
         out.update(_completion_score(ctx, result.log_beta, result.alpha,
                                      corpus))
+    out.update(held_metrics)
     return out
+
+
+def _train_with_holdout(ctx: RunContext, corpus):
+    """--eval-holdout FRAC: hash-split documents BEFORE training, train
+    beta on the remainder only, and report the true held-out
+    per-token log-likelihood of the excluded split (document-completion
+    protocol, models/evaluate.py).  Unlike --eval-quality's
+    training-set completion score, this number is valid for
+    hyperparameter selection — beta never saw the held-out documents.
+
+    The pipeline file contract is preserved: final.gamma /
+    doc_results.csv still carry EVERY document (held-out docs get their
+    doc-topic posterior inferred post-hoc under the trained beta — the
+    scorer needs a theta row per IP), and final.beta/likelihood.dat
+    reflect the train-split run."""
+    import math
+
+    import numpy as np
+
+    from ..io import make_batches
+    from ..models.evaluate import hash_split, held_out_per_token_ll
+    from ..models.lda import LDAResult, _is_coordinator
+    from ..ops import estep
+
+    cfg = ctx.config.lda
+    train_idx, held_idx = hash_split(corpus.doc_names, ctx.eval_holdout)
+    if len(held_idx) == 0 or len(train_idx) == 0:
+        raise ValueError(
+            f"--eval-holdout {ctx.eval_holdout} split to "
+            f"{len(train_idx)} train / {len(held_idx)} held-out docs of "
+            f"{corpus.num_docs}; need both non-empty (tiny day?)"
+        )
+    # out_dir stays the day dir so likelihood.dat streams crash-safe and
+    # checkpoint_every keeps working; train_corpus's final.* writes
+    # cover the train subset only and are overwritten with the
+    # full-contract versions below in the same process.
+    result = train_corpus(
+        corpus.select(train_idx),
+        cfg,
+        out_dir=ctx.day_dir,
+        mesh=ctx.mesh,
+        vocab_sharded=ctx.vocab_sharded,
+    )
+
+    held_batches = make_batches(
+        corpus.select(held_idx), batch_size=cfg.batch_size,
+        min_bucket_len=cfg.min_bucket_len,
+    )
+    score = held_out_per_token_ll(
+        result.log_beta, result.alpha, held_batches,
+        var_max_iters=cfg.var_max_iters, var_tol=cfg.var_tol,
+    )
+
+    # Full-contract gamma: train rows from the fit, held-out rows
+    # inferred under the trained beta (full tokens — what the scorer
+    # conditions on for p(event)).
+    import jax.numpy as jnp
+
+    full_gamma = np.zeros((corpus.num_docs, result.gamma.shape[1]))
+    full_gamma[train_idx] = result.gamma
+    log_beta_dev = jnp.asarray(result.log_beta, jnp.float32)
+    for b in held_batches:
+        res = estep.e_step(
+            log_beta_dev, jnp.float32(result.alpha),
+            jnp.asarray(b.word_idx),
+            jnp.asarray(b.counts, jnp.float32),
+            jnp.asarray(b.doc_mask, jnp.float32),
+            var_max_iters=cfg.var_max_iters, var_tol=cfg.var_tol,
+            backend="xla",
+        )
+        sel = b.doc_mask == 1
+        full_gamma[held_idx[b.doc_index[sel]]] = np.asarray(
+            res.gamma, np.float64
+        )[sel]
+
+    full = LDAResult(
+        log_beta=result.log_beta, gamma=full_gamma, alpha=result.alpha,
+        likelihoods=result.likelihoods, em_iters=result.em_iters,
+    )
+    if _is_coordinator():
+        # likelihood.dat was already streamed during fit.
+        full.save(ctx.day_dir, include_likelihood=False)
+    return full, {
+        "held_out_frac": ctx.eval_holdout,
+        "held_out_docs": int(len(held_idx)),
+        "held_out_per_token_ll": score,
+        "held_out_perplexity": math.exp(-score),
+    }
 
 
 def _completion_score(ctx: RunContext, log_beta, alpha, corpus=None) -> dict:
@@ -406,12 +501,25 @@ def run_pipeline(
     online: bool = False,
     publish: str | None = None,
     eval_quality: bool = False,
+    eval_holdout: float = 0.0,
 ) -> list[dict]:
     """Run (or resume) the pipeline for one day.  Completed stages are
     skipped unless `force`; `stages` restricts to a subset (they still run
     in pipeline order)."""
     if dsource not in ("flow", "dns"):
         raise ValueError(f"dsource must be flow or dns, got {dsource!r}")
+    if online and eval_holdout:
+        raise ValueError("--eval-holdout is batch-mode only")
+    if eval_quality and eval_holdout:
+        # Combining them would score the FULL corpus under a beta
+        # trained on the remainder — a third metric that matches
+        # neither flag's documented semantics and silently breaks
+        # --eval-quality's day-over-day comparability.
+        raise ValueError(
+            "--eval-quality and --eval-holdout are mutually exclusive: "
+            "use --eval-quality for drift monitoring (full-day training "
+            "and scoring) or --eval-holdout for a true held-out score"
+        )
     day_dir = formats.ensure_dir(config.day_dir(fdate))
     ctx = RunContext(
         config=config,
@@ -422,6 +530,7 @@ def run_pipeline(
         vocab_sharded=vocab_sharded,
         online=online,
         eval_quality=eval_quality,
+        eval_holdout=eval_holdout,
     )
     import jax
 
@@ -590,6 +699,17 @@ def build_parser() -> argparse.ArgumentParser:
         "across days, optimistic vs a true held-out split",
     )
     p.add_argument(
+        "--eval-holdout", type=float, default=0.0, metavar="FRAC",
+        help="hash-split FRAC of documents out BEFORE training, train "
+        "beta on the remainder, and record the true held-out per-token "
+        "log-likelihood of the excluded split in the lda stage metrics "
+        "— valid for hyperparameter selection, unlike --eval-quality's "
+        "training-set completion score.  doc_results.csv still covers "
+        "every document (held-out docs get their theta inferred under "
+        "the trained beta).  Batch mode only; mutually exclusive with "
+        "--eval-quality",
+    )
+    p.add_argument(
         "--warm-start", action=argparse.BooleanOptionalAction, default=True,
         help="seed each EM iteration's variational fixed point from the "
         "previous gamma (same optimum, fewer inner iterations; default "
@@ -694,6 +814,7 @@ def main(argv: list[str] | None = None) -> int:
             online=args.online,
             publish=args.publish,
             eval_quality=args.eval_quality,
+            eval_holdout=args.eval_holdout,
         )
     return 0
 

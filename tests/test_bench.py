@@ -31,6 +31,16 @@ def test_bench_dns_scoring_smoke():
     assert p50 > 0
 
 
+def test_bench_pipeline_e2e_smoke():
+    import bench
+
+    total, stages, eps = bench.bench_pipeline_e2e(
+        n_events=3000, n_src=50, n_dst=30, em_max_iters=3
+    )
+    assert total > 0 and eps > 0
+    assert set(stages) == {"pre", "corpus", "lda", "score"}
+
+
 def test_bench_flow_scoring_smoke():
     import bench
 
@@ -61,6 +71,10 @@ def _patch_phases(bench, monkeypatch):
         bench, "bench_flow_scoring", lambda *a, **k: (4000.0, 0.1)
     )
     monkeypatch.setattr(bench, "bench_online_svi", lambda *a, **k: 2000.0)
+    monkeypatch.setattr(
+        bench, "bench_pipeline_e2e",
+        lambda *a, **k: (60.0, {"pre": 10.0, "lda": 40.0}, 80000.0),
+    )
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: True)
     monkeypatch.setattr(
         bench, "bench_convergence", lambda *a, **k: (1.5, 20, -1e5)
@@ -86,10 +100,12 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
     assert set(rec["secondary"]) == {
         "lda_em_throughput_fresh_start",
         "lda_em_throughput_k50_v50k",
+        "lda_em_throughput_config4_v512k",
         "lda_online_svi",
         "lda_em_convergence",
         "dns_scoring",
         "flow_scoring",
+        "pipeline_e2e",
     }
     # prev_round must carry the latest prior driver-captured headline
     # (BENCH_r01.json in-repo: 483336 docs/s).

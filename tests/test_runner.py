@@ -386,3 +386,54 @@ def test_pre_stage_spills_raw_lines(flow_day):
     spill.unlink()
     with pytest.raises(FileNotFoundError, match="re-run the pre stage"):
         run_pipeline(cfg, "20160122", "flow", stages=["score"])
+
+
+def test_eval_holdout_true_held_out_split(flow_day):
+    """--eval-holdout: beta trains on the hash-split remainder, the
+    excluded docs' per-token ll is recorded, and the file contract is
+    intact — doc_results/final.gamma cover EVERY document (held-out
+    thetas inferred under the trained beta)."""
+    cfg, tmp_path = flow_day
+    from oni_ml_tpu.models.evaluate import hash_split
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    metrics = run_pipeline(cfg, "20160122", "flow", force=True,
+                           eval_holdout=0.3)
+    lda = next(m for m in metrics if m["stage"] == "lda")
+    assert 0 < lda["held_out_docs"]
+    assert lda["held_out_frac"] == 0.3
+    assert np.isfinite(lda["held_out_per_token_ll"])
+    assert lda["held_out_per_token_ll"] < 0
+    assert lda["held_out_perplexity"] > 1
+
+    day = tmp_path / "20160122"
+    doc_rows = (day / "doc_results.csv").read_text().splitlines()
+    docs = formats.read_doc_dat(str(day / "doc.dat"))
+    assert len(doc_rows) == len(docs)          # every doc has a theta row
+    gamma = formats.read_gamma(str(day / "final.gamma"))
+    assert gamma.shape[0] == len(docs)
+    # Scoring runs against the full-contract model outputs.
+    assert (day / "flow_results.csv").exists()
+
+    # The split is deterministic by doc NAME: same fraction, same docs.
+    t1, h1 = hash_split(docs, 0.3)
+    t2, h2 = hash_split(list(reversed(docs)), 0.3)
+    assert {docs[i] for i in h1} == {docs[len(docs) - 1 - i] for i in h2}
+    assert lda["held_out_docs"] == len(h1)
+
+
+def test_eval_holdout_rejects_online_and_bad_frac(flow_day):
+    cfg, _ = flow_day
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    with pytest.raises(ValueError, match="batch-mode only"):
+        run_pipeline(cfg, "20160124", "flow", force=True, online=True,
+                     eval_holdout=0.2, stages=[Stage.LDA])
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        run_pipeline(cfg, "20160124", "flow", force=True,
+                     eval_quality=True, eval_holdout=0.2,
+                     stages=[Stage.LDA])
+    with pytest.raises(ValueError, match="in \\(0, 1\\)"):
+        from oni_ml_tpu.models.evaluate import hash_split
+
+        hash_split(["a", "b"], 1.5)
