@@ -368,8 +368,30 @@ def bench_flow_scoring(n_events=400_000, reps=3):
     return n_events / p50, p50
 
 
+def _write_dns_day(f, n_events, n_clients=20_000, n_doms=5_000, seed=13,
+                   chunk=200_000):
+    """Write a synthetic 8-column DNS day (CSV) chunked to an open
+    file."""
+    rng = np.random.default_rng(seed)
+    for start in range(0, n_events, chunk):
+        m = min(chunk, n_events - start)
+        ts = rng.integers(1454000000, 1454086400, size=m)
+        flen = rng.integers(40, 1500, size=m)
+        cli = rng.integers(0, n_clients, size=m)
+        dom = rng.integers(0, n_doms, size=m)
+        sub = rng.integers(0, 500, size=m)
+        qtype = rng.integers(1, 17, size=m)
+        rcode = rng.integers(0, 4, size=m)
+        f.write("\n".join(
+            f"t,{ts[i]},{flen[i]},"
+            f"10.{cli[i] >> 8}.{cli[i] & 255}.9,"
+            f"sub{sub[i]}.dom{dom[i]}.com,1,{qtype[i]},{rcode[i]}"
+            for i in range(m)
+        ) + "\n")
+
+
 def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
-                       em_max_iters=40):
+                       em_max_iters=40, dsource="flow"):
     """One full `run_pipeline` day — the reference's actual unit of work
     (`./ml_ops.sh YYYYMMDD flow`, timed per stage at ml_ops.sh:57-108):
     featurize + word counts, corpus build, LDA to convergence, scoring +
@@ -390,12 +412,16 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
     work = tempfile.mkdtemp(prefix="oni_e2e_")
     _E2E_WORKDIRS.append(work)  # watchdog hard-exit cleans these up
     try:
-        raw = os.path.join(work, "flow_day.csv")
+        raw = os.path.join(work, f"{dsource}_day.csv")
         with open(raw, "w") as f:
-            _write_flow_day(f, n_events, n_src=n_src, n_dst=n_dst)
+            if dsource == "flow":
+                _write_flow_day(f, n_events, n_src=n_src, n_dst=n_dst)
+            else:
+                _write_dns_day(f, n_events, n_clients=n_src)
         cfg = PipelineConfig(
             data_dir=work,
-            flow_path=raw,
+            flow_path=raw if dsource == "flow" else "",
+            dns_path=raw if dsource == "dns" else "",
             lda=LDAConfig(batch_size=4096, em_max_iters=em_max_iters),
             feedback=FeedbackConfig(),
             # Reference-like tiny TOL: almost nothing emitted — the
@@ -403,7 +429,7 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
             scoring=ScoringConfig(threshold=1e-20),
         )
         t0 = time.perf_counter()
-        metrics = run_pipeline(cfg, "20160122", "flow", force=True)
+        metrics = run_pipeline(cfg, "20160122", dsource, force=True)
         total = time.perf_counter() - t0
         stages = {
             m["stage"]: round(m["wall_s"], 2)
@@ -526,9 +552,9 @@ def _with_watchdog(record: _Record, budget_s: float):
 
 def main() -> int:
     record = _Record()
-    # Budget covers headline + 8 secondaries incl. the 5M-event
-    # pipeline_e2e day (~2-4 min on TPU); secondaries run cheapest-risk
-    # first so a watchdog exit keeps the most evidence.
+    # Budget covers the headline + 9 secondaries incl. two full
+    # synthetic days (~2-4 min each on TPU); secondaries run
+    # cheapest-risk first so a watchdog exit keeps the most evidence.
     watchdog = _with_watchdog(record, budget_s=float(
         os.environ.get("BENCH_BUDGET_S", 2400)
     ))
@@ -645,15 +671,29 @@ def main() -> int:
                 "events_per_sec": round(eps, 1), "n_events": 5_000_000,
                 "stages": stages}
 
+    # DNS day (combinatorial word space; one document per querying
+    # client, dns_pre_lda.scala:330-334).
+    def sec_pipeline_e2e_dns():
+        total, stages, eps = bench_pipeline_e2e(
+            n_events=2_000_000, n_src=20_000, dsource="dns"
+        )
+        return {"value": round(total, 1), "unit": "seconds",
+                "events_per_sec": round(eps, 1), "n_events": 2_000_000,
+                "stages": stages}
+
+    # Cheapest/lowest-wedge-risk first: a watchdog exit mid-run keeps
+    # the most evidence.  The huge-V config and the two full-day e2e
+    # runs are the heaviest and go last.
     secondaries = [
         ("lda_em_throughput_fresh_start", sec_fresh_start),
-        ("lda_em_throughput_k50_v50k", sec_k50_v50k),
-        ("lda_em_throughput_config4_v512k", sec_config4),
-        ("lda_online_svi", sec_online_svi),
         ("lda_em_convergence", sec_convergence),
         ("dns_scoring", sec_dns_scoring),
         ("flow_scoring", sec_flow_scoring),
+        ("lda_online_svi", sec_online_svi),
+        ("lda_em_throughput_k50_v50k", sec_k50_v50k),
+        ("lda_em_throughput_config4_v512k", sec_config4),
         ("pipeline_e2e", sec_pipeline_e2e),
+        ("pipeline_e2e_dns", sec_pipeline_e2e_dns),
     ]
     for name, fn in secondaries:
         try:

@@ -75,6 +75,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.dfz_table_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dfz_rows_blob.restype = ctypes.c_void_p
     lib.dfz_rows_blob.argtypes = [ctypes.c_void_p]
+    lib.dfz_set_spill.restype = ctypes.c_int
+    lib.dfz_set_spill.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dfz_spill_flush.restype = ctypes.c_int64
+    lib.dfz_spill_flush.argtypes = [ctypes.c_void_p]
     lib.dfz_row_offsets.restype = _I64P
     lib.dfz_row_offsets.argtypes = [ctypes.c_void_p]
     for fn, res in [
@@ -266,6 +270,7 @@ def _featurize_native(
     sources: Sequence,
     feedback_rows: Sequence[Sequence[str]],
     top_domains: frozenset,
+    spill_path: str | None = None,
 ) -> "NativeDnsFeatures | None":
     """Run the native featurizer; returns None when ingest saw a CSV
     field embedding the \\x1f transport separator (the stored rows blob
@@ -278,6 +283,10 @@ def _featurize_native(
     # handle and the caller falls back to the Python path.
     h = lib.dfz_create()
     try:
+        if spill_path is not None and lib.dfz_set_spill(
+            h, os.fsencode(spill_path)
+        ) < 0:
+            raise OSError(lib.dfz_error(h).decode("utf-8", "replace"))
         for src in sources:
             if isinstance(src, str):
                 if lib.dfz_ingest_csv_file(h, os.fsencode(src), 0) < 0:
@@ -288,7 +297,10 @@ def _featurize_native(
                 blob = _rows_to_blob_checked(src)
                 if blob is None:
                     return None
-                lib.dfz_ingest_rows(h, blob, len(blob))
+                if lib.dfz_ingest_rows(h, blob, len(blob)) < 0:
+                    raise OSError(
+                        lib.dfz_error(h).decode("utf-8", "replace")
+                    )
                 del blob
         if lib.dfz_unsafe(h):
             return None
@@ -297,7 +309,8 @@ def _featurize_native(
             blob = _rows_to_blob_checked(feedback_rows)
             if blob is None:
                 return None
-            lib.dfz_ingest_rows(h, blob, len(blob))
+            if lib.dfz_ingest_rows(h, blob, len(blob)) < 0:
+                raise OSError(lib.dfz_error(h).decode("utf-8", "replace"))
             del blob
 
         n = lib.dfz_num_events(h)
@@ -334,10 +347,20 @@ def _featurize_native(
             raise ValueError(lib.dfz_error(h).decode("utf-8", "replace"))
 
         nwc = lib.dfz_wc_len(h)
-        return NativeDnsFeatures(
-            rows_blob=ctypes.string_at(
+        if spill_path is not None:
+            from .blob import MmapBlob
+
+            if lib.dfz_spill_flush(h) < 0:  # short write: offsets would
+                raise OSError(             # point past the end of the file
+                    lib.dfz_error(h).decode("utf-8", "replace")
+                )
+            rows_blob = MmapBlob(spill_path)
+        else:
+            rows_blob = ctypes.string_at(
                 lib.dfz_rows_blob(h), lib.dfz_rows_blob_len(h)
-            ),
+            )
+        return NativeDnsFeatures(
+            rows_blob=rows_blob,
             row_off=_copy(lib.dfz_row_offsets(h), n + 1, np.int64),
             ip_table=_table(lib, h, 0),
             domain_table=_table(lib, h, 1),
@@ -369,8 +392,18 @@ def featurize_dns_sources(
     sources: Sequence = (),
     top_domains: frozenset = frozenset(),
     feedback_rows: Sequence[Sequence[str]] = (),
+    spill_path: str | None = None,
 ) -> "NativeDnsFeatures | DnsFeatures":
     """Featurize DNS events, native when possible.
+
+    `spill_path` streams the stored rows blob to that file during
+    ingest (features/blob.py MmapBlob) so the day's row bytes never
+    accumulate in RAM and pickling the container stores the path.  The
+    pure-Python fallback (and a native run that fell back over
+    transport bytes) ignores it and keeps rows in memory — that path
+    exists for correctness on hostile fields / toolchain-free hosts,
+    not for day-scale data.  `NativeDnsFeatures.spill_rows` remains for
+    post-hoc spilling of an in-memory native container.
 
     `sources` is an ORDERED sequence whose elements are CSV paths (str)
     or pre-projected 8-column row lists (parquet).  Events enter the
@@ -392,8 +425,10 @@ def featurize_dns_sources(
     if lib is not None:
         # _featurize_native returns None when any in-memory field embeds
         # a transport byte ('\n', '\r', '\x1f') or native CSV ingest
-        # detects an embedded separator — the whole run then falls back.
-        feats = _featurize_native(lib, sources, feedback_rows, top_domains)
+        # detects an embedded separator — the whole run then falls back
+        # (a partially-written spill file is simply left unreferenced).
+        feats = _featurize_native(lib, sources, feedback_rows, top_domains,
+                                  spill_path=spill_path)
         if feats is not None:
             return feats
     from .lineio import iter_raw_lines

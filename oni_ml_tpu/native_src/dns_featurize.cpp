@@ -115,6 +115,10 @@ constexpr char SEP = '\x1f';
 
 struct Dfz {
   std::string rows;                   // \x1f-joined fields, rows appended
+  FILE* spill = nullptr;              // when set, rows stream here
+  int64_t spill_len = 0;              // instead of the in-RAM blob
+  bool spill_err = false;             // short write (ENOSPC etc.)
+  std::string rowbuf;                 // reused per-row join buffer
   std::vector<int64_t> row_off{0};
   std::vector<double> tstamp_, flen_, entropy_;
   std::vector<int32_t> sublen_, nparts_;
@@ -138,11 +142,30 @@ struct Dfz {
   std::string error;
 
   void add_row(const std::string_view* f) {
-    for (int i = 0; i < NCOLS; i++) {
-      if (i) rows += SEP;
-      rows.append(f[i].data(), f[i].size());
+    if (spill) {
+      // Stored rows are only re-read at emit time; streaming them to
+      // the spill file keeps RSS bounded by the numeric/interned
+      // arrays.  Short writes must surface as errors, not as offsets
+      // past the end of the file.
+      rowbuf.clear();
+      for (int i = 0; i < NCOLS; i++) {
+        if (i) rowbuf += SEP;
+        rowbuf.append(f[i].data(), f[i].size());
+      }
+      if (fwrite(rowbuf.data(), 1, rowbuf.size(), spill)
+          != rowbuf.size()) {
+        spill_err = true;
+        error = "short write to rows spill file (disk full?)";
+      }
+      spill_len += (int64_t)rowbuf.size();
+      row_off.push_back(spill_len);
+    } else {
+      for (int i = 0; i < NCOLS; i++) {
+        if (i) rows += SEP;
+        rows.append(f[i].data(), f[i].size());
+      }
+      row_off.push_back((int64_t)rows.size());
     }
-    row_off.push_back((int64_t)rows.size());
 
     tstamp_.push_back(to_double(f[C_TSTAMP]));
     flen_.push_back(to_double(f[C_FLEN]));
@@ -228,8 +251,38 @@ struct Dfz {
 extern "C" {
 
 void* dfz_create() { return new Dfz(); }
-void dfz_destroy(void* h) { delete (Dfz*)h; }
+void dfz_destroy(void* hv) {
+  Dfz* h = (Dfz*)hv;
+  if (h->spill) fclose(h->spill);
+  delete h;
+}
 const char* dfz_error(void* h) { return ((Dfz*)h)->error.c_str(); }
+
+// Route stored rows to `path` instead of RAM.  Call before any ingest;
+// -1 (with dfz_error set) when the file can't open.
+int dfz_set_spill(void* hv, const char* path) {
+  Dfz* h = (Dfz*)hv;
+  if (h->spill) fclose(h->spill);
+  h->spill = fopen(path, "wb");
+  if (!h->spill) {
+    h->error = std::string("cannot open spill file ") + path;
+    return -1;
+  }
+  return 0;
+}
+
+// Returns the spilled byte count, or -1 when any write/flush failed.
+int64_t dfz_spill_flush(void* hv) {
+  Dfz* h = (Dfz*)hv;
+  if (h->spill) {
+    if (fflush(h->spill) != 0 || ferror(h->spill)) {
+      h->spill_err = true;
+      if (h->error.empty())
+        h->error = "flush of rows spill file failed (disk full?)";
+    }
+  }
+  return h->spill_err ? -1 : h->spill_len;
+}
 
 int64_t dfz_ingest_csv_file(void* hv, const char* path, int skip_header) {
   Dfz* h = (Dfz*)hv;
@@ -245,7 +298,7 @@ int64_t dfz_ingest_csv_file(void* hv, const char* path, int skip_header) {
         }
         h->ingest(p, n, ',', /*skip_empty=*/true);
       });
-  return ok ? (int64_t)h->tstamp_.size() : -1;
+  return (ok && !h->spill_err) ? (int64_t)h->tstamp_.size() : -1;
 }
 
 // Rows pre-split by the caller (parquet, feedback): fields joined by
@@ -253,7 +306,7 @@ int64_t dfz_ingest_csv_file(void* hv, const char* path, int skip_header) {
 int64_t dfz_ingest_rows(void* hv, const char* buf, int64_t len) {
   Dfz* h = (Dfz*)hv;
   h->ingest(buf, len, SEP, /*skip_empty=*/true);
-  return (int64_t)h->tstamp_.size();
+  return h->spill_err ? -1 : (int64_t)h->tstamp_.size();
 }
 
 int dfz_unsafe(void* hv) { return ((Dfz*)hv)->unsafe ? 1 : 0; }
@@ -426,9 +479,13 @@ const int64_t* dfz_table_offsets(void* hv, int which) {
   return t.offsets.data();
 }
 
-const char* dfz_rows_blob(void* hv) { return ((Dfz*)hv)->rows.data(); }
+const char* dfz_rows_blob(void* hv) {
+  Dfz* h = (Dfz*)hv;
+  return h->spill ? nullptr : h->rows.data();  // spilled: read the file
+}
 int64_t dfz_rows_blob_len(void* hv) {
-  return (int64_t)((Dfz*)hv)->rows.size();
+  Dfz* h = (Dfz*)hv;
+  return h->spill ? h->spill_len : (int64_t)h->rows.size();
 }
 const int64_t* dfz_row_offsets(void* hv) {
   return ((Dfz*)hv)->row_off.data();

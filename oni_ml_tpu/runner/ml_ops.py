@@ -225,15 +225,17 @@ def stage_pre(ctx: RunContext) -> dict:
         )
         from ..features.native_dns import featurize_dns_sources
 
+        # Rows stream to the spill file during native ingest, so CSV
+        # sources never hold the day's bytes in RAM and features.pkl
+        # references the file.  A run that fell back to the pure-Python
+        # container (hostile transport bytes, no C++ toolchain) keeps
+        # rows in memory — that path exists for correctness, not
+        # day-scale data.
         features = featurize_dns_sources(
             _dns_sources(cfg.dns_path), top_domains=top,
             feedback_rows=fb_rows,
+            spill_path=ctx.path("raw_lines.bin"),
         )
-        if hasattr(features, "spill_rows"):
-            # Post-hoc spill (DNS sources arrive in memory): keeps the
-            # projected-rows bytes out of features.pkl and out of RSS
-            # for every stage after pre.
-            features.spill_rows(ctx.path("raw_lines.bin"))
     with open(ctx.path("features.pkl"), "wb") as f:
         pickle.dump(features, f, protocol=pickle.HIGHEST_PROTOCOL)
     triples = features.word_counts()

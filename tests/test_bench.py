@@ -39,6 +39,11 @@ def test_bench_pipeline_e2e_smoke():
     )
     assert total > 0 and eps > 0
     assert set(stages) == {"pre", "corpus", "lda", "score"}
+    total, stages, eps = bench.bench_pipeline_e2e(
+        n_events=2000, n_src=40, em_max_iters=3, dsource="dns"
+    )
+    assert total > 0 and eps > 0
+    assert set(stages) == {"pre", "corpus", "lda", "score"}
 
 
 def test_bench_flow_scoring_smoke():
@@ -106,6 +111,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "dns_scoring",
         "flow_scoring",
         "pipeline_e2e",
+        "pipeline_e2e_dns",
     }
     # prev_round must carry the latest prior driver-captured headline
     # (BENCH_r01.json in-repo: 483336 docs/s).

@@ -228,3 +228,73 @@ def test_duplicate_in_memory_sources_ingest_twice():
     fb = native_dns.featurize_dns_sources([rows], feedback_rows=rows)
     assert fb.num_raw_events == 20          # feedback rows are not raw
     assert fb.num_events == 40
+
+
+def test_spill_parity_and_scoring(tmp_path):
+    """spill_path streams the rows blob to disk at ingest: identical
+    surface (rows/featurized_row/word_counts), native emit reads rows
+    through the mmap bit-identically, and the pickle references the
+    path instead of embedding the bytes."""
+    import pickle as pkl
+
+    from oni_ml_tpu.features.blob import MmapBlob
+    from oni_ml_tpu.scoring import ScoringModel, score_dns_csv
+
+    path, _ = make_day(tmp_path)
+    fb = [dns_row(ip="9.9.9.9")] * 4
+    nat = native_dns.featurize_dns_sources(
+        [str(path)], top_domains=TOP, feedback_rows=fb
+    )
+    spill = native_dns.featurize_dns_sources(
+        [str(path)], top_domains=TOP, feedback_rows=fb,
+        spill_path=str(tmp_path / "rows.bin"),
+    )
+    assert isinstance(spill.rows_blob, MmapBlob)
+    assert len(spill.rows_blob) == len(nat.rows_blob)
+    assert spill.rows == nat.rows
+    assert spill.word_counts() == nat.word_counts()
+    assert spill.num_raw_events == nat.num_raw_events
+
+    probe = bytes(nat.rows_blob[:64])
+    assert probe not in pkl.dumps(spill)
+    again = pkl.loads(pkl.dumps(spill))
+    assert again.rows == nat.rows
+
+    k = 4
+    rng = np.random.default_rng(0)
+    ips = sorted({ip for ip, _, _ in nat.word_counts()})
+    words = sorted({w for _, w, _ in nat.word_counts()})
+    model = ScoringModel.from_results(
+        doc_names=ips,
+        doc_topic=rng.dirichlet(np.ones(k), size=len(ips)),
+        vocab=words,
+        word_topic=rng.dirichlet(np.ones(k), size=len(words)),
+        fallback=0.1,
+    )
+    blob_nat, s_nat = score_dns_csv(nat, model, threshold=1.1)
+    blob_spill, s_spill = score_dns_csv(spill, model, threshold=1.1)
+    assert blob_nat == blob_spill
+    np.testing.assert_array_equal(s_nat, s_spill)
+
+
+def test_spill_with_mixed_inmemory_sources(tmp_path):
+    """Pre-projected (parquet-style) row sources spill too; a run that
+    falls back over transport bytes leaves the partial spill file
+    unreferenced and returns the Python container."""
+    path, _ = make_day(tmp_path, n=50)
+    mem_rows = [dns_row(ip="10.9.0.1", qname="a.b.example.com")] * 5
+    spill = native_dns.featurize_dns_sources(
+        [mem_rows, str(path)], top_domains=TOP,
+        spill_path=str(tmp_path / "rows.bin"),
+    )
+    nat = native_dns.featurize_dns_sources(
+        [mem_rows, str(path)], top_domains=TOP
+    )
+    assert spill.rows == nat.rows
+
+    bad = [dns_row(ip="10.9.0.2", qname="evil\x1fname.com")] * 2
+    fell_back = native_dns.featurize_dns_sources(
+        [bad, str(path)], top_domains=TOP,
+        spill_path=str(tmp_path / "rows2.bin"),
+    )
+    assert not isinstance(fell_back, native_dns.NativeDnsFeatures)
