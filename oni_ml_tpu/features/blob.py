@@ -9,9 +9,10 @@ not — and round 2 pickled the whole blob into features.pkl besides
 file-backed window: the OS pages rows in at emit time only, RSS stays
 bounded by the numeric arrays, and pickling stores just the path.
 
-The flow featurizer writes the spill during ingest (the blob never
-exists in RAM, native_src/flow_featurize.cpp ffz_set_spill); the DNS
-container spills post-hoc (its sources arrive as in-memory rows anyway).
+Both native featurizers write the spill during ingest (the blob never
+exists in RAM: native_src/flow_featurize.cpp ffz_set_spill,
+native_src/dns_featurize.cpp dfz_set_spill); spill_bytes() remains for
+post-hoc spilling of a container that was built in memory.
 """
 
 from __future__ import annotations

@@ -258,11 +258,17 @@ void dfz_destroy(void* hv) {
 }
 const char* dfz_error(void* h) { return ((Dfz*)h)->error.c_str(); }
 
-// Route stored rows to `path` instead of RAM.  Call before any ingest;
-// -1 (with dfz_error set) when the file can't open.
+// Route stored rows to `path` instead of RAM.  Must be called before
+// any ingest — row offsets are absolute positions in ONE store, so
+// retargeting mid-run (or after in-RAM rows exist) would make them
+// read past EOF / wrong bytes at emit.  -1 with dfz_error set on
+// misuse or when the file can't open.
 int dfz_set_spill(void* hv, const char* path) {
   Dfz* h = (Dfz*)hv;
-  if (h->spill) fclose(h->spill);
+  if (!h->tstamp_.empty() || h->spill) {
+    h->error = "dfz_set_spill must be called once, before any ingest";
+    return -1;
+  }
   h->spill = fopen(path, "wb");
   if (!h->spill) {
     h->error = std::string("cannot open spill file ") + path;

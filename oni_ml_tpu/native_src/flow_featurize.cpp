@@ -162,13 +162,19 @@ void ffz_destroy(void* hv) {
   delete h;
 }
 
-// Route kept raw rows to `path` instead of RAM.  Call before any
-// ingest; returns -1 (with ffz_error set) when the file can't open.
-// ffz_spill_flush makes the bytes visible to a reader (mmap) — the
-// handle stays open so later ingests (feedback rows) keep appending.
+// Route kept raw rows to `path` instead of RAM.  Must be called once,
+// before any ingest — line offsets are absolute positions in ONE
+// store, so retargeting mid-run (or after in-RAM rows exist) would
+// make them read past EOF / wrong bytes at emit; -1 with ffz_error set
+// on misuse or when the file can't open.  ffz_spill_flush makes the
+// bytes visible to a reader (mmap) — the handle stays open so later
+// ingests (feedback rows) keep appending.
 int ffz_set_spill(void* hv, const char* path) {
   Ffz* h = (Ffz*)hv;
-  if (h->spill) fclose(h->spill);
+  if (!h->time_.empty() || h->spill) {
+    h->error = "ffz_set_spill must be called once, before any ingest";
+    return -1;
+  }
   h->spill = fopen(path, "wb");
   if (!h->spill) {
     h->error = std::string("cannot open spill file ") + path;
