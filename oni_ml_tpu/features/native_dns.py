@@ -218,10 +218,11 @@ class NativeDnsFeatures:
     def spill_rows(self, path: str) -> None:
         """Move the projected-rows blob to a mmap-backed file
         (features/blob.py): pickling stores the path, not the bytes.
-        DNS sources arrive as in-memory rows, so unlike the flow
-        featurizer's ingest-time spill this is post-hoc — it bounds the
-        pickle and everything after the pre stage, not the featurize
-        peak itself."""
+        Post-hoc companion to the ingest-time spill
+        (featurize_dns_sources(spill_path=...) / dfz_set_spill, which
+        bounds the featurize peak itself) — use this when a container
+        was built in memory and only the pickle/post-stage RSS needs
+        bounding.  No-op when the blob is already spilled."""
         if isinstance(self.rows_blob, (bytes, bytearray)):
             from .blob import spill_bytes
 

@@ -63,6 +63,23 @@ def main() -> int:
     os.makedirs(day_dir, exist_ok=True)
     res = train_corpus(corpus, cfg, out_dir=day_dir, mesh=mesh)
 
+    # Vocab-sharded DENSE plan on a (2, 2) mesh spanning both processes:
+    # the model-axis [B, K] psum inside the fixed point and the
+    # column-sharded beta/suff-stats now genuinely cross hosts
+    # (config 4's multi-chip path, parallel.make_vocab_sharded_dense_e_step).
+    import dataclasses
+
+    vs_mesh = make_mesh(data=nprocs, model=2)
+    vs_res = train_corpus(
+        corpus,
+        # warm start off: the launcher pins this trajectory against the
+        # (fresh-start) sparse data-parallel run above.
+        dataclasses.replace(cfg, dense_em="on", checkpoint_every=0,
+                            warm_start_gamma=False),
+        mesh=vs_mesh,
+        vocab_sharded=True,
+    )
+
     # Streaming trainer through the same mesh: its checkpoint path calls
     # the collective _to_host BEFORE the coordinator gate — the old
     # gate-first ordering deadlocks exactly here (ADVICE r2 finding).
@@ -114,6 +131,8 @@ def main() -> int:
         gamma=res.gamma,
         alpha=np.float64(res.alpha),
         lls=np.asarray([ll for ll, _ in res.likelihoods], np.float64),
+        vs_log_beta=vs_res.log_beta,
+        vs_lls=np.asarray([ll for ll, _ in vs_res.likelihoods], np.float64),
         stream_lam=lam,
         stream_steps=np.int64(trainer.step_count),
         pipeline_stages=np.int64(len(metrics)),

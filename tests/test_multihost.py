@@ -33,8 +33,11 @@ def worker_runs(tmp_path_factory):
         k: v
         for k, v in os.environ.items()
         # The workers configure their own backend; scrub the suite's
-        # single-process CPU/8-device env and any TPU pool hook.
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+        # single-process CPU/8-device env, any TPU pool hook, and the
+        # E-step engine override (it silently maps dense_em="on" to
+        # "off", which would hollow out the dense cross-host test).
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
+                     "ONI_ML_TPU_ESTEP")
     }
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
@@ -92,6 +95,22 @@ def test_ranks_agree_and_match_single_process(worker_runs):
     np.testing.assert_allclose(res.log_beta, r0["log_beta"], atol=5e-4)
     np.testing.assert_allclose(
         np.asarray([ll for ll, _ in res.likelihoods]), r0["lls"], rtol=1e-5
+    )
+
+
+def test_vocab_sharded_dense_crosses_hosts(worker_runs):
+    """The vocab-sharded dense plan on a (2, 2) mesh spanning both
+    processes: ranks agree bit-for-bit, and the trajectory matches the
+    sparse data-parallel run on the same corpus/config (the engines
+    share semantics, so only reduction-order noise remains)."""
+    r0 = np.load(worker_runs / "proc0.npz")
+    r1 = np.load(worker_runs / "proc1.npz")
+    np.testing.assert_array_equal(r0["vs_log_beta"], r1["vs_log_beta"])
+    np.testing.assert_array_equal(r0["vs_lls"], r1["vs_lls"])
+    np.testing.assert_allclose(r0["vs_lls"], r0["lls"], rtol=1e-4)
+    np.testing.assert_allclose(
+        np.exp(r0["vs_log_beta"]), np.exp(r0["log_beta"]),
+        rtol=5e-3, atol=5e-3,
     )
 
 
@@ -187,7 +206,8 @@ def _run_pair(script, tmp_path, timeout=180):
     port = _free_port()
     env = {
         k: v for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
+                     "ONI_ML_TPU_ESTEP")
     }
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
