@@ -67,7 +67,8 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
               force_sparse=False, wmajor=True, warm_start=False,
               precision="bf16"):
     """Shared corpus/dense-path/runner setup for the EM benches:
-    returns (log_beta, groups, run_chunk, use_dense, used_wmajor)."""
+    returns (log_beta, groups, run_chunk, use_dense, used_wmajor,
+    corpus_itemsize)."""
     import jax
     import jax.numpy as jnp
 
@@ -88,9 +89,16 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
     )
     use_dense = use_dense and not force_sparse
     wmajor = use_dense and use_wmajor
+    corpus_itemsize = 4
     if use_dense:
+        # Gate on the DENSIFIED cells (duplicate words in a doc sum),
+        # exactly like the trainer.
+        store = dense_estep.corpus_dtype(
+            dense_estep.max_dense_cell(word_idx, counts), precision
+        )
+        corpus_itemsize = jnp.dtype(store).itemsize
         dense = jax.jit(
-            lambda w, c: dense_estep.densify(w, c, v)
+            lambda w, c: dense_estep.densify(w, c, v, dtype=store)
         )(word_idx, counts)
         if wmajor:
             dense = jnp.transpose(dense)
@@ -106,14 +114,15 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
         dense_wmajor=wmajor, warm_start=warm_start and use_dense,
         dense_precision=precision if use_dense else "f32",
     )
-    return log_beta, groups, run_chunk, use_dense, wmajor
+    return log_beta, groups, run_chunk, use_dense, wmajor, corpus_itemsize
 
 
 def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
              force_sparse=False, wmajor=True, warm_start=False,
              precision="bf16"):
     """Production fused-EM throughput at (K, V, B, L); returns
-    (docs_per_sec, seconds_per_em_iter, used_dense, used_wmajor).
+    (docs_per_sec, seconds_per_em_iter, used_dense, used_wmajor,
+    corpus_itemsize).
 
     chunk EM iterations run device-resident per host call; chunk=32
     amortizes the host<->device round-trip (which dominates at chunk=8
@@ -126,10 +135,12 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
     ~10% faster, so the headline uses it."""
     import jax.numpy as jnp
 
-    log_beta, groups, run_chunk, use_dense, wmajor = _setup_em(
-        k, v, b, l, chunk=chunk, var_max_iters=var_max_iters, em_tol=0.0,
-        force_sparse=force_sparse, wmajor=wmajor, warm_start=warm_start,
-        precision=precision,
+    log_beta, groups, run_chunk, use_dense, wmajor, corpus_itemsize = (
+        _setup_em(
+            k, v, b, l, chunk=chunk, var_max_iters=var_max_iters,
+            em_tol=0.0, force_sparse=force_sparse, wmajor=wmajor,
+            warm_start=warm_start, precision=precision,
+        )
     )
     alpha = jnp.float32(2.5)
     res = run_chunk(log_beta, alpha, jnp.float32(np.nan), groups, chunk)
@@ -147,7 +158,7 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         ll = _sync(res.lls[-1])
         best = min(best, (time.perf_counter() - t0) / chunk)
     assert np.isfinite(ll)
-    return b / best, best, use_dense, wmajor
+    return b / best, best, use_dense, wmajor, corpus_itemsize
 
 
 def bench_convergence(k=20, v=8192, b=4096, l=128, em_tol=1e-4,
@@ -161,7 +172,7 @@ def bench_convergence(k=20, v=8192, b=4096, l=128, em_tol=1e-4,
     production driver performs."""
     import jax.numpy as jnp
 
-    log_beta, groups, run_chunk, _, _ = _setup_em(
+    log_beta, groups, run_chunk, _, _, _ = _setup_em(
         k, v, b, l, chunk=chunk, var_max_iters=20, em_tol=em_tol,
         precision=precision, warm_start=warm_start,
     )
@@ -187,7 +198,7 @@ def bench_convergence(k=20, v=8192, b=4096, l=128, em_tol=1e-4,
 
 
 def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True,
-                   precision="bf16"):
+                   precision="bf16", corpus_itemsize=4):
     """Roofline accounting for one dense-path EM iteration.
 
     FLOPs: the kernel runs (var_max_iters VI iterations + 1 tail pass),
@@ -195,8 +206,9 @@ def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True,
     (the production default) the phinorm contraction pads K to the
     128-lane tile while the gamma-update output pads K only to the
     8-sublane granularity.  HBM: the dense corpus crosses once per EM
-    iteration, beta re-reads once per doc block (grid = B/bb blocks),
-    plus model/outputs.
+    iteration (2 bytes/element when stored bf16 — corpus_dtype), beta
+    re-reads once per doc block (grid = B/bb blocks), plus
+    model/outputs.
     """
     from oni_ml_tpu.ops import dense_estep
 
@@ -208,7 +220,9 @@ def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True,
     # gamma-update matmul: K pads to 8 sublanes W-major, 128 lanes row-major
     k_s = max(k, -(-k // 8) * 8) if wmajor else max(k, 128)
     flops_padded = flops_useful * (k_q + k_s) / (2.0 * k)
-    bytes_hbm = 4.0 * (b * w + b * k + (grid + 3) * k * w)
+    bytes_hbm = (
+        float(corpus_itemsize) * b * w + 4.0 * (b * k + (grid + 3) * k * w)
+    )
     return {
         "achieved_tflops": round(flops_useful / t_iter / 1e12, 2),
         "mxu_pct": round(100 * flops_padded / t_iter / PEAK_FLOPS, 1),
@@ -576,12 +590,12 @@ def main() -> int:
     # it is measured; everything after is best-effort.
     k1, v1, b1, l1 = 20, 8192, 4096, 128
     precision = "bf16"
-    docs_per_sec, t_iter, used_dense, used_wmajor = bench_em(
+    docs_per_sec, t_iter, used_dense, used_wmajor, corpus_isz = bench_em(
         k1, v1, b1, l1, precision=precision, warm_start=True
     )
     util = (
         em_utilization(k1, v1, b1, t_iter, wmajor=used_wmajor,
-                       precision=precision)
+                       precision=precision, corpus_itemsize=corpus_isz)
         if used_dense
         else {}
     )
@@ -604,7 +618,7 @@ def main() -> int:
     # --no-warm-start selects) — reported so the warm-start default's
     # gain stays attributable.
     def sec_fresh_start():
-        docs_fresh, _, dense_f, _ = bench_em(k1, v1, b1, l1, rounds=3,
+        docs_fresh, _, dense_f, _, _ = bench_em(k1, v1, b1, l1, rounds=3,
                                              warm_start=False,
                                              precision=precision)
         return {"value": round(docs_fresh, 1), "unit": "docs/sec",
@@ -613,7 +627,8 @@ def main() -> int:
 
     # Config-3 scale (BASELINE.json: 50 topics, full vocabulary).
     def sec_k50_v50k():
-        docs50k, _, dense50k, _ = bench_em(50, 50_000, 2048, 128, rounds=3,
+        docs50k, _, dense50k, _, _ = bench_em(50, 50_000, 2048, 128,
+                                              rounds=3,
                                            precision=precision,
                                            warm_start=True)
         return {"value": round(docs50k, 1), "unit": "docs/sec",
@@ -655,7 +670,7 @@ def main() -> int:
     # column-sharded over `model`, [B, K] psum per fixed-point
     # iteration), correctness-pinned on the virtual mesh.
     def sec_config4():
-        docs4, _, dense4, _ = bench_em(20, 524_288, 2048, 128, rounds=2,
+        docs4, _, dense4, _, _ = bench_em(20, 524_288, 2048, 128, rounds=2,
                                        warm_start=True)
         return {"value": round(docs4, 1), "unit": "docs/sec",
                 "v": 524_288,

@@ -80,7 +80,10 @@ class LDAConfig:
     # backends (tests, interpret mode) f32 matmuls are exact, so "bf16"
     # there emulates the TPU's input truncation instead.  The
     # suff-stats / ELBO tail pass always runs full-width off the
-    # converged gamma.
+    # converged gamma.  bf16 mode additionally STORES the densified
+    # corpus bf16 whenever every count is <= 256 (exact in bf16's 8
+    # significand bits; ops/dense_estep.corpus_dtype) — halving the
+    # corpus' per-iteration HBM streaming with bit-identical results.
     dense_precision: str = "f32"
     # Store the dense corpus transposed ([W, B]) so the gamma-update
     # matmul's small-K output axis pads to the 8-sublane granularity

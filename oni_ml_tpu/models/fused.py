@@ -82,6 +82,7 @@ def stack_batches(
 def densify_groups(
     groups: StackedGroups, num_terms: int, wmajor: bool = False,
     put: Callable | None = None, width: int | None = None,
+    dtype=None,
 ) -> StackedGroups:
     """Convert stacked sparse groups to dense-counts groups for the
     gather/scatter-free E-step (ops/dense_estep.py).
@@ -92,11 +93,13 @@ def densify_groups(
     ONCE here and is amortized over every EM iteration of the run — that
     amortization is the whole point (a per-iteration scatter is what the
     dense path exists to avoid).  `width` overrides the dense width (the
-    vocab-sharded XLA path matches it to the sharded beta width)."""
+    vocab-sharded XLA path matches it to the sharded beta width);
+    `dtype` is the storage dtype (dense_estep.corpus_dtype — bf16 when
+    exact, halving the corpus' HBM footprint and streaming)."""
     from ..ops import dense_estep
 
     def one(w, c):
-        d = dense_estep.densify(w, c, num_terms, width=width)
+        d = dense_estep.densify(w, c, num_terms, width=width, dtype=dtype)
         return d.T if wmajor else d
 
     arrays = []

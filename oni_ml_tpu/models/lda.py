@@ -587,6 +587,20 @@ class LDATrainer:
         use_dense = self._use_dense(batches)
         use_wmajor = False
         dense_e_fn = None
+        corpus_store = None
+        if use_dense:
+            from ..ops import dense_estep as _de
+
+            # bf16 corpus storage when exact and the run is already in
+            # bf16 operand mode — halves the corpus' HBM streaming with
+            # bit-identical results.  The gate bounds the DENSIFIED
+            # cells (duplicate (doc, word) tokens sum — the DUPFACTOR
+            # feedback path makes ~1000-count cells out of count-1
+            # tokens), not the raw counts.
+            cell_max = max(
+                _de.max_dense_cell(b.word_idx, b.counts) for b in batches
+            )
+            corpus_store = _de.corpus_dtype(cell_max, cfg.dense_precision)
         if use_dense and self.vocab_sharded:
             from functools import partial as _partial
 
@@ -611,7 +625,7 @@ class LDATrainer:
             groups = fused.densify_groups(
                 groups, self.num_terms, wmajor=False,
                 put=lambda x: jax.device_put(x, dense_sh),
-                width=self.num_terms,
+                width=self.num_terms, dtype=corpus_store,
             )
         elif use_dense:
             from functools import partial as _partial
@@ -651,7 +665,8 @@ class LDATrainer:
             else:
                 dense_put = None
             groups = fused.densify_groups(
-                groups, self.num_terms, wmajor=use_wmajor, put=dense_put
+                groups, self.num_terms, wmajor=use_wmajor, put=dense_put,
+                dtype=corpus_store,
             )
             # XLA drops the pallas kernel's own scoped-VMEM limit when the
             # call is fusion-wrapped inside a stacked-group scan; forward

@@ -55,6 +55,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.scipy.special import gammaln
@@ -192,7 +193,47 @@ def padded_width(num_terms: int) -> int:
     return -(-num_terms // 128) * 128
 
 
-def densify(word_idx, counts, num_terms: int, width: int | None = None):
+def max_dense_cell(word_idx, counts) -> float:
+    """Largest value any densified cell will hold: the max over
+    (doc, word) of the SUMMED counts of duplicate tokens.
+
+    This — not the max raw per-token count — is what the bf16-exactness
+    gate must bound: duplicate (doc, word) tokens sum in densify(), and
+    the corpus deliberately contains them (the ingest keeps duplicate
+    pairs as separate tokens, and the analyst-feedback path replicates
+    a row DUPFACTOR=1000 times, so a feedback doc holds the same word
+    as ~1000 count-1 tokens whose CELL is ~1000 while every raw count
+    is 1)."""
+    w = np.asarray(word_idx, np.int64)
+    c = np.asarray(counts, np.float64)
+    if w.size == 0:
+        return 0.0
+    docs = np.arange(w.shape[0], dtype=np.int64)[:, None]
+    keys = (docs * (int(w.max()) + 1) + w).ravel()
+    _, inv = np.unique(keys, return_inverse=True)
+    sums = np.bincount(inv.ravel(), weights=c.ravel())
+    return float(sums.max()) if sums.size else 0.0
+
+
+def corpus_dtype(cell_max: float, precision: str = "f32"):
+    """Storage dtype for the densified corpus.
+
+    bf16 when the dense path runs in bf16 operand mode AND every
+    DENSIFIED CELL (per-(doc, word) summed count — see max_dense_cell;
+    raw per-token counts undercount duplicates) is <= 256: bf16's 8
+    significand bits represent integers exactly up to 256, so the
+    f32-promoting consumers in the kernels see the exact counts —
+    bit-identical results — while the corpus' HBM streaming (the
+    dominant per-iteration memory traffic once the fixed point is
+    matmul-bound) halves.  Anything larger — e.g. the DUPFACTOR=1000
+    feedback cells — keeps f32."""
+    if precision == "bf16" and cell_max <= 256:
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def densify(word_idx, counts, num_terms: int, width: int | None = None,
+            dtype=None):
     """[B, L] token lists -> [B, W] dense counts.  One scatter, run once
     per batch group and amortized over every EM iteration (padded tokens
     carry count 0, so they contribute nothing to column 0).
@@ -201,14 +242,19 @@ def densify(word_idx, counts, num_terms: int, width: int | None = None):
     needs.  The XLA-level vocab-sharded dense path passes an explicit
     `width` (the model-axis-divisible padded vocab) instead: XLA has no
     lane-tile requirement, and matching the sharded beta width exactly
-    keeps shard ownership aligned with the sparse plan's."""
+    keeps shard ownership aligned with the sparse plan's.
+
+    `dtype` is the STORAGE dtype (see corpus_dtype); the scatter always
+    accumulates in the counts dtype and converts once at the end, so a
+    bf16 store is an exact conversion, never a bf16 accumulation."""
     if width is None:
         width = padded_width(num_terms)
     elif width < num_terms:
         raise ValueError(f"width {width} < num_terms {num_terms}")
     b = word_idx.shape[0]
     dense = jnp.zeros((b, width), counts.dtype)
-    return dense.at[jnp.arange(b)[:, None], word_idx].add(counts)
+    dense = dense.at[jnp.arange(b)[:, None], word_idx].add(counts)
+    return dense if dtype is None else dense.astype(dtype)
 
 
 def _dense_kernel(
@@ -225,11 +271,16 @@ def _dense_kernel(
     iterations once beta stabilizes; config knob warm_start_gamma)."""
     k_topics = beta_ref.shape[0]
     beta = beta_ref[...]                       # [K, V] exp(log_beta)
-    c = c_ref[...]                             # [BB, V]
+    # The corpus block may arrive STORED bf16 (corpus_dtype: exact for
+    # counts <= 256, halves its HBM streaming).  It is consumed via
+    # f32-promoting elementwise ops — the upcast fuses per use instead
+    # of materializing a second full-width copy in VMEM — so the
+    # storage dtype changes no results.
+    c = c_ref[...]                             # [BB, V] f32 or bf16
     mask = mask_ref[...]                       # [BB, 1]
     alpha = alpha_ref[0, 0]
     warm = warm_ref[0, 0]
-    n_d = jnp.sum(c, axis=1, keepdims=True)
+    n_d = jnp.sum(c, axis=1, keepdims=True, dtype=jnp.float32)
     cast = _cast_for(precision)
     beta_m = cast(beta)
 
@@ -265,13 +316,14 @@ def _dense_kernel(
         return jnp.logical_and(it < var_max_iters, delta > var_tol)
 
     fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
-        (c.shape[0], k_topics), c.dtype
+        (c.shape[0], k_topics), jnp.float32
     )
     gamma0 = jnp.where(warm != 0, gamma_in_ref[...], fresh0)
     gamma, iters, _ = jax.lax.while_loop(
         cond,
         body,
-        (gamma0, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, c.dtype)),
+        (gamma0, jnp.asarray(0, jnp.int32),
+         jnp.asarray(jnp.inf, jnp.float32)),
     )
 
     # Converged single-pass tail, all while C is still VMEM-resident:
@@ -320,11 +372,14 @@ def _dense_kernel_w(
     identical modulo float reassociation."""
     k_topics = beta_ref.shape[0]
     beta = beta_ref[...]                       # [K, W] exp(log_beta)
-    ct = ct_ref[...]                           # [W, BB]
+    # bf16-stored corpus is consumed via f32-promoting ops — exact, no
+    # materialized upcast (see _dense_kernel).
+    ct = ct_ref[...]                           # [W, BB] f32 or bf16
     mask = mask_ref[...]                       # [1, BB]
     alpha = alpha_ref[0, 0]
     warm = warm_ref[0, 0]
-    n_d = jnp.sum(ct, axis=0, keepdims=True)   # [1, BB]
+    n_d = jnp.sum(ct, axis=0, keepdims=True,   # [1, BB]
+                  dtype=jnp.float32)
     cast = _cast_for(precision)
     beta_m = cast(beta)
 
@@ -361,13 +416,14 @@ def _dense_kernel_w(
         return jnp.logical_and(it < var_max_iters, delta > var_tol)
 
     fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
-        (k_topics, ct.shape[1]), ct.dtype
+        (k_topics, ct.shape[1]), jnp.float32
     )
     gamma0 = jnp.where(warm != 0, gamma_in_ref[...], fresh0)
     gamma_t, iters, _ = jax.lax.while_loop(
         cond,
         body,
-        (gamma0, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, ct.dtype)),
+        (gamma0, jnp.asarray(0, jnp.int32),
+         jnp.asarray(jnp.inf, jnp.float32)),
     )
 
     # f32 tail off the converged gamma: suff-stats factor plus the full
@@ -431,7 +487,9 @@ def dense_fixed_point_w(
         _dense_kernel_w, var_max_iters=var_max_iters, var_tol=var_tol,
         precision=precision,
     )
-    dtype = dense_counts_t.dtype
+    # Outputs/state stay f32 even when the corpus is STORED bf16
+    # (corpus_dtype); the kernel upcasts the block on entry.
+    dtype = jnp.promote_types(dense_counts_t.dtype, jnp.float32)
     if gamma_prev is None:
         gamma_in = jnp.zeros((k_topics, b), dtype)
         warm = jnp.asarray(0, jnp.int32)
@@ -520,7 +578,9 @@ def dense_fixed_point(
         _dense_kernel, var_max_iters=var_max_iters, var_tol=var_tol,
         precision=precision,
     )
-    dtype = dense_counts.dtype
+    # Outputs/state stay f32 even when the corpus is STORED bf16
+    # (corpus_dtype); the kernel upcasts the block on entry.
+    dtype = jnp.promote_types(dense_counts.dtype, jnp.float32)
     if gamma_prev is None:
         gamma_in = jnp.zeros((b, k_topics), dtype)
         warm = jnp.asarray(0, jnp.int32)

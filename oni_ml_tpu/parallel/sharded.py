@@ -186,7 +186,12 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
         beta_l = jnp.exp(log_beta_l)               # [K, W_l]
         beta_m = cast(beta_l)
         mask_col = doc_mask[:, None]
-        n_d = jax.lax.psum(c_l.sum(axis=1), MODEL_AXIS)   # [B_l]
+        # f32 accumulation: the corpus may be STORED bf16
+        # (dense_estep.corpus_dtype) and is consumed via f32-promoting
+        # ops throughout.
+        n_d = jax.lax.psum(
+            jnp.sum(c_l, axis=1, dtype=jnp.float32), MODEL_AXIS
+        )                                          # [B_l]
 
         def e_log_theta(gamma):
             return digamma(gamma) - digamma(gamma.sum(1, keepdims=True))
@@ -223,13 +228,13 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
             return jnp.logical_and(it < var_max_iters, delta > var_tol)
 
         fresh0 = alpha + (n_d / k)[:, None] + jnp.zeros(
-            (c_l.shape[0], k), c_l.dtype
+            (c_l.shape[0], k), jnp.float32
         )
         gamma0 = jnp.where(warm != 0, gamma_prev, fresh0)
         # delta varies over `data` (each data row stops independently);
         # the initial scalar must carry the same varying-axes type.
         delta0 = jax.lax.pcast(
-            jnp.asarray(jnp.inf, c_l.dtype), DATA_AXIS, to="varying"
+            jnp.asarray(jnp.inf, jnp.float32), DATA_AXIS, to="varying"
         )
         gamma, iters, _ = jax.lax.while_loop(
             cond, body,

@@ -634,3 +634,93 @@ def test_trainer_dense_precision_bf16_tracks_f32():
         [ll for ll, _ in f32.likelihoods],
         rtol=1e-2,
     )
+
+
+@pytest.mark.parametrize("wmajor", [False, True])
+@pytest.mark.parametrize("precision", ["f32", "bf16"])
+def test_bf16_corpus_storage_is_bit_identical(wmajor, precision):
+    """A bf16-STORED corpus (counts <= 256: exact in bf16) must produce
+    bitwise-identical results to the f32-stored corpus under either
+    operand precision — the storage dtype only changes HBM traffic."""
+    rng = np.random.default_rng(23)
+    b, l, v, k = 16, 16, 260, 4
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked=2)
+    log_beta = _log_beta(rng, k, v)
+    kw = dict(var_max_iters=20, var_tol=1e-6, interpret=True,
+              wmajor=wmajor, precision=precision)
+
+    d32 = dense_estep.densify(word_idx, counts, v)
+    d16 = dense_estep.densify(word_idx, counts, v, dtype=jnp.bfloat16)
+    assert d16.dtype == jnp.bfloat16
+    # Exactness precondition: every stored count round-trips.
+    np.testing.assert_array_equal(
+        np.asarray(d16, np.float32), np.asarray(d32)
+    )
+    if wmajor:
+        d32, d16 = d32.T, d16.T
+
+    r32 = dense_estep.e_step_dense(log_beta, jnp.float32(2.5), d32,
+                                   doc_mask, **kw)
+    r16 = dense_estep.e_step_dense(log_beta, jnp.float32(2.5), d16,
+                                   doc_mask, **kw)
+    assert r16.gamma.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(r16.gamma),
+                                  np.asarray(r32.gamma))
+    np.testing.assert_array_equal(np.asarray(r16.suff_stats),
+                                  np.asarray(r32.suff_stats))
+    assert float(r16.likelihood) == float(r32.likelihood)
+
+
+def test_corpus_dtype_decision():
+    assert dense_estep.corpus_dtype(4.0, "bf16") == jnp.bfloat16
+    assert dense_estep.corpus_dtype(256.0, "bf16") == jnp.bfloat16
+    assert dense_estep.corpus_dtype(257.0, "bf16") == jnp.float32
+    assert dense_estep.corpus_dtype(4.0, "f32") == jnp.float32
+
+
+def test_max_dense_cell_sums_duplicates():
+    """The bf16 gate must bound DENSIFIED cells: duplicate (doc, word)
+    tokens sum in densify — the DUPFACTOR=1000 feedback path makes a
+    ~1000-count cell out of count-1 tokens, which a max over raw counts
+    never sees."""
+    word_idx = np.zeros((2, 300), np.int32)
+    counts = np.ones((2, 300), np.float32)
+    word_idx[1] = np.arange(300) % 297      # doc 1: mostly distinct
+    cell_max = dense_estep.max_dense_cell(word_idx, counts)
+    assert cell_max == 300.0                # doc 0: one word, 300 tokens
+    assert float(np.max(counts)) == 1.0     # the raw-count max is blind
+    # ...and the decision falls back to exact f32 storage.
+    assert dense_estep.corpus_dtype(cell_max, "bf16") == jnp.float32
+    # Consistency with the real scatter:
+    dense = np.asarray(
+        dense_estep.densify(jnp.asarray(word_idx), jnp.asarray(counts), 300),
+        np.float32,
+    )
+    assert dense.max() == cell_max
+
+
+def test_vocab_sharded_dense_bf16_corpus_matches():
+    """The XLA-level vocab-sharded dense plan with a bf16-stored corpus
+    must match its f32-stored run bitwise (f32-promoting consumers)."""
+    import jax
+
+    from oni_ml_tpu.parallel import make_mesh, make_vocab_sharded_dense_e_step
+
+    rng = np.random.default_rng(29)
+    b, l, v, k = 16, 16, 256, 4
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked=2)
+    log_beta = _log_beta(rng, k, v)
+    mesh = make_mesh(data=2, model=4)
+    fn = make_vocab_sharded_dense_e_step(mesh)
+    kw = dict(var_max_iters=15, var_tol=1e-6)
+    g0 = jnp.zeros((b, k), jnp.float32)
+    res = {}
+    for dt in (None, jnp.bfloat16):
+        dense = dense_estep.densify(word_idx, counts, v, width=v, dtype=dt)
+        res[dt] = jax.jit(
+            lambda lb, a, d, m: fn(lb, a, d, m, g0,
+                                   jnp.asarray(0, jnp.int32), **kw)
+        )(log_beta, jnp.float32(2.5), dense, doc_mask)
+    np.testing.assert_array_equal(np.asarray(res[None].gamma),
+                                  np.asarray(res[jnp.bfloat16].gamma))
+    assert float(res[None].likelihood) == float(res[jnp.bfloat16].likelihood)
