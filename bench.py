@@ -120,9 +120,11 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
 def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
              force_sparse=False, wmajor=True, warm_start=False,
              precision="bf16"):
-    """Production fused-EM throughput at (K, V, B, L); returns
-    (docs_per_sec, seconds_per_em_iter, used_dense, used_wmajor,
-    corpus_itemsize).
+    """Production fused-EM throughput at (K, V, B, L); returns a dict:
+    docs_per_sec, t_iter (seconds per EM iteration), use_dense, wmajor,
+    corpus_itemsize, and mean_vi (mean inner fixed-point iterations per
+    EM step in the timed rounds — shows the var_tol early exit and warm
+    start collapsing the inner loop as beta stabilizes).
 
     chunk EM iterations run device-resident per host call; chunk=32
     amortizes the host<->device round-trip (which dominates at chunk=8
@@ -152,13 +154,22 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
     _sync(res.lls[-1])
 
     best = float("inf")
+    vi = []
     for _ in range(rounds):
         t0 = time.perf_counter()
         res = run_chunk(res.log_beta, res.alpha, res.ll_prev, groups, chunk)
         ll = _sync(res.lls[-1])
         best = min(best, (time.perf_counter() - t0) / chunk)
+        vi.append(float(np.asarray(res.vi_iters, np.float64).mean()))
     assert np.isfinite(ll)
-    return b / best, best, use_dense, wmajor, corpus_itemsize
+    return {
+        "docs_per_sec": b / best,
+        "t_iter": best,
+        "use_dense": use_dense,
+        "wmajor": wmajor,
+        "corpus_itemsize": corpus_itemsize,
+        "mean_vi": float(np.mean(vi)),
+    }
 
 
 def bench_convergence(k=20, v=8192, b=4096, l=128, em_tol=1e-4,
@@ -590,12 +601,12 @@ def main() -> int:
     # it is measured; everything after is best-effort.
     k1, v1, b1, l1 = 20, 8192, 4096, 128
     precision = "bf16"
-    docs_per_sec, t_iter, used_dense, used_wmajor, corpus_isz = bench_em(
-        k1, v1, b1, l1, precision=precision, warm_start=True
-    )
+    em = bench_em(k1, v1, b1, l1, precision=precision, warm_start=True)
+    docs_per_sec, used_dense = em["docs_per_sec"], em["use_dense"]
     util = (
-        em_utilization(k1, v1, b1, t_iter, wmajor=used_wmajor,
-                       precision=precision, corpus_itemsize=corpus_isz)
+        em_utilization(k1, v1, b1, em["t_iter"], wmajor=em["wmajor"],
+                       precision=precision,
+                       corpus_itemsize=em["corpus_itemsize"])
         if used_dense
         else {}
     )
@@ -610,6 +621,7 @@ def main() -> int:
         vs_baseline=round(docs_per_sec / HISTORY_DOCS_PER_SEC, 2),
         engine=engine,
         utilization=util,
+        mean_vi_iters=round(em["mean_vi"], 2),
         prev_round=_prev_round_headline(),
     )
 
@@ -618,22 +630,20 @@ def main() -> int:
     # --no-warm-start selects) — reported so the warm-start default's
     # gain stays attributable.
     def sec_fresh_start():
-        docs_fresh, _, dense_f, _, _ = bench_em(k1, v1, b1, l1, rounds=3,
-                                             warm_start=False,
-                                             precision=precision)
-        return {"value": round(docs_fresh, 1), "unit": "docs/sec",
-                "engine": ("fused+dense+" + precision) if dense_f
-                else "fused+sparse"}
+        em_f = bench_em(k1, v1, b1, l1, rounds=3, warm_start=False,
+                        precision=precision)
+        return {"value": round(em_f["docs_per_sec"], 1), "unit": "docs/sec",
+                "mean_vi_iters": round(em_f["mean_vi"], 2),
+                "engine": ("fused+dense+" + precision)
+                if em_f["use_dense"] else "fused+sparse"}
 
     # Config-3 scale (BASELINE.json: 50 topics, full vocabulary).
     def sec_k50_v50k():
-        docs50k, _, dense50k, _, _ = bench_em(50, 50_000, 2048, 128,
-                                              rounds=3,
-                                           precision=precision,
-                                           warm_start=True)
-        return {"value": round(docs50k, 1), "unit": "docs/sec",
-                "engine": ("dense+" + precision + "+warm") if dense50k
-                else "sparse"}
+        em3 = bench_em(50, 50_000, 2048, 128, rounds=3,
+                       precision=precision, warm_start=True)
+        return {"value": round(em3["docs_per_sec"], 1), "unit": "docs/sec",
+                "engine": ("dense+" + precision + "+warm")
+                if em3["use_dense"] else "sparse"}
 
     # Config-5: streaming SVI steady state at the headline shape.
     def sec_online_svi():
@@ -670,11 +680,10 @@ def main() -> int:
     # column-sharded over `model`, [B, K] psum per fixed-point
     # iteration), correctness-pinned on the virtual mesh.
     def sec_config4():
-        docs4, _, dense4, _, _ = bench_em(20, 524_288, 2048, 128, rounds=2,
-                                       warm_start=True)
-        return {"value": round(docs4, 1), "unit": "docs/sec",
+        em4 = bench_em(20, 524_288, 2048, 128, rounds=2, warm_start=True)
+        return {"value": round(em4["docs_per_sec"], 1), "unit": "docs/sec",
                 "v": 524_288,
-                "engine": "dense" if dense4 else "sparse",
+                "engine": "dense" if em4["use_dense"] else "sparse",
                 "multichip_plan": "vocab_sharded_dense"}
 
     # The reference's actual unit of work: one full day start-to-finish

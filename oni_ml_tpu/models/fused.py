@@ -128,6 +128,10 @@ class ChunkResult(NamedTuple):
     steps_done: jax.Array       # int32 scalar in [0, n_steps]
     converged: jax.Array        # bool scalar
     gammas: tuple               # per group: [NB, B, K] from the final E-step
+    vi_iters: jax.Array         # [chunk] max inner fixed-point iterations
+                                # per executed EM step (observability:
+                                # shows the var_tol early exit + warm
+                                # start collapsing the inner loop)
 
 
 def make_chunk_runner(
@@ -178,6 +182,7 @@ def make_chunk_runner(
         total_ss = jnp.zeros((v, k), dtype)
         total_ll = jnp.zeros((), dtype)
         total_ass = jnp.zeros((), dtype)
+        vi_max = jnp.zeros((), jnp.int32)
         gammas = []
 
         def run_batch(batch, g_in):
@@ -201,21 +206,26 @@ def make_chunk_runner(
                 total_ss = total_ss + res.suff_stats
                 total_ll = total_ll + res.likelihood
                 total_ass = total_ass + res.alpha_ss
+                vi_max = jnp.maximum(
+                    vi_max, jnp.asarray(res.vi_iters, jnp.int32)
+                )
                 gammas.append(res.gamma[None])
                 continue
 
             def scan_body(carry, batch_and_gamma):
-                ss, ll, ass = carry
+                ss, ll, ass, vi = carry
                 batch, g_in = batch_and_gamma
                 res = run_batch(batch, g_in)
                 return (
                     (ss + res.suff_stats, ll + res.likelihood,
-                     ass + res.alpha_ss),
+                     ass + res.alpha_ss,
+                     jnp.maximum(vi, jnp.asarray(res.vi_iters, jnp.int32))),
                     res.gamma,
                 )
 
-            (total_ss, total_ll, total_ass), g = jax.lax.scan(
-                scan_body, (total_ss, total_ll, total_ass), (group, g_prev)
+            (total_ss, total_ll, total_ass, vi_max), g = jax.lax.scan(
+                scan_body, (total_ss, total_ll, total_ass, vi_max),
+                (group, g_prev)
             )
             gammas.append(g)
         new_beta = m_fn(total_ss)
@@ -224,7 +234,7 @@ def make_chunk_runner(
             if estimate_alpha
             else alpha
         )
-        return new_beta, new_alpha, total_ll, tuple(gammas)
+        return new_beta, new_alpha, total_ll, tuple(gammas), vi_max
 
     def run_chunk_impl(log_beta, alpha, ll_prev, groups, n_steps) -> ChunkResult:
         dtype = log_beta.dtype
@@ -244,17 +254,18 @@ def make_chunk_runner(
             for g in groups
         )
         lls0 = jnp.zeros((chunk,), dtype)
+        vi0 = jnp.zeros((chunk,), jnp.int32)
 
         def cond(state):
-            _, _, _, step, _, converged, _ = state
+            _, _, _, step, _, _, converged, _ = state
             return (step < jnp.minimum(n_steps, chunk)) & ~converged
 
         def body(state):
-            log_beta, alpha, ll_prev, step, lls, _, gammas_prev = state
+            log_beta, alpha, ll_prev, step, lls, vis, _, gammas_prev = state
             # Warm start only once this run has produced a gamma (step>0);
             # the initial zeros buffers must never seed the fixed point.
             warm = (step > 0) if warm_start else jnp.asarray(False)
-            new_beta, new_alpha, ll, gammas = em_iteration(
+            new_beta, new_alpha, ll, gammas, vi_max = em_iteration(
                 log_beta, alpha, groups, gammas_prev, warm
             )
             # The first-ever iteration (ll_prev = nan) never stops — the
@@ -268,19 +279,20 @@ def make_chunk_runner(
                 ll,
                 step + 1,
                 lls.at[step].set(ll),
+                vis.at[step].set(vi_max),
                 converged,
                 gammas,
             )
 
         state = (
             log_beta, alpha, ll_prev, jnp.asarray(0, jnp.int32),
-            lls0, jnp.asarray(False), gamma0,
+            lls0, vi0, jnp.asarray(False), gamma0,
         )
-        log_beta, alpha, ll_prev, step, lls, converged, gammas = (
+        log_beta, alpha, ll_prev, step, lls, vis, converged, gammas = (
             jax.lax.while_loop(cond, body, state)
         )
         return ChunkResult(
-            log_beta, alpha, ll_prev, lls, step, converged, gammas
+            log_beta, alpha, ll_prev, lls, step, converged, gammas, vis
         )
 
     return jax.jit(run_chunk_impl, compiler_options=compiler_options)

@@ -14,14 +14,13 @@ import numpy as np
 def test_bench_em_sparse_smoke():
     import bench
 
-    dps, t_iter, used_dense, used_wmajor, corpus_isz = bench.bench_em(
-        4, 128, 32, 16, chunk=2, rounds=1, var_max_iters=3
-    )
-    assert np.isfinite(dps) and dps > 0
-    assert t_iter > 0
-    assert used_dense is False  # CPU backend: dense gate requires TPU
-    assert used_wmajor is False
-    assert corpus_isz == 4      # sparse path: no dense corpus stored
+    em = bench.bench_em(4, 128, 32, 16, chunk=2, rounds=1, var_max_iters=3)
+    assert np.isfinite(em["docs_per_sec"]) and em["docs_per_sec"] > 0
+    assert em["t_iter"] > 0
+    assert em["use_dense"] is False  # CPU backend: dense gate needs TPU
+    assert em["wmajor"] is False
+    assert em["corpus_itemsize"] == 4  # sparse: no dense corpus stored
+    assert 0 < em["mean_vi"] <= 3
 
 
 def test_bench_dns_scoring_smoke():
@@ -68,7 +67,9 @@ def test_em_utilization_fields():
 def _patch_phases(bench, monkeypatch):
     monkeypatch.setattr(
         bench, "bench_em",
-        lambda *a, **k: (1000.0, 0.004, False, False, 4),
+        lambda *a, **k: {"docs_per_sec": 1000.0, "t_iter": 0.004,
+                         "use_dense": False, "wmajor": False,
+                         "corpus_itemsize": 4, "mean_vi": 5.0},
     )
     monkeypatch.setattr(
         bench, "bench_dns_scoring", lambda *a, **k: (5000.0, 0.08)
