@@ -68,7 +68,7 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
               precision="bf16"):
     """Shared corpus/dense-path/runner setup for the EM benches:
     returns (log_beta, groups, run_chunk, use_dense, used_wmajor,
-    corpus_itemsize)."""
+    corpus_itemsize, gammas0)."""
     import jax
     import jax.numpy as jnp
 
@@ -111,10 +111,13 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
         num_docs=b, num_topics=k, num_terms=v, chunk=chunk,
         var_max_iters=var_max_iters, var_tol=1e-6, em_tol=em_tol,
         estimate_alpha=True, compiler_options=compiler_options,
-        dense_wmajor=wmajor, warm_start=warm_start and use_dense,
+        dense_wmajor=wmajor, warm_start=warm_start,
         dense_precision=precision if use_dense else "f32",
     )
-    return log_beta, groups, run_chunk, use_dense, wmajor, corpus_itemsize
+    gammas0 = fused.initial_gammas(groups, k, jnp.float32,
+                                   dense_wmajor=wmajor)
+    return (log_beta, groups, run_chunk, use_dense, wmajor,
+            corpus_itemsize, gammas0)
 
 
 def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
@@ -137,27 +140,32 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
     ~10% faster, so the headline uses it."""
     import jax.numpy as jnp
 
-    log_beta, groups, run_chunk, use_dense, wmajor, corpus_itemsize = (
-        _setup_em(
-            k, v, b, l, chunk=chunk, var_max_iters=var_max_iters,
-            em_tol=0.0, force_sparse=force_sparse, wmajor=wmajor,
-            warm_start=warm_start, precision=precision,
-        )
+    (log_beta, groups, run_chunk, use_dense, wmajor, corpus_itemsize,
+     gammas0) = _setup_em(
+        k, v, b, l, chunk=chunk, var_max_iters=var_max_iters,
+        em_tol=0.0, force_sparse=force_sparse, wmajor=wmajor,
+        warm_start=warm_start, precision=precision,
     )
     alpha = jnp.float32(2.5)
-    res = run_chunk(log_beta, alpha, jnp.float32(np.nan), groups, chunk)
+    have = jnp.asarray(False)
+    res = run_chunk(log_beta, alpha, jnp.float32(np.nan), groups, chunk,
+                    gammas0, have)
     _sync(res.lls[-1])
     # Second warmup: the first post-compile dispatch over the tunneled
     # backend is reliably slow (caches, link); one extra chunk keeps the
-    # timed rounds honest about the steady state.
-    res = run_chunk(res.log_beta, res.alpha, res.ll_prev, groups, chunk)
+    # timed rounds honest about the steady state.  Gammas feed back so
+    # warm start carries across chunk boundaries like the production
+    # driver.
+    res = run_chunk(res.log_beta, res.alpha, res.ll_prev, groups, chunk,
+                    res.gammas, res.steps_done > 0)
     _sync(res.lls[-1])
 
     best = float("inf")
     vi = []
     for _ in range(rounds):
         t0 = time.perf_counter()
-        res = run_chunk(res.log_beta, res.alpha, res.ll_prev, groups, chunk)
+        res = run_chunk(res.log_beta, res.alpha, res.ll_prev, groups, chunk,
+                        res.gammas, res.steps_done > 0)
         ll = _sync(res.lls[-1])
         best = min(best, (time.perf_counter() - t0) / chunk)
         vi.append(float(np.asarray(res.vi_iters, np.float64).mean()))
@@ -183,22 +191,24 @@ def bench_convergence(k=20, v=8192, b=4096, l=128, em_tol=1e-4,
     production driver performs."""
     import jax.numpy as jnp
 
-    log_beta, groups, run_chunk, _, _, _ = _setup_em(
+    (log_beta, groups, run_chunk, _, _, _, gammas0) = _setup_em(
         k, v, b, l, chunk=chunk, var_max_iters=20, em_tol=em_tol,
         precision=precision, warm_start=warm_start,
     )
     # Compile warmup without executing any EM iteration.
     res = run_chunk(log_beta, jnp.float32(2.5), jnp.float32(np.nan),
-                    groups, 0)
+                    groups, 0, gammas0, jnp.asarray(False))
     _sync(res.steps_done)
 
     t0 = time.perf_counter()
     log_b, alpha, ll_prev = log_beta, jnp.float32(2.5), jnp.float32(np.nan)
+    gp, have = gammas0, jnp.asarray(False)
     iters = 0
     done = 0
     while iters < max_iters:
         res = run_chunk(log_b, alpha, ll_prev, groups,
-                        min(chunk, max_iters - iters))
+                        min(chunk, max_iters - iters), gp, have)
+        gp, have = res.gammas, res.steps_done > 0
         log_b, alpha, ll_prev = res.log_beta, res.alpha, res.ll_prev
         done = int(_sync(res.steps_done))
         iters += done

@@ -120,6 +120,22 @@ def dense_groups_bytes(batches: Sequence[Batch], num_terms: int,
     return sum(b.word_idx.shape[0] for b in batches) * width * itemsize
 
 
+def initial_gammas(groups_arrays, k: int, dtype, dense_wmajor=False):
+    """Zero gamma buffers matching ChunkResult.gammas' structure — what
+    drivers pass as the first chunk's `gammas_in` (with have_prev=False)
+    so that later chunks can feed `res.gammas` back WITHOUT a retrace
+    (same pytree structure/shapes every call)."""
+    def batch_dim(g):
+        return (
+            g[0].shape[2] if len(g) == 2 and dense_wmajor else g[0].shape[1]
+        )
+
+    return tuple(
+        jnp.zeros((g[0].shape[0], batch_dim(g), k), dtype)
+        for g in groups_arrays
+    )
+
+
 class ChunkResult(NamedTuple):
     log_beta: jax.Array
     alpha: jax.Array
@@ -162,6 +178,11 @@ def make_chunk_runner(
 
     e_fn = e_step_fn or estep.e_step
     m_fn = m_step_fn or estep.m_step
+    # Sparse groups warm-start only through callables that declare the
+    # gamma_prev/warm kwargs (this package's e_step and its sharded
+    # wrappers); a user-supplied custom e_step_fn stays fresh-start
+    # rather than breaking on unexpected kwargs.
+    e_warm = warm_start and getattr(e_fn, "_oni_warm_capable", False)
     k, v = num_topics, num_terms
 
     def _default_dense(log_beta, alpha, dense, m, g_in, warm):
@@ -189,6 +210,12 @@ def make_chunk_runner(
             if len(batch) == 2:                # dense group: (C [B,V], mask)
                 return dense_fn(log_beta, alpha, *batch, g_in, warm)
             w, c, m = batch                    # sparse group: (w, c, mask)
+            if e_warm:
+                return e_fn(
+                    log_beta, alpha, w, c, m,
+                    var_max_iters=var_max_iters, var_tol=var_tol,
+                    gamma_prev=g_in, warm=warm,
+                )
             return e_fn(
                 log_beta, alpha, w, c, m,
                 var_max_iters=var_max_iters, var_tol=var_tol,
@@ -236,23 +263,21 @@ def make_chunk_runner(
         )
         return new_beta, new_alpha, total_ll, tuple(gammas), vi_max
 
-    def run_chunk_impl(log_beta, alpha, ll_prev, groups, n_steps) -> ChunkResult:
+    def run_chunk_impl(log_beta, alpha, ll_prev, groups, n_steps,
+                       gammas_in=None, have_prev=None) -> ChunkResult:
         dtype = log_beta.dtype
         # Gamma buffers must exist in the carry before the first iteration
-        # writes them; zeros are never read back (steps_done >= 1 whenever
-        # the caller uses gammas).  The doc axis of a W-major dense group
-        # ([NB, W, B]) is the last one.
-        def batch_dim(g):
-            return (
-                g[0].shape[2]
-                if len(g) == 2 and dense_wmajor
-                else g[0].shape[1]
-            )
-
-        gamma0 = tuple(
-            jnp.zeros((g[0].shape[0], batch_dim(g), k), dtype)
-            for g in groups
-        )
+        # writes them.  `gammas_in`/`have_prev` carry the PREVIOUS chunk's
+        # posteriors across the host boundary so warm start survives chunk
+        # boundaries (without them iteration chunk*i+1 restarted fresh);
+        # when absent, zeros are never read back (warm gates on step>0).
+        if gammas_in is None:
+            gamma0 = initial_gammas(groups, k, dtype,
+                                    dense_wmajor=dense_wmajor)
+            have_prev = jnp.asarray(False)
+        else:
+            gamma0 = gammas_in
+            have_prev = jnp.asarray(have_prev)
         lls0 = jnp.zeros((chunk,), dtype)
         vi0 = jnp.zeros((chunk,), jnp.int32)
 
@@ -262,9 +287,13 @@ def make_chunk_runner(
 
         def body(state):
             log_beta, alpha, ll_prev, step, lls, vis, _, gammas_prev = state
-            # Warm start only once this run has produced a gamma (step>0);
-            # the initial zeros buffers must never seed the fixed point.
-            warm = (step > 0) if warm_start else jnp.asarray(False)
+            # Warm start once ANY gamma exists: produced this chunk
+            # (step>0) or carried in from the previous one (have_prev).
+            warm = (
+                (step > 0) | have_prev
+                if warm_start
+                else jnp.asarray(False)
+            )
             new_beta, new_alpha, ll, gammas, vi_max = em_iteration(
                 log_beta, alpha, groups, gammas_prev, warm
             )

@@ -223,6 +223,17 @@ class LDATrainer:
                 var_tol=config.var_tol,
             )
         )
+        # Warm-start variant for the stepwise loop (separate jit: the
+        # fresh path must not pay for unused gamma_prev plumbing).
+        if getattr(base, "_oni_warm_capable", False):
+            self._e_step_warm = jax.jit(
+                lambda lb, a, w, c, m, g, wm: base(
+                    lb, a, w, c, m,
+                    var_max_iters=config.var_max_iters,
+                    var_tol=config.var_tol,
+                    gamma_prev=g, warm=wm,
+                )
+            )
         self._m_step = jax.jit(self._m_base)
 
     def fit(
@@ -395,15 +406,28 @@ class LDATrainer:
             )
             for b in batches
         ]
+        # Warm start mirrors the fused driver's semantics (same gammas
+        # seed the next iteration's fixed point) so the stepwise loop
+        # stays its numerical cross-check under the default config.
+        use_warm = cfg.warm_start_gamma and getattr(
+            self._e_base, "_oni_warm_capable", False
+        )
         gammas = []
         it = start_it
         for it in range(start_it + 1, cfg.em_max_iters + 1):
             total_ss = jnp.zeros((v, k), dtype)
             total_ll = jnp.zeros((), dtype)
             total_ass = jnp.zeros((), dtype)
+            prev_gammas = gammas if use_warm else []
             gammas = []
-            for widx, cnts, mask in dev_batches:
-                res = self._e_step(log_beta, alpha, widx, cnts, mask)
+            for bi, (widx, cnts, mask) in enumerate(dev_batches):
+                if prev_gammas:
+                    res = self._e_step_warm(
+                        log_beta, alpha, widx, cnts, mask,
+                        prev_gammas[bi], jnp.asarray(1, jnp.int32),
+                    )
+                else:
+                    res = self._e_step(log_beta, alpha, widx, cnts, mask)
                 total_ss = total_ss + res.suff_stats
                 total_ll = total_ll + res.likelihood
                 total_ass = total_ass + res.alpha_ss
@@ -697,7 +721,7 @@ class LDATrainer:
             m_step_fn=self._m_base,
             compiler_options=compiler_options,
             dense_wmajor=use_wmajor,
-            warm_start=use_dense and cfg.warm_start_gamma,
+            warm_start=cfg.warm_start_gamma,
             dense_e_step_fn=dense_e_fn,
             dense_precision=cfg.dense_precision,
         )
@@ -707,6 +731,10 @@ class LDATrainer:
         )
         it = start_it
         res = None
+        gammas_prev = fused.initial_gammas(
+            groups.arrays, k, dtype, dense_wmajor=use_wmajor
+        )
+        have_prev = jnp.asarray(False)
         while it < cfg.em_max_iters:
             stop = min(it + cfg.fused_em_chunk, cfg.em_max_iters)
             if checkpoint_path and cfg.checkpoint_every:
@@ -715,8 +743,12 @@ class LDATrainer:
                 ) * cfg.checkpoint_every
                 stop = min(stop, next_ckpt)
             res = run_chunk(
-                log_beta, alpha, ll_prev_dev, groups.arrays, stop - it
+                log_beta, alpha, ll_prev_dev, groups.arrays, stop - it,
+                gammas_prev, have_prev,
             )
+            # Carry the chunk's final posteriors so warm start survives
+            # the host sync at chunk boundaries.
+            gammas_prev, have_prev = res.gammas, res.steps_done > 0
             log_beta, alpha, ll_prev_dev = res.log_beta, res.alpha, res.ll_prev
             steps = int(res.steps_done)
             host_conv = None

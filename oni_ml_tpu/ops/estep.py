@@ -73,12 +73,21 @@ def fixed_point(
     doc_mask: jnp.ndarray,   # [B]
     var_max_iters: int,
     var_tol: float,
+    gamma_prev=None,         # [B, K] warm start (None = fresh init)
+    warm=None,               # traced scalar gating gamma_prev
 ):
-    """Per-document gamma fixed point.  Returns (gamma [B, K], iters)."""
+    """Per-document gamma fixed point.  Returns (gamma [B, K], iters).
+
+    `gamma_prev`/`warm` mirror the dense kernels' warm start (config
+    knob warm_start_gamma): warm != 0 resumes from the previous EM
+    iteration's posterior — same fixed point, fewer iterations once
+    beta stabilizes — else the reference's fresh alpha + N_d/K init."""
     B, L, K = beta_bt.shape
     dtype = beta_bt.dtype
     n_d = counts.sum(-1, keepdims=True)                  # [B, 1]
     gamma0 = alpha + n_d / K * jnp.ones((B, K), dtype)   # lda-c init: alpha + N/k
+    if gamma_prev is not None:
+        gamma0 = jnp.where(warm != 0, gamma_prev, gamma0)
 
     def body(state):
         gamma, _, it = state
@@ -163,6 +172,8 @@ def e_step(
     var_max_iters: int,
     var_tol: float,
     backend: str = "auto",
+    gamma_prev=None,         # [B, K] warm start (None = fresh init)
+    warm=None,               # traced scalar gating gamma_prev
 ) -> EStepResult:
     """Run the per-document fixed point to convergence for one batch.
 
@@ -203,6 +214,7 @@ def e_step(
         return dense_estep.e_step_dense(
             log_beta, alpha, dense, doc_mask, var_max_iters, var_tol,
             interpret=jax.default_backend() != "tpu",
+            gamma_prev=gamma_prev, warm=warm,
         )
     if backend != "xla":
         from . import pallas_estep
@@ -222,15 +234,22 @@ def e_step(
             return pallas_estep.e_step(
                 log_beta, alpha, word_idx, counts, doc_mask,
                 var_max_iters, var_tol,
+                gamma_prev=gamma_prev, warm=warm,
             )
     V = log_beta.shape[1]
     beta_bt = gather_beta(log_beta, word_idx)
     gamma, iters = fixed_point(beta_bt, alpha, counts, doc_mask,
-                               var_max_iters, var_tol)
+                               var_max_iters, var_tol,
+                               gamma_prev=gamma_prev, warm=warm)
     phi_c, phinorm = phi_weighted(beta_bt, gamma, counts, doc_mask)
     suff = suff_stats(phi_c, word_idx, V)
     likelihood, alpha_ss = batch_likelihood(gamma, phinorm, counts, alpha, doc_mask)
     return EStepResult(gamma, suff, alpha_ss, likelihood, iters)
+
+
+# Lets the fused runner know this callable accepts gamma_prev/warm (a
+# user-supplied custom e_step_fn may not; the runner then stays fresh).
+e_step._oni_warm_capable = True
 
 
 def m_step(suff_stats: jnp.ndarray, topic_total=None) -> jnp.ndarray:

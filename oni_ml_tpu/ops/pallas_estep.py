@@ -110,13 +110,19 @@ def digamma_pos(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _fixed_point_kernel(
-    alpha_ref, slab_ref, counts_ref, mask_ref, gamma_ref, iters_ref,
+    alpha_ref, warm_ref, slab_ref, counts_ref, mask_ref, gamma_in_ref,
+    gamma_ref, iters_ref,
     *, var_max_iters: int, var_tol: float,
 ):
     """One grid step = one block of BB documents, slab block [K, BB, L]
-    in VMEM for the whole variational loop."""
+    in VMEM for the whole variational loop.
+
+    warm_ref selects the start: 0 = the reference's fresh alpha + N_d/K
+    init, 1 = resume from gamma_in_ref (warm_start_gamma — same fixed
+    point, fewer iterations once beta stabilizes)."""
     k_topics = slab_ref.shape[0]
     alpha = alpha_ref[0, 0]
+    warm = warm_ref[0, 0]
     counts = counts_ref[:]                      # [BB, L]
     mask = mask_ref[:]                          # [BB, 1]
     n_d = jnp.sum(counts, axis=1, keepdims=True)
@@ -147,9 +153,10 @@ def _fixed_point_kernel(
         _, it, delta = state
         return jnp.logical_and(it < var_max_iters, delta > var_tol)
 
-    gamma0 = (alpha + n_d / k_topics) + jnp.zeros(
+    fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
         (counts.shape[0], k_topics), counts.dtype
     )
+    gamma0 = jnp.where(warm != 0, gamma_in_ref[:], fresh0)
     gamma, iters, _ = jax.lax.while_loop(
         cond,
         body,
@@ -183,6 +190,8 @@ def fixed_point(
     var_tol: float,
     block: int | None = None,
     interpret: bool = False,
+    gamma_prev=None,         # [B, K] warm start (None = fresh init)
+    warm=None,               # traced scalar gating gamma_prev
 ):
     """Pallas gamma fixed point.  Returns (gamma [B, K], iters scalar)."""
     k_topics, b, l = slab_kbl.shape
@@ -195,10 +204,18 @@ def fixed_point(
     kernel = functools.partial(
         _fixed_point_kernel, var_max_iters=var_max_iters, var_tol=var_tol
     )
+    dtype = slab_kbl.dtype
+    if gamma_prev is None:
+        gamma_in = jnp.zeros((b, k_topics), dtype)
+        warm = jnp.asarray(0, jnp.int32)
+    else:
+        gamma_in = jnp.asarray(gamma_prev, dtype)
+        warm = jnp.asarray(warm, jnp.int32)
     gamma, iters = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec(
                 (k_topics, bb, l), lambda i: (0, i, 0),
@@ -206,6 +223,8 @@ def fixed_point(
             ),
             pl.BlockSpec((bb, l), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, k_topics), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((bb, k_topics), lambda i: (i, 0),
@@ -220,9 +239,11 @@ def fixed_point(
         interpret=interpret,
     )(
         jnp.reshape(jnp.asarray(alpha, slab_kbl.dtype), (1, 1)),
+        jnp.reshape(warm, (1, 1)),
         slab_kbl,
         counts,
         jnp.reshape(doc_mask, (b, 1)),
+        gamma_in,
     )
     return gamma, iters.max()
 
@@ -236,6 +257,8 @@ def e_step(
     var_max_iters: int,
     var_tol: float,
     interpret: bool = False,
+    gamma_prev=None,         # [B, K] warm start (None = fresh init)
+    warm=None,               # traced scalar gating gamma_prev
 ) -> estep.EStepResult:
     """Drop-in for estep.e_step with the fixed point in Pallas.
 
@@ -247,7 +270,7 @@ def e_step(
     slab_kbl = jnp.exp(log_beta)[:, word_idx]           # [K, B, L]
     gamma, iters = fixed_point(
         slab_kbl, alpha, counts, doc_mask, var_max_iters, var_tol,
-        interpret=interpret,
+        interpret=interpret, gamma_prev=gamma_prev, warm=warm,
     )
     # Single-pass tail terms: same code as the XLA backend (XLA fuses the
     # layout transpose into the consumers).

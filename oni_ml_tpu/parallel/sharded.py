@@ -33,13 +33,25 @@ from ..ops import estep
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
+def _fresh_warm_fill(log_beta, word_idx):
+    """Default (gamma_prev, warm) for fresh-start calls: zeros that are
+    never read back (warm=0).  One definition so the sharded plans
+    cannot drift on the fresh-start convention."""
+    return (
+        jnp.zeros((word_idx.shape[0], log_beta.shape[0]), log_beta.dtype),
+        jnp.asarray(0, jnp.int32),
+    )
+
+
 def make_data_parallel_e_step(mesh: Mesh):
     """e_step-compatible callable: inputs batch-sharded over `data`,
     outputs gamma sharded / reductions replicated."""
 
-    def local(log_beta, alpha, word_idx, counts, doc_mask, var_max_iters, var_tol):
+    def local(log_beta, alpha, word_idx, counts, doc_mask, gamma_prev,
+              warm, var_max_iters, var_tol):
         res = estep.e_step(
-            log_beta, alpha, word_idx, counts, doc_mask, var_max_iters, var_tol
+            log_beta, alpha, word_idx, counts, doc_mask, var_max_iters,
+            var_tol, gamma_prev=gamma_prev, warm=warm,
         )
         return estep.EStepResult(
             gamma=res.gamma,
@@ -50,11 +62,14 @@ def make_data_parallel_e_step(mesh: Mesh):
         )
 
     def wrapped(log_beta, alpha, word_idx, counts, doc_mask,
-                var_max_iters, var_tol):
+                var_max_iters, var_tol, gamma_prev=None, warm=None):
+        if gamma_prev is None:
+            gamma_prev, warm = _fresh_warm_fill(log_beta, word_idx)
         fn = jax.shard_map(
             partial(local, var_max_iters=var_max_iters, var_tol=var_tol),
             mesh=mesh,
-            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P()),
             out_specs=estep.EStepResult(
                 gamma=P(DATA_AXIS),
                 suff_stats=P(),
@@ -63,10 +78,12 @@ def make_data_parallel_e_step(mesh: Mesh):
                 vi_iters=P(),
             ),
         )
-        return fn(log_beta, alpha, word_idx, counts, doc_mask)
+        return fn(log_beta, alpha, word_idx, counts, doc_mask, gamma_prev,
+                  warm)
 
     wrapped._oni_data_parallel = True  # lets the trainer's dense-mode
-    return wrapped                     # check recognize its own wrapper
+    wrapped._oni_warm_capable = True   # check recognize its own wrapper
+    return wrapped
 
 
 def make_data_parallel_dense_e_step(mesh: Mesh, wmajor: bool = False,
@@ -323,7 +340,7 @@ def make_vocab_sharded_fns(mesh: Mesh):
     m = mesh.shape[MODEL_AXIS]
 
     def local_e_step(log_beta_l, alpha, word_idx, counts, doc_mask,
-                     var_max_iters, var_tol):
+                     gamma_prev, warm, var_max_iters, var_tol):
         K, v_local = log_beta_l.shape
         shard = jax.lax.axis_index(MODEL_AXIS)
         offset = shard * v_local
@@ -337,7 +354,8 @@ def make_vocab_sharded_fns(mesh: Mesh):
         beta_bt = jax.lax.psum(slab_l, MODEL_AXIS)
 
         gamma, iters = estep.fixed_point(
-            beta_bt, alpha, counts, doc_mask, var_max_iters, var_tol
+            beta_bt, alpha, counts, doc_mask, var_max_iters, var_tol,
+            gamma_prev=gamma_prev, warm=warm,
         )
         phi_c, phinorm = estep.phi_weighted(beta_bt, gamma, counts, doc_mask)
         # Scatter only into the owned vocab slice.
@@ -355,16 +373,18 @@ def make_vocab_sharded_fns(mesh: Mesh):
         )
 
     def e_step_fn(log_beta, alpha, word_idx, counts, doc_mask,
-                  var_max_iters, var_tol):
+                  var_max_iters, var_tol, gamma_prev=None, warm=None):
         if log_beta.shape[1] % m:
             raise ValueError(
                 f"vocab size {log_beta.shape[1]} not divisible by model axis {m}"
             )
+        if gamma_prev is None:
+            gamma_prev, warm = _fresh_warm_fill(log_beta, word_idx)
         fn = jax.shard_map(
             partial(local_e_step, var_max_iters=var_max_iters, var_tol=var_tol),
             mesh=mesh,
             in_specs=(P(None, MODEL_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS)),
+                      P(DATA_AXIS), P(DATA_AXIS), P()),
             out_specs=estep.EStepResult(
                 gamma=P(DATA_AXIS),
                 suff_stats=P(MODEL_AXIS, None),
@@ -373,7 +393,8 @@ def make_vocab_sharded_fns(mesh: Mesh):
                 vi_iters=P(),
             ),
         )
-        return fn(log_beta, alpha, word_idx, counts, doc_mask)
+        return fn(log_beta, alpha, word_idx, counts, doc_mask, gamma_prev,
+                  warm)
 
     def local_m_step(ss_l):
         # ss_l: [V/m, K].  Per-topic totals need the full vocab, so psum
@@ -395,6 +416,7 @@ def make_vocab_sharded_fns(mesh: Mesh):
     # vocab-sharded plan (a user's custom e_step_fn must never be
     # silently bypassed by the dense path).
     e_step_fn._oni_vocab_sharded = True
+    e_step_fn._oni_warm_capable = True
     m_step_fn._oni_vocab_sharded = True
     return e_step_fn, m_step_fn
 
