@@ -223,7 +223,10 @@ def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True,
     """Roofline accounting for one dense-path EM iteration.
 
     FLOPs: the kernel runs (var_max_iters VI iterations + 1 tail pass),
-    each two K-small matmuls of 2*B*K*W flops.  In the W-major layout
+    each two K-small matmuls of 2*B*K*W flops — pass the MEASURED mean
+    executed iterations (bench_em's mean_vi) as var_max_iters, not the
+    cap: under warm start the early exit collapses the inner loop and a
+    cap-based count would overstate achieved FLOP/s.  In the W-major layout
     (the production default) the phinorm contraction pads K to the
     128-lane tile while the gamma-update output pads K only to the
     8-sublane granularity.  HBM: the dense corpus crosses once per EM
@@ -616,7 +619,8 @@ def main() -> int:
     util = (
         em_utilization(k1, v1, b1, em["t_iter"], wmajor=em["wmajor"],
                        precision=precision,
-                       corpus_itemsize=em["corpus_itemsize"])
+                       corpus_itemsize=em["corpus_itemsize"],
+                       var_max_iters=em["mean_vi"])
         if used_dense
         else {}
     )

@@ -731,8 +731,16 @@ class LDATrainer:
         )
         it = start_it
         res = None
-        gammas_prev = fused.initial_gammas(
-            groups.arrays, k, dtype, dense_wmajor=use_wmajor
+        # Same data-axis commitment as every other device input: on a
+        # multi-host mesh an uncommitted buffer spanning non-addressable
+        # devices fails outright, and even single-host meshes would pay
+        # a reshard on the first chunk (gamma buffers are [NB, B, K]
+        # with B on the data axis, like the stacked batches).
+        gammas_prev = tuple(
+            put_stacked(g)
+            for g in fused.initial_gammas(
+                groups.arrays, k, dtype, dense_wmajor=use_wmajor
+            )
         )
         have_prev = jnp.asarray(False)
         while it < cfg.em_max_iters:

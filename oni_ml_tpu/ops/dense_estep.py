@@ -41,8 +41,9 @@ doc block (`pick_block`; the 50-topic/50k-vocab config-3 shape fits at
 BB=64).  Shapes beyond either limit fall back to the sparse Pallas/XLA
 paths (ops/pallas_estep.py).  Data-parallel meshes keep this kernel:
 parallel.make_data_parallel_dense_e_step shard_maps it over the doc
-axis with suff-stats psum'd over ICI.  Vocab-sharded runs need the full
-V per device and take the sparse path.
+axis with suff-stats psum'd over ICI.  Vocab-sharded runs get their own
+XLA-level dense plan (parallel.make_vocab_sharded_dense_e_step — this
+kernel needs full V per device, that one column-shards C and beta).
 
 Reference anchor: this replaces oni-lda-c's per-document inner loop
 (SURVEY.md §2.8, §3.3) — `lda est` E-step semantics are preserved
@@ -494,6 +495,7 @@ def dense_fixed_point_w(
         gamma_in = jnp.zeros((k_topics, b), dtype)
         warm = jnp.asarray(0, jnp.int32)
     else:
+        estep.check_warm_pair(gamma_prev, warm)
         gamma_in = jnp.asarray(gamma_prev, dtype).T
         warm = jnp.asarray(warm, jnp.int32)
     gamma_t, t, docll, ass, iters = pl.pallas_call(
@@ -585,6 +587,7 @@ def dense_fixed_point(
         gamma_in = jnp.zeros((b, k_topics), dtype)
         warm = jnp.asarray(0, jnp.int32)
     else:
+        estep.check_warm_pair(gamma_prev, warm)
         gamma_in = jnp.asarray(gamma_prev, dtype)
         warm = jnp.asarray(warm, jnp.int32)
     gamma, t, docll, ass, iters = pl.pallas_call(

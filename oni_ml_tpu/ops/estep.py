@@ -61,6 +61,18 @@ def e_log_dirichlet(param: jnp.ndarray) -> jnp.ndarray:
 _e_log_theta = e_log_dirichlet
 
 
+def check_warm_pair(gamma_prev, warm) -> None:
+    """gamma_prev and warm travel together: without this guard, a
+    gamma_prev passed alone would silently warm-start on the XLA path
+    (`None != 0` is True) but crash on the Pallas/dense paths — one
+    backend changing the math where another errors."""
+    if gamma_prev is not None and warm is None:
+        raise ValueError(
+            "gamma_prev requires an explicit `warm` gate (0 = fresh "
+            "init, nonzero = seed from gamma_prev)"
+        )
+
+
 def gather_beta(log_beta: jnp.ndarray, word_idx: jnp.ndarray) -> jnp.ndarray:
     """[K, V] log beta + [B, L] word ids -> [B, L, K] probability slab."""
     return jnp.exp(log_beta).T[word_idx]
@@ -87,6 +99,7 @@ def fixed_point(
     n_d = counts.sum(-1, keepdims=True)                  # [B, 1]
     gamma0 = alpha + n_d / K * jnp.ones((B, K), dtype)   # lda-c init: alpha + N/k
     if gamma_prev is not None:
+        check_warm_pair(gamma_prev, warm)
         gamma0 = jnp.where(warm != 0, gamma_prev, gamma0)
 
     def body(state):

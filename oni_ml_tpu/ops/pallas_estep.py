@@ -209,6 +209,7 @@ def fixed_point(
         gamma_in = jnp.zeros((b, k_topics), dtype)
         warm = jnp.asarray(0, jnp.int32)
     else:
+        estep.check_warm_pair(gamma_prev, warm)
         gamma_in = jnp.asarray(gamma_prev, dtype)
         warm = jnp.asarray(warm, jnp.int32)
     gamma, iters = pl.pallas_call(

@@ -146,3 +146,14 @@ def test_warm_start_sparse_paths(problem, backend):
     cold = run(gamma_prev=jnp.full_like(fresh.gamma, 7.0), warm=0)
     np.testing.assert_array_equal(np.asarray(cold.gamma),
                                   np.asarray(fresh.gamma))
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas", "dense"])
+def test_gamma_prev_without_warm_raises(problem, backend):
+    """gamma_prev alone must error identically on every backend — never
+    silently warm-start on one and crash on another."""
+    lb, alpha, w, c, m = problem
+    fresh = estep.e_step(lb, alpha, w, c, m, 10, 1e-5, backend="xla")
+    with pytest.raises(ValueError, match="warm"):
+        estep.e_step(lb, alpha, w, c, m, 10, 1e-5, backend=backend,
+                     gamma_prev=fresh.gamma)
