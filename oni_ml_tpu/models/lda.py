@@ -225,6 +225,7 @@ class LDATrainer:
         )
         # Warm-start variant for the stepwise loop (separate jit: the
         # fresh path must not pay for unused gamma_prev plumbing).
+        self._e_step_warm = None   # stays None for non-capable e_fns
         if getattr(base, "_oni_warm_capable", False):
             self._e_step_warm = jax.jit(
                 lambda lb, a, w, c, m, g, wm: base(
