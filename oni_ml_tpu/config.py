@@ -31,6 +31,20 @@ class LDAConfig:
     em_max_iters: int = 100
     em_tol: float = 1e-4
     var_max_iters: int = 20
+    # Inner fixed-point stop (shared rule, ops/stop.py): exit when the
+    # per-doc mean |delta gamma| drops under var_tol RELATIVE to the
+    # doc's mean gamma (alpha + N_d/K, an exact iteration invariant),
+    # OR on gated stagnation — once already near convergence
+    # (< ops.stop.STALL_GATE) and the delta stops shrinking, the
+    # iterate has reached its arithmetic's noise floor (on TPU the
+    # MXU's bf16-truncated matmul inputs put a ~2^-8 relative floor
+    # under the iterates, below which they jitter instead of
+    # contracting) and more iterations cannot improve gamma.  At 1e-6
+    # the relative test is still far tighter than lda-c's per-doc
+    # relative-likelihood stop at its stock 1e-6 (the ELBO is quadratic
+    # in delta-gamma near the fixed point); an absolute 1e-6 against
+    # typical gamma magnitudes sits below f32 resolution and silently
+    # turns var_max_iters into a trip count.
     var_tol: float = 1e-6
     # Device batching: documents per E-step batch (padded, bucketed by length).
     batch_size: int = 1024
@@ -110,7 +124,7 @@ class OnlineLDAConfig:
     tau0: float = 64.0           # learning-rate delay
     kappa: float = 0.7           # learning-rate decay in (0.5, 1]
     var_max_iters: int = 20
-    var_tol: float = 1e-6
+    var_tol: float = 1e-6        # relative to mean gamma (see LDAConfig)
     batch_size: int = 1024       # docs per micro-batch
     min_bucket_len: int = 16
     compute_dtype: str = "float32"

@@ -66,6 +66,7 @@ from . import estep
 # fixed-point body's time (7.1 -> 2.1 us per iteration per 128-doc
 # block at V=8192, K=20); the matmuls themselves run at ~35 TF/s.
 from .pallas_estep import digamma_pos, gammaln_pos, newton_recip as _recip
+from .stop import fp_continue
 
 # VMEM working-set model: double-buffered C block + q + ratio (each
 # [BB, V] f32) + beta and the T accumulator (each [K, V] f32), plus
@@ -282,6 +283,10 @@ def _dense_kernel(
     alpha = alpha_ref[0, 0]
     warm = warm_ref[0, 0]
     n_d = jnp.sum(c, axis=1, keepdims=True, dtype=jnp.float32)
+    # Relative stop normalizer: mean_k gamma = alpha + N_d/K for every
+    # iterate (gamma rows sum to K*alpha + N_d exactly), making var_tol
+    # a relative tolerance — reachable in f32 (see ops/estep.py).
+    inv_scale = 1.0 / (alpha + n_d / k_topics)   # [BB, 1]
     cast = _cast_for(precision)
     beta_m = cast(beta)
 
@@ -298,7 +303,7 @@ def _dense_kernel(
         ) + 1e-30
 
     def body(state):
-        gamma, it, _ = state
+        gamma, it, delta_old, _ = state
         exp_et = jnp.exp(e_log_theta(gamma))   # [BB, K]
         q = qmat(cast(exp_et), beta_m)
         ratio = c * _recip(q)
@@ -308,22 +313,25 @@ def _dense_kernel(
         )
         gamma_new = alpha + exp_et * s
         delta = jnp.max(
-            jnp.mean(jnp.abs(gamma_new - gamma), axis=1, keepdims=True) * mask
+            jnp.mean(jnp.abs(gamma_new - gamma), axis=1, keepdims=True)
+            * inv_scale * mask
         )
-        return gamma_new, it + 1, delta
+        return gamma_new, it + 1, delta, delta_old
 
     def cond(state):
-        _, it, delta = state
-        return jnp.logical_and(it < var_max_iters, delta > var_tol)
+        # var_tol or gated stagnation — the shared rule (ops/stop.py).
+        _, it, delta, prev = state
+        return fp_continue(it, delta, prev, var_max_iters, var_tol)
 
     fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
         (c.shape[0], k_topics), jnp.float32
     )
     gamma0 = jnp.where(warm != 0, gamma_in_ref[...], fresh0)
-    gamma, iters, _ = jax.lax.while_loop(
+    gamma, iters, _, _ = jax.lax.while_loop(
         cond,
         body,
         (gamma0, jnp.asarray(0, jnp.int32),
+         jnp.asarray(jnp.inf, jnp.float32),
          jnp.asarray(jnp.inf, jnp.float32)),
     )
 
@@ -381,6 +389,8 @@ def _dense_kernel_w(
     warm = warm_ref[0, 0]
     n_d = jnp.sum(ct, axis=0, keepdims=True,   # [1, BB]
                   dtype=jnp.float32)
+    # Relative stop normalizer (see _dense_kernel / ops/estep.py).
+    inv_scale = 1.0 / (alpha + n_d / k_topics)  # [1, BB]
     cast = _cast_for(precision)
     beta_m = cast(beta)
 
@@ -397,7 +407,7 @@ def _dense_kernel_w(
         ) + 1e-30
 
     def body(state):
-        gamma_t, it, _ = state
+        gamma_t, it, delta_old, _ = state
         exp_et_t = jnp.exp(e_log_theta_t(gamma_t))   # [K, BB]
         q_t = qmat_t(cast(exp_et_t), beta_m)
         ratio_t = ct * _recip(q_t)
@@ -408,22 +418,24 @@ def _dense_kernel_w(
         gamma_new = alpha + exp_et_t * s_t
         delta = jnp.max(
             jnp.mean(jnp.abs(gamma_new - gamma_t), axis=0, keepdims=True)
-            * mask
+            * inv_scale * mask
         )
-        return gamma_new, it + 1, delta
+        return gamma_new, it + 1, delta, delta_old
 
     def cond(state):
-        _, it, delta = state
-        return jnp.logical_and(it < var_max_iters, delta > var_tol)
+        # var_tol or gated stagnation — the shared rule (ops/stop.py).
+        _, it, delta, prev = state
+        return fp_continue(it, delta, prev, var_max_iters, var_tol)
 
     fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
         (k_topics, ct.shape[1]), jnp.float32
     )
     gamma0 = jnp.where(warm != 0, gamma_in_ref[...], fresh0)
-    gamma_t, iters, _ = jax.lax.while_loop(
+    gamma_t, iters, _, _ = jax.lax.while_loop(
         cond,
         body,
         (gamma0, jnp.asarray(0, jnp.int32),
+         jnp.asarray(jnp.inf, jnp.float32),
          jnp.asarray(jnp.inf, jnp.float32)),
     )
 

@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import estep
+from .stop import fp_continue
 
 # VMEM working-set model for picking the doc block size.  Two terms
 # dominate: the double-buffered slab block (2 * K*BB*L*4) and the
@@ -126,6 +127,11 @@ def _fixed_point_kernel(
     counts = counts_ref[:]                      # [BB, L]
     mask = mask_ref[:]                          # [BB, 1]
     n_d = jnp.sum(counts, axis=1, keepdims=True)
+    # Relative stop: mean_k gamma = alpha + N_d/K is iteration-invariant
+    # (gamma rows sum to K*alpha + N_d exactly), so this normalizer makes
+    # var_tol a relative tolerance — reachable in f32, unlike an absolute
+    # 1e-6 against gamma magnitudes (see ops/estep.py fixed_point).
+    inv_scale = 1.0 / (alpha + n_d / k_topics)  # [BB, 1]
 
     def e_log_theta(gamma):
         return digamma_pos(gamma) - digamma_pos(
@@ -133,7 +139,7 @@ def _fixed_point_kernel(
         )
 
     def body(state):
-        gamma, it, _ = state
+        gamma, it, delta_old, _ = state
         exp_et = jnp.exp(e_log_theta(gamma))    # [BB, K]
         phinorm = jnp.zeros_like(counts)
         for k in range(k_topics):               # K-unrolled VPU reduction
@@ -145,22 +151,26 @@ def _fixed_point_kernel(
             cols.append(alpha + exp_et[:, k : k + 1] * t)
         gamma_new = jnp.concatenate(cols, axis=1)
         delta = jnp.max(
-            jnp.mean(jnp.abs(gamma_new - gamma), axis=1, keepdims=True) * mask
+            jnp.mean(jnp.abs(gamma_new - gamma), axis=1, keepdims=True)
+            * inv_scale * mask
         )
-        return gamma_new, it + 1, delta
+        return gamma_new, it + 1, delta, delta_old
 
     def cond(state):
-        _, it, delta = state
-        return jnp.logical_and(it < var_max_iters, delta > var_tol)
+        # var_tol or gated stagnation — the shared rule (ops/stop.py).
+        _, it, delta, prev = state
+        return fp_continue(it, delta, prev, var_max_iters, var_tol)
 
     fresh0 = (alpha + n_d / k_topics) + jnp.zeros(
         (counts.shape[0], k_topics), counts.dtype
     )
     gamma0 = jnp.where(warm != 0, gamma_in_ref[:], fresh0)
-    gamma, iters, _ = jax.lax.while_loop(
+    gamma, iters, _, _ = jax.lax.while_loop(
         cond,
         body,
-        (gamma0, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, counts.dtype)),
+        (gamma0, jnp.asarray(0, jnp.int32),
+         jnp.asarray(jnp.inf, counts.dtype),
+         jnp.asarray(jnp.inf, counts.dtype)),
     )
     gamma_ref[:] = gamma
     iters_ref[pl.program_id(0), 0] = iters

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import estep
+from ..ops.stop import fp_continue
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -209,6 +210,9 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
         n_d = jax.lax.psum(
             jnp.sum(c_l, axis=1, dtype=jnp.float32), MODEL_AXIS
         )                                          # [B_l]
+        # Relative stop normalizer, identical across the model group
+        # (n_d is psum'd), so the stop stays collective-consistent.
+        inv_scale = 1.0 / (alpha + n_d / k)        # [B_l]
 
         def e_log_theta(gamma):
             return digamma(gamma) - digamma(gamma.sum(1, keepdims=True))
@@ -220,7 +224,7 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
             ) + 1e-30
 
         def body(state):
-            gamma, it, _ = state
+            gamma, it, delta_old, _ = state
             exp_et = jnp.exp(e_log_theta(gamma))   # [B_l, K] (replicated
             q = qmat(cast(exp_et), beta_m)         #  across model)
             ratio = c_l / q
@@ -236,13 +240,16 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
             # shard reaches the same stop decision — the psum inside the
             # loop stays collective-consistent.
             delta = jnp.max(
-                jnp.mean(jnp.abs(gamma_new - gamma), axis=1) * doc_mask
+                jnp.mean(jnp.abs(gamma_new - gamma), axis=1)
+                * inv_scale * doc_mask
             )
-            return gamma_new, it + 1, delta
+            return gamma_new, it + 1, delta, delta_old
 
         def cond(state):
-            _, it, delta = state
-            return jnp.logical_and(it < var_max_iters, delta > var_tol)
+            # var_tol or gated stagnation — the shared rule
+            # (ops/stop.py), identical across the model group.
+            _, it, delta, prev = state
+            return fp_continue(it, delta, prev, var_max_iters, var_tol)
 
         fresh0 = alpha + (n_d / k)[:, None] + jnp.zeros(
             (c_l.shape[0], k), jnp.float32
@@ -253,9 +260,9 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
         delta0 = jax.lax.pcast(
             jnp.asarray(jnp.inf, jnp.float32), DATA_AXIS, to="varying"
         )
-        gamma, iters, _ = jax.lax.while_loop(
+        gamma, iters, _, _ = jax.lax.while_loop(
             cond, body,
-            (gamma0, jnp.asarray(0, jnp.int32), delta0),
+            (gamma0, jnp.asarray(0, jnp.int32), delta0, delta0),
         )
 
         # Full-f32 tail off the converged gamma (dense-kernel semantics).

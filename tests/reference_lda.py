@@ -16,18 +16,33 @@ from scipy.special import digamma, gammaln
 def e_step_doc(log_beta, alpha, words, counts, var_max_iters=20, var_tol=1e-6):
     """Per-document fixed point. Returns (gamma [K], phi [N, K], likelihood)."""
     K = log_beta.shape[0]
+    from oni_ml_tpu.ops.stop import STALL_GATE
+
     n_total = counts.sum()
     gamma = np.full(K, alpha + n_total / K)
     beta_w = np.exp(log_beta[:, words])  # [K, N]
-    for _ in range(var_max_iters):
+    prev_delta = np.inf
+    for it in range(var_max_iters):
         e_lt = digamma(gamma) - digamma(gamma.sum())
         phi = beta_w.T * np.exp(e_lt)[None, :]  # [N, K]
         phi = phi / (phi.sum(-1, keepdims=True) + 1e-300)
         gamma_new = alpha + (phi * counts[:, None]).sum(0)
-        if np.abs(gamma_new - gamma).mean() < var_tol:
-            gamma = gamma_new
-            break
+        # The engines' shared stop rule (oni_ml_tpu/ops/stop.py):
+        # var_tol RELATIVE to the iteration-invariant per-doc mean gamma
+        # (= alpha + N_d/K, since gamma sums to K*alpha + N_d exactly),
+        # or gated stagnation (delta no longer shrinking once already
+        # under STALL_GATE — the arithmetic's noise floor; in this
+        # float64 oracle deltas decrease strictly until machine epsilon,
+        # so it is mirrored for semantic alignment but effectively never
+        # fires first).
+        scale = alpha + n_total / K
+        delta = np.abs(gamma_new - gamma).mean() / scale
         gamma = gamma_new
+        if delta < var_tol:
+            break
+        if it > 0 and delta < STALL_GATE and delta >= prev_delta:
+            break
+        prev_delta = delta
     e_lt = digamma(gamma) - digamma(gamma.sum())
     phi = beta_w.T * np.exp(e_lt)[None, :]
     phinorm = phi.sum(-1)

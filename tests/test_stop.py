@@ -1,0 +1,57 @@
+"""The shared fixed-point stop rule (oni_ml_tpu/ops/stop.py).
+
+The rule is exercised end-to-end through every engine by the oracle
+parity tests; these pin its branch semantics directly so a future tune
+cannot silently change one exit without the table below failing.
+"""
+
+import numpy as np
+
+from oni_ml_tpu.ops.stop import STALL_GATE, fp_continue
+
+
+def cont(it, delta, prev, cap=20, tol=1e-6):
+    return bool(fp_continue(it, delta, prev, cap, tol))
+
+
+def test_first_iteration_always_runs():
+    # it == 0 short-circuits: even a degenerate inf/inf state runs once.
+    assert cont(0, np.inf, np.inf)
+
+
+def test_cap_stops():
+    assert not cont(20, 1.0, 2.0)
+
+
+def test_var_tol_stops():
+    assert not cont(5, 1e-7, 1e-5)
+    assert cont(5, 1e-5, 1e-3)
+
+
+def test_transient_increase_above_gate_continues():
+    # Far from the fixed point the delta is not monotone (warm start
+    # whose beta moved, saddle escape): a growing delta above the gate
+    # must NOT abort the loop.
+    assert STALL_GATE <= 2e-2
+    assert cont(2, 0.3, 0.1)
+    assert cont(2, STALL_GATE, STALL_GATE / 2)
+
+
+def test_stagnation_below_gate_stops():
+    # At the noise floor (below the gate) a non-shrinking delta ends
+    # the loop: further iterations only jitter.
+    d = STALL_GATE / 4
+    assert not cont(5, d, d)          # equal -> stop
+    assert not cont(5, d, d * 0.9)    # grew  -> stop
+    assert cont(5, d * 0.9, d)        # still shrinking -> continue
+
+
+def test_oracle_uses_same_constants():
+    # reference_lda imports STALL_GATE from the package, so the oracle
+    # cannot drift from the engines.
+    import inspect
+
+    from tests import reference_lda
+
+    src = inspect.getsource(reference_lda.e_step_doc)
+    assert "STALL_GATE" in src
