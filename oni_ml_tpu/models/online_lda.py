@@ -234,34 +234,53 @@ class OnlineLDATrainer:
             return True
         return feasible and jax.default_backend() == "tpu"
 
-    def _get_update(self, b: int, l: int):
-        key = (b, l)
-        got = self._updates.pop(key, None)
-        if got is not None:
-            self._updates[key] = got      # re-insert: most recently used
-            return got
+    def _make_e_fn(self, b: int):
+        """Per-batch-shape E-step choice: the dense MXU path when
+        feasible (ops/dense_estep.py — one densify scatter per
+        micro-batch instead of a beta-slab gather per fixed-point
+        iteration), else the configured sparse/sharded e_fn.  Returns
+        (e_fn, compiler_options)."""
         from ..ops import dense_estep
 
         cfg = self.config
-        total_docs = self.total_docs
-        use_dense = self._use_dense(b)
-        compiler_options = None
-        if use_dense:
-            v, k = self.num_terms, cfg.num_topics
-            _, wmajor, compiler_options = dense_estep.plan(b, v, k)
+        if not self._use_dense(b):
+            return self._e_fn, None
+        v, k = self.num_terms, cfg.num_topics
+        _, wmajor, compiler_options = dense_estep.plan(b, v, k)
 
-            def e_fn(elog_beta, alpha, word_idx, counts, doc_mask):
-                dense = dense_estep.densify(word_idx, counts, v)
-                if wmajor:
-                    dense = dense.T
-                return dense_estep.e_step_dense(
-                    elog_beta, alpha, dense, doc_mask,
-                    cfg.var_max_iters, cfg.var_tol,
-                    interpret=jax.default_backend() != "tpu",
-                    wmajor=wmajor,
-                )
-        else:
-            e_fn = self._e_fn
+        def e_fn(elog_beta, alpha, word_idx, counts, doc_mask):
+            dense = dense_estep.densify(word_idx, counts, v)
+            if wmajor:
+                dense = dense.T
+            return dense_estep.e_step_dense(
+                elog_beta, alpha, dense, doc_mask,
+                cfg.var_max_iters, cfg.var_tol,
+                interpret=jax.default_backend() != "tpu",
+                wmajor=wmajor,
+            )
+
+        return e_fn, compiler_options
+
+    def _cache_get(self, key):
+        got = self._updates.pop(key, None)
+        if got is not None:
+            self._updates[key] = got      # re-insert: most recently used
+        return got
+
+    def _cache_update(self, key, jitted):
+        while len(self._updates) >= self._UPDATE_CACHE_MAX:
+            self._updates.pop(next(iter(self._updates)))
+        self._updates[key] = jitted
+        return jitted
+
+    def _get_update(self, b: int, l: int):
+        key = (b, l)
+        got = self._cache_get(key)
+        if got is not None:
+            return got
+        cfg = self.config
+        total_docs = self.total_docs
+        e_fn, compiler_options = self._make_e_fn(b)
 
         def update(lam, rho, word_idx, counts, doc_mask):
             res = e_fn(expected_log_beta(lam), self._alpha, word_idx,
@@ -271,16 +290,69 @@ class OnlineLDATrainer:
             new_lam = (1.0 - rho) * lam + rho * lam_hat
             return new_lam, res.likelihood, res.gamma
 
-        jitted = jax.jit(update, donate_argnums=(0,),
+        return self._cache_update(
+            key, jax.jit(update, donate_argnums=(0,),
                          compiler_options=compiler_options)
-        while len(self._updates) >= self._UPDATE_CACHE_MAX:
-            self._updates.pop(next(iter(self._updates)))
-        self._updates[key] = jitted
-        return jitted
+        )
+
+    def _get_update_many(self, n: int, b: int, l: int):
+        """The chunked streaming program: `n` same-shape micro-batches
+        as ONE jitted `lax.scan` — lambda never leaves the device
+        between the scanned natural-gradient steps, and the rho
+        schedule advances in-scan from the traced start step.  This is
+        models/fused.py's chunking applied to SVI: through a
+        remote-relay PJRT backend the per-step dispatch round-trip
+        otherwise dominates streaming wall-clock."""
+        key = ("many", n, b, l)
+        got = self._cache_get(key)
+        if got is not None:
+            return got
+        cfg = self.config
+        total_docs = self.total_docs
+        e_fn, compiler_options = self._make_e_fn(b)
+        tau0, kappa, eta = cfg.tau0, cfg.kappa, cfg.eta
+
+        def update_many(lam, t0, word_idx, counts, doc_mask):
+            def body(carry, xs):
+                lam, t = carry
+                w, c, m = xs
+                # step()'s host-side rho, evaluated on device (f32 pow
+                # instead of float64 — the schedules agree to ~1e-7
+                # relative).  t stays f32 bookkeeping whatever the
+                # batch compute_dtype: in bf16 t + 1.0 rounds back to
+                # t past 256 and the schedule would freeze.
+                rho = ((tau0 + t) ** (-kappa)).astype(lam.dtype)
+                res = e_fn(expected_log_beta(lam), self._alpha, w, c, m)
+                batch_docs = jnp.maximum(m.sum(), 1.0)
+                lam_hat = (
+                    eta + (total_docs / batch_docs) * res.suff_stats.T
+                )
+                lam = (1.0 - rho) * lam + rho * lam_hat
+                return (lam, t + 1.0), res.likelihood
+
+            (lam, _), lls = jax.lax.scan(
+                body, (lam, t0), (word_idx, counts, doc_mask)
+            )
+            return lam, lls
+
+        return self._cache_update(
+            key, jax.jit(update_many, donate_argnums=(0,),
+                         compiler_options=compiler_options)
+        )
 
     @property
     def lam(self) -> jnp.ndarray:
         return self._lam
+
+    def _check_data_divisible(self, ndocs: int) -> None:
+        from ..parallel.mesh import DATA_AXIS
+
+        data_size = self.mesh.shape[DATA_AXIS]
+        if ndocs % data_size:
+            raise ValueError(
+                f"micro-batch of {ndocs} docs not divisible by data "
+                f"axis {data_size}"
+            )
 
     def _put_batch(self, batch: Batch):
         """Device placement for one micro-batch (data-axis sharded when a
@@ -293,14 +365,9 @@ class OnlineLDATrainer:
         )
         if self.mesh is None:
             return arrays
-        from ..parallel.mesh import DATA_AXIS, batch_sharding
+        from ..parallel.mesh import batch_sharding
 
-        data_size = self.mesh.shape[DATA_AXIS]
-        if batch.word_idx.shape[0] % data_size:
-            raise ValueError(
-                f"micro-batch of {batch.word_idx.shape[0]} docs not "
-                f"divisible by data axis {data_size}"
-            )
+        self._check_data_divisible(batch.word_idx.shape[0])
         sh = batch_sharding(self.mesh)
         return tuple(jax.device_put(a, sh) for a in arrays)
 
@@ -324,36 +391,133 @@ class OnlineLDATrainer:
             tokens=int(batch.counts.sum()),
         )
         self.history.append(info)
-        if (
-            self.checkpoint_path
-            and cfg.checkpoint_every
-            and self.step_count % cfg.checkpoint_every == 0
-        ):
-            from .lda import _is_coordinator
-
-            # _to_host is collective on multi-host meshes
-            # (process_allgather) — every process must reach it; only
-            # the coordinator writes.
-            lam_host = self._to_host(self._lam)
-            if _is_coordinator():
-                save_stream_checkpoint(
-                    self.checkpoint_path,
-                    lam_host,
-                    float(self._alpha),
-                    self.step_count,
-                    [(float(h.likelihood), h.rho) for h in self.history],
-                )
+        self._maybe_stream_checkpoint(prev_count=self.step_count - 1)
         return info
+
+    def _maybe_stream_checkpoint(self, prev_count: int) -> None:
+        """Checkpoint when a checkpoint_every boundary was crossed since
+        `prev_count` (chunked steps cross it mid-chunk; only the
+        end-of-chunk lambda is materialized, so the checkpoint lands on
+        the first step call after the boundary)."""
+        cfg = self.config
+        every = cfg.checkpoint_every
+        if not (self.checkpoint_path and every):
+            return
+        if (self.step_count // every) <= (prev_count // every):
+            return
+        from .lda import _is_coordinator
+
+        # _to_host is collective on multi-host meshes
+        # (process_allgather) — every process must reach it; only
+        # the coordinator writes.
+        lam_host = self._to_host(self._lam)
+        if _is_coordinator():
+            save_stream_checkpoint(
+                self.checkpoint_path,
+                lam_host,
+                float(self._alpha),
+                self.step_count,
+                [(float(h.likelihood), h.rho) for h in self.history],
+            )
+
+    def _put_stack(self, run: Sequence[Batch]):
+        """Device placement for a stacked [N, B, ...] run of same-shape
+        micro-batches (docs axis 1 sharded over `data` on a mesh)."""
+        dtype = jnp.dtype(self.config.compute_dtype)
+        w = np.stack([b.word_idx for b in run])
+        c = np.stack([b.counts for b in run]).astype(dtype)
+        m = np.stack([b.doc_mask for b in run]).astype(dtype)
+        if self.mesh is None:
+            return jnp.asarray(w), jnp.asarray(c), jnp.asarray(m)
+        from ..parallel.mesh import stacked_batch_sharding
+
+        self._check_data_divisible(w.shape[1])
+        sh = stacked_batch_sharding(self.mesh)
+        return tuple(jax.device_put(a, sh) for a in (w, c, m))
+
+    def _run_chunk(self, run: Sequence[Batch]) -> list[StreamStepInfo]:
+        """Execute a same-shape run of micro-batches as one scan chunk."""
+        cfg = self.config
+        w, c, m = self._put_stack(run)
+        update = self._get_update_many(len(run), w.shape[1], w.shape[2])
+        prev = self.step_count
+        t0 = jnp.asarray(float(prev), jnp.float32)  # f32 bookkeeping
+        self._lam, lls = update(self._lam, t0, w, c, m)
+        infos = []
+        for i, b in enumerate(run):
+            rho = float((cfg.tau0 + self.step_count) ** (-cfg.kappa))
+            self.step_count += 1
+            info = StreamStepInfo(
+                step=self.step_count,
+                rho=rho,
+                batch_docs=int(b.doc_mask.sum()),
+                likelihood=lls[i],  # device scalar; no sync here
+                tokens=int(b.counts.sum()),
+            )
+            self.history.append(info)
+            infos.append(info)
+        self._maybe_stream_checkpoint(prev_count=prev)
+        return infos
+
+    def step_many(
+        self, batches: Sequence[Batch], chunk: int = 16
+    ) -> list[StreamStepInfo]:
+        """Natural-gradient updates over `batches` IN ORDER, executing
+        each contiguous same-shape run as device-resident scans (one
+        dispatch per scan — see _get_update_many).  Runs split into
+        power-of-two scan lengths capped at `chunk` (a 7-batch run =
+        scan4 + scan2 + step): any run of >= 2 amortizes dispatches,
+        while the number of compiled scan programs stays bounded at
+        log2(chunk) per micro-batch shape — a 7-batch epoch reuses the
+        same two programs every epoch.  Numerically it is step()
+        applied to each micro-batch in sequence (modulo the rho
+        schedule's f32 evaluation); only the dispatch granularity and
+        checkpoint timing coarsen."""
+        if chunk < 2:
+            return [self.step(b) for b in batches]
+        infos: list[StreamStepInfo] = []
+        i, n = 0, len(batches)
+        while i < n:
+            shape = batches[i].word_idx.shape
+            j = i
+            while j < n and batches[j].word_idx.shape == shape:
+                j += 1
+            while i < j:
+                c = min(j - i, chunk)
+                c = 1 << (c.bit_length() - 1)   # largest power of two <= c
+                if c >= 2:
+                    infos.extend(self._run_chunk(batches[i:i + c]))
+                else:
+                    infos.append(self.step(batches[i]))
+                i += c
+        return infos
 
     def fit_stream(
         self,
         batches: Iterable[Batch],
         progress: Callable[[StreamStepInfo], None] | None = None,
+        chunk: int = 16,
     ) -> "OnlineLDATrainer":
-        for b in batches:
-            info = self.step(b)
+        """Consume a micro-batch stream, buffering contiguous same-shape
+        runs into step_many chunks (progress fires per micro-batch, but
+        only after its chunk completes)."""
+        buf: list[Batch] = []
+
+        def flush():
+            infos = self.step_many(buf, chunk=chunk)
+            buf.clear()
             if progress:
-                progress(info)
+                for info in infos:
+                    progress(info)
+
+        for b in batches:
+            if buf and (
+                b.word_idx.shape != buf[0].word_idx.shape
+                or len(buf) >= chunk
+            ):
+                flush()
+            buf.append(b)
+        flush()
         return self
 
     # -- model extraction ---------------------------------------------------
@@ -465,7 +629,14 @@ def train_corpus_online(
     done = trainer.step_count
     rng = np.random.default_rng(config.seed)
     for _ in range(epochs):
-        order = rng.permutation(len(batches))
+        # Stable-group the epoch's shuffled order by micro-batch shape
+        # (still deterministic in the seed, still a valid SVI sampling
+        # order): same-shape runs then stream through fit_stream's
+        # chunked device-resident scans instead of per-step dispatches.
+        order = sorted(
+            rng.permutation(len(batches)),
+            key=lambda i: batches[i].word_idx.shape,
+        )
         skip, done = min(done, len(order)), max(done - len(order), 0)
         trainer.fit_stream(
             (batches[i] for i in order[skip:]), progress=progress

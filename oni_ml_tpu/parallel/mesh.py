@@ -96,6 +96,13 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[N, B, ...] stacks of micro-batches: docs (axis 1) sharded over
+    `data`, the stack axis replicated (each scan step consumes one
+    full micro-batch)."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -139,7 +139,7 @@ def test_bench_main_headline_survives_secondary_failure(capsys, monkeypatch):
 def test_bench_online_svi_smoke():
     import bench
 
-    dps = bench.bench_online_svi(k=4, v=256, b=64, l=16, steps=4, warm=2)
+    dps = bench.bench_online_svi(k=4, v=256, b=64, l=16, steps=4, chunk=2)
     assert np.isfinite(dps) and dps > 0
 
 

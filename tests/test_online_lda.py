@@ -312,3 +312,109 @@ def test_update_cache_is_bounded():
     tr._get_update(*first_kept)
     tr._get_update(8, cap + 10)
     assert first_kept in tr._updates
+
+
+def test_step_many_matches_sequential_steps():
+    """The chunked scan path (step_many) is step() applied in sequence:
+    same lambda, same step/rho bookkeeping, same likelihood history —
+    modulo the rho schedule's f32 in-scan evaluation."""
+    docs, _ = ref.make_synthetic_corpus(num_docs=96, num_terms=30,
+                                        num_topics=3, seed=9)
+    V, K = 30, 4
+    corpus = corpus_from_docs(docs, V)
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          tau0=8.0, seed=2)
+    batches = list(make_batches(corpus, cfg.batch_size, cfg.min_bucket_len))
+    stream = (batches * 3)[:12]
+
+    seq = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs)
+    for b in stream:
+        seq.step(b)
+    chunked = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs)
+    infos = chunked.step_many(stream, chunk=4)
+
+    np.testing.assert_allclose(np.asarray(seq.lam), np.asarray(chunked.lam),
+                               rtol=1e-4, atol=1e-5)
+    assert chunked.step_count == seq.step_count == 12
+    assert [i.step for i in infos] == list(range(1, 13))
+    np.testing.assert_allclose([i.rho for i in infos],
+                               [h.rho for h in seq.history], rtol=1e-6)
+    np.testing.assert_allclose(
+        [float(i.likelihood) for i in infos],
+        [float(h.likelihood) for h in seq.history], rtol=1e-4)
+    # sub-chunk remainders and shape changes take the per-step path
+    assert chunked.step_many(stream[:3], chunk=4)
+
+
+def test_step_many_mixed_shapes_preserves_order():
+    """Shape changes split runs; order and results still match step()."""
+    rng = np.random.default_rng(5)
+    from oni_ml_tpu.io import Batch
+
+    V, K, B = 25, 3, 8
+
+    def mk(l, seed):
+        r = np.random.default_rng(seed)
+        return Batch(
+            word_idx=r.integers(0, V, size=(B, l)).astype(np.int32),
+            counts=r.integers(1, 4, size=(B, l)).astype(np.float32),
+            doc_index=np.arange(B, dtype=np.int32),
+            doc_mask=np.ones((B,), np.float32),
+        )
+
+    stream = ([mk(16, i) for i in range(5)] + [mk(32, 10 + i) for i in range(2)]
+              + [mk(16, 20 + i) for i in range(2)])
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=B, tau0=8.0, seed=3)
+    seq = OnlineLDATrainer(cfg, num_terms=V, total_docs=64)
+    for b in stream:
+        seq.step(b)
+    chunked = OnlineLDATrainer(cfg, num_terms=V, total_docs=64)
+    chunked.step_many(stream, chunk=4)
+    np.testing.assert_allclose(np.asarray(seq.lam), np.asarray(chunked.lam),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_step_many_sharded_matches_single_device():
+    """The stacked [N, B, L] chunk shards docs (axis 1) over `data` and
+    scans the shard_map'd E-step — same lambda as the unsharded chunk."""
+    import jax
+    from oni_ml_tpu.parallel import make_mesh
+
+    docs, _ = ref.make_synthetic_corpus(num_docs=64, num_terms=20,
+                                        num_topics=2, seed=4)
+    V, K = 20, 3
+    corpus = corpus_from_docs(docs, V)
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          tau0=8.0, seed=6)
+    batches = list(make_batches(corpus, cfg.batch_size, cfg.min_bucket_len))
+    stream = (batches * 3)[:8]
+
+    single = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs)
+    single.step_many(stream, chunk=4)
+    mesh = make_mesh(data=4, model=1, devices=jax.devices()[:4])
+    sharded = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs,
+                               mesh=mesh)
+    sharded.step_many(stream, chunk=4)
+    np.testing.assert_allclose(np.asarray(single.lam),
+                               np.asarray(sharded.lam), rtol=2e-4, atol=2e-4)
+
+
+def test_chunked_checkpoint_lands_after_boundary(tmp_path):
+    """A checkpoint_every boundary crossed mid-chunk checkpoints at the
+    chunk end (the only materialized lambda), not silently never."""
+    docs, _ = ref.make_synthetic_corpus(num_docs=64, num_terms=20,
+                                        num_topics=2, seed=8)
+    V, K = 20, 3
+    corpus = corpus_from_docs(docs, V)
+    cfg = OnlineLDAConfig(num_topics=K, batch_size=16, min_bucket_len=64,
+                          tau0=8.0, seed=7, checkpoint_every=3)
+    batches = list(make_batches(corpus, cfg.batch_size, cfg.min_bucket_len))
+    path = str(tmp_path / "stream.npz")
+    tr = OnlineLDATrainer(cfg, num_terms=V, total_docs=corpus.num_docs,
+                          checkpoint_path=path)
+    tr.step_many((batches * 2)[:4], chunk=4)   # crosses step 3 mid-chunk
+    from oni_ml_tpu.models.online_lda import load_stream_checkpoint
+
+    ck = load_stream_checkpoint(path)
+    assert ck["step"] == 4                     # end-of-chunk state
+    np.testing.assert_allclose(ck["lam"], np.asarray(tr.lam))
