@@ -4,14 +4,17 @@ dns_post_lda.scala:312-331).
 p(event) = Σ_k p(topic k | event's IP) · p(event's word | topic k); events
 scoring below a threshold are emitted ascending (most suspicious first).
 
-TPU-native design: the reference broadcasts two driver-side hash maps to
-every Spark executor and loops per event.  Here the model is two dense
-matrices on device — theta [D+1, K] and p [V+1, K], each with its
-fallback vector as the extra final row — and scoring one batch of events
-is two gathers + a row-wise dot, one fused XLA program on the MXU path.
-Unseen IPs/words index the fallback row, preserving the reference's quirky
-asymmetric fallbacks (0.05/topic flow, 0.1/topic dns; a fully-unseen flow
-event scores 20·0.05·0.05 = 0.05, i.e. NOT maximally suspicious —
+Design: the reference broadcasts two driver-side hash maps to every
+Spark executor and loops per event.  Here the model is two dense
+matrices — theta [D+1, K] and p [V+1, K], each with its fallback
+vector as the extra final row — and scoring one batch of events is two
+row gathers + a row-wise dot, vectorized HOST-side numpy in float64
+(the reference's double precision; see _batched_scores for why this
+stage is deliberately not a device op — at K=20 it is memory-bound
+bookkeeping on host-resident data, not MXU work).  Unseen IPs/words
+index the fallback row, preserving the reference's quirky asymmetric
+fallbacks (0.05/topic flow, 0.1/topic dns; a fully-unseen flow event
+scores 20·0.05·0.05 = 0.05, i.e. NOT maximally suspicious —
 SURVEY §2.6).
 
 Scoring reuses the featurization computed by the pre stage (FlowFeatures /
@@ -21,10 +24,7 @@ DnsFeatures) instead of re-running it the way the post scripts do.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..features.flow import FlowFeatures
@@ -155,28 +155,33 @@ def _lut_rows(lut_odd, queries: list[str], fallback_row: int) -> np.ndarray:
     return out
 
 
-@partial(jax.jit, donate_argnums=())
-def _dot_scores(theta, p, ip_idx, word_idx):
-    """score[i] = <theta[ip_idx[i]], p[word_idx[i]]> — two gathers + dot."""
-    return jnp.einsum(
-        "ik,ik->i", jnp.take(theta, ip_idx, axis=0), jnp.take(p, word_idx, axis=0)
-    )
-
-
 def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20):
-    """Score in fixed-size padded chunks so XLA compiles one shape."""
+    """score[i] = <theta[ip_idx[i]], p[word_idx[i]]> — two K-wide row
+    gathers and a dot, on the HOST in fixed-size numpy chunks.
+
+    This is deliberately not a device op: at K=20 it is ~40 flops per
+    event against two gathered rows — pure memory-bound host work on
+    data that already lives host-side (the featurized day), while a
+    device round trip ships the index arrays out and the scores back
+    for no arithmetic advantage (measured through the remote-relay
+    backend it was the whole scoring stage's wall-clock; even
+    PCIe-attached the transfer beats the compute).  float64
+    accumulation matches the reference's double-precision scoring
+    (the earlier device path computed f32 — a deliberate re-pin of
+    the golden scoring bytes); chunking bounds the gathered
+    temporaries.  Reference anchor: the per-event Map lookup + dot of
+    flow_post_lda.scala:227-239."""
     n = len(ip_idx)
-    theta = jnp.asarray(model.theta, jnp.float32)
-    p = jnp.asarray(model.p, jnp.float32)
+    theta = np.asarray(model.theta, np.float64)
+    p = np.asarray(model.p, np.float64)
     out = np.empty(n, dtype=np.float64)
     for lo in range(0, n, batch):
         hi = min(lo + batch, n)
-        ii = np.zeros(batch if n > batch else n, dtype=np.int32)
-        wi = np.zeros_like(ii)
-        ii[: hi - lo] = ip_idx[lo:hi]
-        wi[: hi - lo] = word_idx[lo:hi]
-        s = _dot_scores(theta, p, jnp.asarray(ii), jnp.asarray(wi))
-        out[lo:hi] = np.asarray(s[: hi - lo], dtype=np.float64)
+        out[lo:hi] = np.einsum(
+            "ik,ik->i",
+            theta[np.asarray(ip_idx[lo:hi], np.int32)],
+            p[np.asarray(word_idx[lo:hi], np.int32)],
+        )
     return out
 
 
