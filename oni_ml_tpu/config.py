@@ -63,8 +63,12 @@ class LDAConfig:
     # Dense-corpus E-step (ops/dense_estep.py): "auto" densifies the corpus
     # once and runs the gather/scatter-free MXU kernel when the device is a
     # TPU, the doc blocks fit VMEM, and the dense corpus fits the HBM
-    # budget below; "on"/"off" force it.  ONI_ML_TPU_ESTEP=dense/xla/pallas
-    # overrides.
+    # budget below; "on"/"off" force it.  When the FULL vocabulary is too
+    # wide (config-4 DNS scale), auto/"on" fall through to the
+    # compact-vocab dense variant — each batch remapped onto its own
+    # Wc-wide vocabulary slice (models/lda.py _plan_compact) — before
+    # giving up on the MXU path.  ONI_ML_TPU_ESTEP=dense/compact/xla/
+    # pallas overrides.
     dense_em: str = "auto"
     # Device-byte ceiling for the densified corpus under dense_em="auto".
     dense_hbm_budget: int = 2 * 1024**3

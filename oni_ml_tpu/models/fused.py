@@ -120,15 +120,142 @@ def dense_groups_bytes(batches: Sequence[Batch], num_terms: int,
     return sum(b.word_idx.shape[0] for b in batches) * width * itemsize
 
 
+class CompactPlan(NamedTuple):
+    """Host-side plan for the compact-vocab dense E-step (config 4's
+    single-chip MXU path — SURVEY.md §5.7's V scaling axis, the
+    combinatorial word space of dns_pre_lda.scala:320-326).
+
+    When the FULL vocabulary is too wide to densify ([B, padded V]
+    blows the VMEM/HBM budget), each batch still touches only the
+    words its documents contain — power-law distributed in real
+    traffic, so a 4096x128-token batch of a 500k-word day typically
+    holds a few tens of thousands of distinct words.  Remapping each
+    batch onto its own compacted vocabulary turns the huge-V E-step
+    back into the gather/scatter-free dense kernel at width Wc << V,
+    at the cost of ONE [K, Wc] beta-column gather and one [Wc, K]
+    suff-stats row-scatter per batch per EM iteration (vs the sparse
+    path's per-token gathers in every fixed-point iteration).
+
+    uniques[g][j]: sorted distinct word ids of group g's j-th stacked
+    batch; widths[g]: the group's shared compact width (max unique
+    count, padded to the 128-lane tile).
+    """
+
+    uniques: tuple          # per group: tuple of np.ndarray word ids
+    widths: tuple           # per group: int compact width Wc
+    wmajor: bool
+    corpus_bytes: int       # device bytes of the compacted corpus
+
+
+def plan_compact(
+    batches: Sequence[Batch],
+    num_topics: int,
+    precision: str = "f32",
+    wmajor: bool = True,
+    itemsize: int = 4,
+    local_div: int = 1,
+) -> CompactPlan | None:
+    """Build a CompactPlan, or None when some group's compact width
+    admits no VMEM-feasible doc block (then the sparse path is the
+    only option).  Pure host-side: np.unique over each batch's token
+    ids (the corpus is static, so the per-batch vocabulary is fixed
+    for the whole run).  `local_div` divides the per-kernel doc count
+    (data-mesh shard factor); callers gate mesh support themselves."""
+    from ..ops import dense_estep
+
+    groups: dict[tuple, list[int]] = {}
+    for i, b in enumerate(batches):
+        groups.setdefault(b.word_idx.shape, []).append(i)
+    uniques, widths = [], []
+    total = 0
+    use_wmajor = wmajor
+    for shape in sorted(groups):
+        idxs = groups[shape]
+        us = tuple(np.unique(batches[i].word_idx) for i in idxs)
+        wc = max(len(u) for u in us)
+        wc = -(-wc // 128) * 128  # lane tile, like padded_width()
+        b_local = shape[0] // local_div
+        if dense_estep.pick_block(b_local, wc, num_topics,
+                                  precision) is None:
+            return None
+        use_wmajor = use_wmajor and (
+            dense_estep.pick_block_w(b_local, wc, num_topics, precision)
+            is not None
+        )
+        uniques.append(us)
+        widths.append(wc)
+        total += len(idxs) * shape[0] * wc * itemsize
+    return CompactPlan(tuple(uniques), tuple(widths), use_wmajor, total)
+
+
+def compact_stack_batches(
+    batches: Sequence[Batch],
+    dtype,
+    put: Callable[[np.ndarray], jax.Array],
+    plan: CompactPlan,
+    corpus_store=None,
+) -> StackedGroups:
+    """Stack batches into compact-dense groups:
+
+    arrays[g] = (dense_local [NB, B, Wc] (or [NB, Wc, B] W-major),
+                 doc_mask [NB, B], vocab_map [NB, Wc] int32)
+
+    vocab_map[j, u] is the GLOBAL word id of local column u; columns
+    past the batch's unique count repeat id 0 as a sentinel — inert,
+    because their local counts are zero, so the kernel produces zero
+    suff-stats there and the scatter-back adds zeros to word 0.
+    Token ids remap via searchsorted into the batch's sorted unique
+    set (exact: every token id is a member)."""
+    from ..ops import dense_estep
+
+    groups: dict[tuple, list[int]] = {}
+    for i, b in enumerate(batches):
+        groups.setdefault(b.word_idx.shape, []).append(i)
+    arrays = []
+    slots = []
+    for g, shape in enumerate(sorted(groups)):
+        idxs = groups[shape]
+        wc = plan.widths[g]
+
+        local_idx, cnts, masks, vmaps = [], [], [], []
+        for j, i in enumerate(idxs):
+            u = plan.uniques[g][j]
+            local_idx.append(
+                np.searchsorted(u, batches[i].word_idx).astype(np.int32)
+            )
+            cnts.append(batches[i].counts.astype(dtype))
+            masks.append(batches[i].doc_mask.astype(dtype))
+            vm = np.zeros(wc, np.int32)
+            vm[: len(u)] = u
+            vmaps.append(vm)
+
+        def one(w, c):
+            d = dense_estep.densify(w, c, wc, width=wc, dtype=corpus_store)
+            return d.T if plan.wmajor else d
+
+        dense = jax.jit(jax.vmap(one))(
+            jnp.asarray(np.stack(local_idx)), jnp.asarray(np.stack(cnts))
+        )
+        arrays.append(
+            (put(dense), put(np.stack(masks)), put(np.stack(vmaps)))
+        )
+        slots.append(tuple(idxs))
+    return StackedGroups(tuple(arrays), tuple(slots))
+
+
 def initial_gammas(groups_arrays, k: int, dtype, dense_wmajor=False):
     """Zero gamma buffers matching ChunkResult.gammas' structure — what
     drivers pass as the first chunk's `gammas_in` (with have_prev=False)
     so that later chunks can feed `res.gammas` back WITHOUT a retrace
     (same pytree structure/shapes every call)."""
     def batch_dim(g):
-        return (
-            g[0].shape[2] if len(g) == 2 and dense_wmajor else g[0].shape[1]
-        )
+        # Dense [NB,B,W] / compact-dense [NB,B,Wc] groups put docs on
+        # axis 1 like sparse [NB,B,L]; the W-major layouts transpose
+        # docs onto the last axis.  Compact groups are len 3 like
+        # sparse but lead with the floating dense corpus (sparse leads
+        # with integer word_idx) — same rule run_batch dispatches on.
+        is_dense = len(g) == 2 or jnp.issubdtype(g[0].dtype, jnp.floating)
+        return g[0].shape[2] if is_dense and dense_wmajor else g[0].shape[1]
 
     return tuple(
         jnp.zeros((g[0].shape[0], batch_dim(g), k), dtype)
@@ -198,6 +325,29 @@ def make_chunk_runner(
 
     dense_fn = dense_e_step_fn or _default_dense
 
+    def _compact_dense(log_beta, alpha, dense_local, m, vocab_map, g_in,
+                       warm):
+        """Compact-vocab dense E-step (plan_compact): run the dense
+        kernel over the batch's own Wc-wide vocabulary slice, then
+        scatter the suff-stats rows back to the full [V, K] layout the
+        M-step consumes.  Sentinel columns (vocab_map padding repeats
+        word 0) carry zero local counts, so their suff-stats are
+        exactly zero and the duplicate-index .add() is a no-op."""
+        from ..ops import dense_estep
+
+        beta_local = jnp.take(log_beta, vocab_map, axis=1)
+        res = dense_estep.e_step_dense(
+            beta_local, alpha, dense_local, m,
+            var_max_iters=var_max_iters, var_tol=var_tol,
+            interpret=jax.default_backend() != "tpu",
+            wmajor=dense_wmajor,
+            gamma_prev=g_in, warm=warm, precision=dense_precision,
+        )
+        ss = jnp.zeros((v, k), log_beta.dtype).at[vocab_map].add(
+            res.suff_stats
+        )
+        return res._replace(suff_stats=ss)
+
     def em_iteration(log_beta, alpha, groups, gammas_prev, warm):
         dtype = log_beta.dtype
         total_ss = jnp.zeros((v, k), dtype)
@@ -209,6 +359,11 @@ def make_chunk_runner(
         def run_batch(batch, g_in):
             if len(batch) == 2:                # dense group: (C [B,V], mask)
                 return dense_fn(log_beta, alpha, *batch, g_in, warm)
+            if jnp.issubdtype(batch[0].dtype, jnp.floating):
+                # compact-dense group: (C_local, mask, vocab_map) —
+                # disjoint from sparse, whose leading word_idx is
+                # integer (dtype is static at trace time).
+                return _compact_dense(log_beta, alpha, *batch, g_in, warm)
             w, c, m = batch                    # sparse group: (w, c, mask)
             if e_warm:
                 return e_fn(

@@ -477,9 +477,10 @@ class LDATrainer:
         from ..ops import dense_estep
 
         env = os.environ.get("ONI_ML_TPU_ESTEP", "")
-        mode = {"dense": "on", "xla": "off", "pallas": "off"}.get(
-            env, self.config.dense_em
-        )
+        # "compact" forces the compact-vocab dense variant: full-V dense
+        # off here, then _plan_compact treats the same env as forced-on.
+        mode = {"dense": "on", "compact": "off", "xla": "off",
+                "pallas": "off"}.get(env, self.config.dense_em)
         if mode not in ("auto", "on", "off"):
             raise ValueError(
                 f"LDAConfig.dense_em={mode!r}: expected 'auto', 'on', or "
@@ -510,9 +511,21 @@ class LDATrainer:
         )
         if mode == "on":
             if not feasible:
+                # Forced dense with an infeasible full-V shape: the
+                # compact-vocab variant is still the dense family —
+                # rescue through it when it can serve (single-process,
+                # per-batch widths blockable), else keep the hard error.
+                if self.mesh is None:
+                    self._compact_rescue = fused.plan_compact(
+                        batches, k, self.config.dense_precision,
+                        wmajor=self.config.dense_wmajor,
+                    )
+                    if self._compact_rescue is not None:
+                        return False
                 raise ValueError(
                     "dense E-step forced but a batch shape has no "
-                    f"VMEM-feasible doc block (V={v}, K={k})"
+                    f"VMEM-feasible doc block (V={v}, K={k}) and the "
+                    "compact-vocab fallback is not feasible either"
                 )
             return True
         # Peak device memory during densify_groups holds BOTH the sparse
@@ -578,6 +591,89 @@ class LDATrainer:
             <= self.config.dense_hbm_budget
         )
 
+    def _plan_compact(self, batches):
+        """Compact-vocab dense fallback decision (fused.plan_compact).
+
+        When the FULL vocabulary is too wide to densify — config-4
+        scale, the combinatorial DNS word space of
+        dns_pre_lda.scala:320-326 — each batch still only touches the
+        words its documents contain, so remapping every batch onto its
+        own compacted vocabulary (width Wc << V) recovers the
+        gather/scatter-free MXU kernel at the cost of one beta-column
+        gather and one suff-stats row-scatter per batch per EM
+        iteration.  Gates mirror _use_dense: auto needs the TPU
+        backend, the stock E-step, and the compacted corpus under the
+        HBM budget; ONI_ML_TPU_ESTEP=compact forces it (tests /
+        interpret runs).  Single-process only — the multi-chip huge-V
+        story is the vocab-sharded dense plan (parallel/sharded.py)."""
+        from ..ops import dense_estep
+
+        env = os.environ.get("ONI_ML_TPU_ESTEP", "")
+        rescue = getattr(self, "_compact_rescue", None)
+        self._compact_rescue = None
+        if env == "dense":
+            # Forced dense that _use_dense could not serve at full V:
+            # the rescue plan (when one was feasible) IS the
+            # dense-family fallback; no separate compact gating.
+            return rescue
+        if env and env != "compact":
+            return None
+        mode = "on" if env == "compact" else self.config.dense_em
+        blocked = (
+            "a mesh is active (the multi-chip huge-V story is the "
+            "vocab-sharded dense plan)"
+            if self.mesh is not None or self.vocab_sharded
+            else "a custom e_step_fn is installed"
+            if self._e_base is not estep.e_step
+            else None
+        )
+        if mode == "off" or blocked:
+            if env == "compact" and blocked:
+                raise ValueError(
+                    f"compact dense E-step forced but {blocked}"
+                )
+            return None
+        if rescue is not None:  # dense_em="on" rescue from _use_dense
+            return rescue
+        if mode != "on" and jax.default_backend() != "tpu":
+            return None
+        cfg = self.config
+        cell_max = max(
+            dense_estep.max_dense_cell(b.word_idx, b.counts)
+            for b in batches
+        )
+        # Cache for _fused_loop's corpus_store derivation: this is a
+        # full O(tokens) host pass the compact path must not pay twice.
+        self._compact_cell_max = cell_max
+        itemsize = jnp.dtype(
+            dense_estep.corpus_dtype(cell_max, cfg.dense_precision)
+        ).itemsize
+        plan = fused.plan_compact(
+            batches, cfg.num_topics, cfg.dense_precision,
+            wmajor=cfg.dense_wmajor, itemsize=itemsize,
+        )
+        if plan is None:
+            if mode == "on":
+                raise ValueError(
+                    "compact dense E-step forced but a batch's compact "
+                    "width admits no VMEM-feasible doc block"
+                )
+            return None
+        if mode == "on":
+            return plan
+        # Peak device memory: the whole compacted corpus plus the
+        # largest single group's sparse stacks (compact_stack_batches
+        # uploads sparse arrays one group at a time, unlike
+        # densify_groups which holds them all).
+        groups: dict[tuple, int] = {}
+        for b in batches:
+            groups[b.word_idx.shape] = (
+                groups.get(b.word_idx.shape, 0) + b.word_idx.size * 8
+            )
+        if plan.corpus_bytes + max(groups.values()) > cfg.dense_hbm_budget:
+            return None
+        return plan
+
     def _fused_loop(
         self, batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
         likelihoods, ll_file, progress, checkpoint_path, gamma_out,
@@ -605,15 +701,14 @@ class LDATrainer:
             def put_stacked(x):
                 return jax.device_put(jnp.asarray(x), stacked_sh)
 
-        groups = fused.stack_batches(
-            batches, np.dtype(cfg.compute_dtype), put_stacked
-        )
         compiler_options = None
         use_dense = self._use_dense(batches)
+        self._compact_cell_max = None  # set by _plan_compact's scan
+        compact = None if use_dense else self._plan_compact(batches)
         use_wmajor = False
         dense_e_fn = None
         corpus_store = None
-        if use_dense:
+        if use_dense or compact is not None:
             from ..ops import dense_estep as _de
 
             # bf16 corpus storage when exact and the run is already in
@@ -622,10 +717,44 @@ class LDATrainer:
             # cells (duplicate (doc, word) tokens sum — the DUPFACTOR
             # feedback path makes ~1000-count cells out of count-1
             # tokens), not the raw counts.
-            cell_max = max(
-                _de.max_dense_cell(b.word_idx, b.counts) for b in batches
-            )
+            cell_max = self._compact_cell_max
+            if cell_max is None:
+                cell_max = max(
+                    _de.max_dense_cell(b.word_idx, b.counts)
+                    for b in batches
+                )
             corpus_store = _de.corpus_dtype(cell_max, cfg.dense_precision)
+        if compact is not None:
+            from ..ops import dense_estep
+
+            # Compact-vocab dense groups are built straight from the
+            # host batches (no sparse stacked upload to discard).  The
+            # chunk runner dispatches on the group layout itself
+            # (fused._compact_dense gathers beta columns and scatters
+            # suff-stats rows per batch).
+            use_wmajor = compact.wmajor
+            groups = fused.compact_stack_batches(
+                batches, np.dtype(cfg.compute_dtype), put, compact,
+                corpus_store=corpus_store,
+            )
+            shapes = sorted({b.word_idx.shape for b in batches})
+            kibs = [
+                dense_estep.scoped_vmem_kib(
+                    shape[0], wc, k, wmajor=use_wmajor,
+                    precision=cfg.dense_precision,
+                )
+                for shape, wc in zip(shapes, compact.widths)
+            ]
+            if any(kibs) and jax.default_backend() == "tpu":
+                compiler_options = {
+                    "xla_tpu_scoped_vmem_limit_kib": str(
+                        max(filter(None, kibs))
+                    )
+                }
+        else:
+            groups = fused.stack_batches(
+                batches, np.dtype(cfg.compute_dtype), put_stacked
+            )
         if use_dense and self.vocab_sharded:
             from functools import partial as _partial
 

@@ -214,13 +214,13 @@ def e_step(
 
     if backend == "auto":
         env = os.environ.get("ONI_ML_TPU_ESTEP", "auto")
-        # "dense" in the env is a DRIVER-level hint (models/lda.py picks it
-        # up in _use_dense, where the densification is amortized across the
-        # run).  Honoring it per call here would re-scatter the batch every
-        # EM iteration — the exact cost the dense path exists to avoid —
-        # so auto dispatch ignores it; only an explicit backend="dense"
-        # argument densifies inline.
-        backend = "auto" if env == "dense" else env
+        # "dense"/"compact" in the env are DRIVER-level hints (models/lda.py
+        # picks them up in _use_dense/_plan_compact, where the densification
+        # is amortized across the run).  Honoring them per call here would
+        # re-scatter the batch every EM iteration — the exact cost the dense
+        # paths exist to avoid — so auto dispatch ignores them; only an
+        # explicit backend="dense" argument densifies inline.
+        backend = "auto" if env in ("dense", "compact") else env
     if backend not in ("auto", "xla", "pallas", "dense"):
         raise ValueError(
             f"unknown E-step backend {backend!r} (set via ONI_ML_TPU_ESTEP "

@@ -724,3 +724,249 @@ def test_vocab_sharded_dense_bf16_corpus_matches():
     np.testing.assert_array_equal(np.asarray(res[None].gamma),
                                   np.asarray(res[jnp.bfloat16].gamma))
     assert float(res[None].likelihood) == float(res[jnp.bfloat16].likelihood)
+
+
+def _compact_groups_for(word_idx, counts, doc_mask, wmajor=False):
+    """Hand-build a one-batch compact-dense group the way
+    fused.compact_stack_batches does: sorted unique vocab, 128-lane
+    padded width, sentinel word-0 padding in the vocab map."""
+    u = np.unique(np.asarray(word_idx))
+    wc = -(-len(u) // 128) * 128
+    vmap_ = np.zeros(wc, np.int32)
+    vmap_[: len(u)] = u
+    local = np.searchsorted(u, np.asarray(word_idx)).astype(np.int32)
+    dense_local = dense_estep.densify(
+        jnp.asarray(local), counts, wc, width=wc
+    )
+    if wmajor:
+        dense_local = dense_local.T
+    return (
+        (dense_local[None], doc_mask[None], jnp.asarray(vmap_)[None]),
+    ), wc, len(u)
+
+
+def test_fused_runner_compact_groups_match_sparse():
+    """Compact-vocab dense groups (per-batch vocabulary remap +
+    suff-stats scatter-back) must reproduce the sparse EM trajectory —
+    both layouts, with real sentinel padding in the vocab map."""
+    rng = np.random.default_rng(13)
+    b, l, v, k = 16, 16, 700, 4
+    word_idx, counts, doc_mask = _random_batch(rng, b, l, v, n_masked=2)
+    log_beta = _log_beta(rng, k, v)
+    alpha = jnp.float32(2.5)
+
+    sparse_groups = ((word_idx[None], counts[None], doc_mask[None]),)
+    compact_groups, wc, n_unique = _compact_groups_for(
+        word_idx, counts, doc_mask
+    )
+    assert wc < v            # actually compacted
+    assert wc > n_unique     # sentinel-padded columns exist
+
+    run = fused.make_chunk_runner(
+        num_docs=b - 2, num_topics=k, num_terms=v, chunk=4,
+        var_max_iters=20, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
+    )
+    r_sparse = run(log_beta, alpha, jnp.float32(np.nan), sparse_groups, 4)
+    r_compact = run(log_beta, alpha, jnp.float32(np.nan), compact_groups, 4)
+
+    compact_groups_w, _, _ = _compact_groups_for(
+        word_idx, counts, doc_mask, wmajor=True
+    )
+    run_w = fused.make_chunk_runner(
+        num_docs=b - 2, num_topics=k, num_terms=v, chunk=4,
+        var_max_iters=20, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
+        dense_wmajor=True,
+    )
+    r_wmajor = run_w(
+        log_beta, alpha, jnp.float32(np.nan), compact_groups_w, 4
+    )
+
+    for r in (r_compact, r_wmajor):
+        np.testing.assert_allclose(
+            np.asarray(r.lls), np.asarray(r_sparse.lls), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(r.log_beta), np.asarray(r_sparse.log_beta),
+            rtol=5e-3, atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            float(r.alpha), float(r_sparse.alpha), rtol=1e-3
+        )
+    # Words absent from the batch got no suff-stats: their beta rows
+    # come out of the M-step exactly like the sparse run's.
+    absent = np.setdiff1d(np.arange(v), np.unique(np.asarray(word_idx)))
+    assert absent.size
+    np.testing.assert_allclose(
+        np.asarray(r_compact.log_beta)[:, absent],
+        np.asarray(r_sparse.log_beta)[:, absent],
+        rtol=1e-5,
+    )
+
+
+def test_plan_compact_widths_and_grouping():
+    """plan_compact: per-group widths are 128-lane multiples covering
+    the widest batch; infeasible compact widths return None."""
+    rng = np.random.default_rng(7)
+
+    def batch(b, l, v):
+        w, c, m = _random_batch(rng, b, l, v)
+        return Batch(
+            word_idx=np.asarray(w), counts=np.asarray(c),
+            doc_mask=np.asarray(m), doc_index=np.arange(b),
+        )
+
+    batches = [batch(16, 16, 5000), batch(16, 16, 5000), batch(8, 8, 5000)]
+    plan = fused.plan_compact(batches, num_topics=4)
+    assert plan is not None
+    assert len(plan.widths) == 2  # (8,8) and (16,16) shape groups
+    for g, us in enumerate(plan.uniques):
+        wmax = max(len(u) for u in us)
+        assert plan.widths[g] % 128 == 0
+        assert plan.widths[g] >= wmax
+        assert plan.widths[g] - wmax < 128
+    # corpus bytes: sum over groups of NB * B * Wc * itemsize
+    shapes = sorted({b.word_idx.shape for b in batches})
+    expect = 0
+    for (shape, wc) in zip(shapes, plan.widths):
+        nb = sum(1 for b in batches if b.word_idx.shape == shape)
+        expect += nb * shape[0] * wc * 4
+    assert plan.corpus_bytes == expect
+
+
+def test_trainer_compact_mode_matches_sparse(monkeypatch):
+    """LDATrainer end-to-end: ONI_ML_TPU_ESTEP=compact (forced compact-
+    vocab dense) vs dense_em='off' on a tiny corpus with two batch
+    shapes."""
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    rng = np.random.default_rng(23)
+    v = 900
+
+    def batch(b, l, start):
+        w, c, m = _random_batch(rng, b, l, v)
+        return Batch(
+            word_idx=np.asarray(w), counts=np.asarray(c),
+            doc_mask=np.asarray(m), doc_index=start + np.arange(b),
+        )
+
+    batches = [batch(16, 16, 0), batch(16, 16, 16), batch(8, 8, 32)]
+    results = {}
+    for force in ("compact", ""):
+        if force:
+            monkeypatch.setenv("ONI_ML_TPU_ESTEP", force)
+        else:
+            monkeypatch.delenv("ONI_ML_TPU_ESTEP", raising=False)
+        cfg = LDAConfig(
+            num_topics=4, em_max_iters=6, em_tol=0.0,
+            var_max_iters=20, fused_em_chunk=3, seed=1,
+            dense_em="off" if not force else "auto",
+            warm_start_gamma=False,
+        )
+        trainer = LDATrainer(cfg, num_terms=v)
+        results[force] = trainer.fit(batches, num_docs=40)
+
+    on, off = results["compact"], results[""]
+    np.testing.assert_allclose(on.log_beta, off.log_beta, rtol=5e-3,
+                               atol=5e-3)
+    np.testing.assert_allclose(
+        [ll for ll, _ in on.likelihoods],
+        [ll for ll, _ in off.likelihoods],
+        rtol=1e-4,
+    )
+    # gamma rows come back to the same per-doc slots either way
+    np.testing.assert_allclose(on.gamma, off.gamma, rtol=5e-3, atol=5e-3)
+
+
+def test_trainer_compact_warm_start_trajectory(monkeypatch):
+    """Warm start through the compact path: same optimum as the fresh
+    compact run within tolerance (mirrors the dense warm-start pin)."""
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    rng = np.random.default_rng(31)
+    v = 600
+    w, c, m = _random_batch(rng, 16, 16, v)
+    batch = Batch(
+        word_idx=np.asarray(w), counts=np.asarray(c),
+        doc_mask=np.asarray(m), doc_index=np.arange(16),
+    )
+    monkeypatch.setenv("ONI_ML_TPU_ESTEP", "compact")
+    res = {}
+    for warm in (True, False):
+        cfg = LDAConfig(
+            num_topics=4, em_max_iters=8, em_tol=0.0, var_max_iters=20,
+            fused_em_chunk=3, seed=1, warm_start_gamma=warm,
+        )
+        res[warm] = LDATrainer(cfg, num_terms=v).fit([batch], num_docs=16)
+    np.testing.assert_allclose(
+        res[True].likelihoods[-1][0], res[False].likelihoods[-1][0],
+        rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        res[True].log_beta, res[False].log_beta, rtol=5e-2, atol=5e-2
+    )
+
+
+def test_forced_dense_infeasible_rescues_to_compact():
+    """dense_em='on' with a full-V-infeasible vocabulary but feasible
+    per-batch compact widths must route to the compact plan instead of
+    raising."""
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    rng = np.random.default_rng(3)
+    v = 4_000_000  # no VMEM-feasible full-V doc block at any batch size
+    assert dense_estep.pick_block(16, v, 4) is None
+    w, c, m = _random_batch(rng, 16, 16, v)
+    batch = Batch(
+        word_idx=np.asarray(w), counts=np.asarray(c),
+        doc_mask=np.asarray(m), doc_index=np.arange(16),
+    )
+    trainer = LDATrainer(
+        LDAConfig(num_topics=4, dense_em="on"), num_terms=v
+    )
+    assert trainer._use_dense([batch]) is False
+    plan = trainer._plan_compact([batch])
+    assert plan is not None
+    assert plan.widths[0] <= 512  # 16x16 tokens -> tiny compact width
+
+
+def test_forced_compact_with_mesh_raises(monkeypatch):
+    """ONI_ML_TPU_ESTEP=compact on a meshed trainer must fail loudly
+    (like every other forced-engine misconfiguration), not silently run
+    sparse."""
+    from oni_ml_tpu.models.lda import LDATrainer
+    from oni_ml_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("ONI_ML_TPU_ESTEP", "compact")
+    trainer = LDATrainer(
+        LDAConfig(num_topics=4), num_terms=200,
+        mesh=make_mesh(data=8, model=1),
+    )
+    batch = Batch(
+        word_idx=np.zeros((16, 8), np.int32),
+        counts=np.zeros((16, 8), np.float32),
+        doc_mask=np.ones((16,), np.float32),
+        doc_index=np.arange(16),
+    )
+    with pytest.raises(ValueError, match="compact dense E-step forced"):
+        trainer._plan_compact([batch])
+
+
+def test_env_dense_infeasible_consumes_rescue(monkeypatch):
+    """ONI_ML_TPU_ESTEP=dense with an infeasible full-V shape must
+    route through the compact rescue — and must not leak the rescue
+    into a later decision for different batches."""
+    from oni_ml_tpu.models.lda import LDATrainer
+
+    rng = np.random.default_rng(3)
+    v = 4_000_000
+    w, c, m = _random_batch(rng, 16, 16, v)
+    batch = Batch(
+        word_idx=np.asarray(w), counts=np.asarray(c),
+        doc_mask=np.asarray(m), doc_index=np.arange(16),
+    )
+    monkeypatch.setenv("ONI_ML_TPU_ESTEP", "dense")
+    trainer = LDATrainer(LDAConfig(num_topics=4), num_terms=v)
+    assert trainer._use_dense([batch]) is False  # rescue cached
+    plan = trainer._plan_compact([batch])
+    assert plan is not None                      # rescue consumed
+    assert trainer._plan_compact([batch]) is None  # not served twice
