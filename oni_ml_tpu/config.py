@@ -172,7 +172,9 @@ class PipelineConfig:
     """End-to-end run configuration (replaces /etc/duxbay.conf + env vars)."""
 
     data_dir: str = "."            # per-day working directory (LPATH analogue)
-    flow_path: str = ""            # raw netflow CSV file/dir (FLOW_PATH)
+    flow_path: str = ""            # netflow CSV file/dir/glob/comma list
+                                   # (FLOW_PATH; multi-file = config-3
+                                   # 30-day corpus, one joint ECDF)
     dns_path: str = ""             # raw DNS CSV/parquet paths (DNS_PATH)
     top_domains_path: str = ""     # Alexa top-1m.csv (dns_pre_lda.scala:62)
     qtiles_path: str = ""          # precomputed flow cuts (SURVEY §2.7)

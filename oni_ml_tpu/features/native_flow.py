@@ -250,9 +250,42 @@ class NativeFlowFeatures:
         self._word_lists = {}
 
 
+def expand_flow_paths(path: str) -> list[str]:
+    """A flow input spec -> ordered list of concrete CSV paths.
+
+    The reference points FLOW_PATH at an HDFS location and Spark's
+    textFile reads every part file under it (flow_pre_lda.scala:249);
+    config 3's 30-day corpus is exactly such a multi-file ingest.  The
+    spec is a comma-separated list whose pieces may be files,
+    directories (every regular file inside, sorted), or globs (sorted
+    expansion).  Listed order is preserved — the first-seen id
+    contract depends on event order.  Header semantics across files
+    match the reference's removeHeader: the first line of the FIRST
+    file is the header, and any later line equal to it is dropped
+    (identical part-file headers vanish)."""
+    import glob as _glob
+
+    out: list[str] = []
+    for piece in path.split(","):
+        if not piece:
+            continue
+        if os.path.isdir(piece):
+            out.extend(
+                p for p in sorted(
+                    os.path.join(piece, n) for n in os.listdir(piece)
+                )
+                if os.path.isfile(p)
+            )
+        elif _glob.has_magic(piece):
+            out.extend(sorted(_glob.glob(piece)))
+        else:
+            out.append(piece)
+    return out
+
+
 def _featurize_native(
     lib,
-    path: str,
+    paths: Sequence[str],
     feedback_rows: Sequence[str],
     precomputed_cuts=None,
     spill_path: str | None = None,
@@ -263,8 +296,9 @@ def _featurize_native(
             h, os.fsencode(spill_path)
         ) < 0:
             raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
-        if lib.ffz_ingest_file(h, os.fsencode(path)) < 0:
-            raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
+        for path in paths:
+            if lib.ffz_ingest_file(h, os.fsencode(path)) < 0:
+                raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
         lib.ffz_mark_raw(h)
         if feedback_rows:
             blob = ("\n".join(feedback_rows) + "\n").encode(
@@ -342,7 +376,14 @@ def featurize_flow_file(
     precomputed_cuts=None,
     spill_path: str | None = None,
 ) -> "NativeFlowFeatures | FlowFeatures":
-    """Featurize a raw netflow CSV file, native when possible.
+    """Featurize raw netflow CSV input, native when possible.
+
+    `path` accepts a single file, a comma-separated list, a directory,
+    or a glob (expand_flow_paths) — the reference's FLOW_PATH is an
+    HDFS location whose every part file Spark reads, and config 3's
+    30-day corpus is a multi-file ingest.  Quantile cuts are computed
+    over the UNION of all files, exactly like one Spark RDD over the
+    whole location.
 
     `spill_path` streams kept raw rows to that file during ingest
     instead of holding them in RAM (features/blob.py MmapBlob): RSS
@@ -350,14 +391,21 @@ def featurize_flow_file(
     returned container stores the spill path, not the bytes.  The
     Python fallback keeps rows in memory (it exists for environments
     without a C++ toolchain, where day-scale data is not expected)."""
+    paths = expand_flow_paths(path)
+    if not paths:
+        # An empty expansion (empty directory, unmatched glob, empty
+        # spec) must not silently produce an empty day.
+        raise OSError(f"no flow input files match {path!r}")
     lib = _LIB.load()
     if lib is not None:
-        return _featurize_native(lib, path, feedback_rows, precomputed_cuts,
-                                 spill_path=spill_path)
+        return _featurize_native(lib, paths, feedback_rows,
+                                 precomputed_cuts, spill_path=spill_path)
+    from itertools import chain
+
     from .lineio import iter_raw_lines
 
     return featurize_flow(
-        iter_raw_lines(path),
+        chain.from_iterable(iter_raw_lines(p) for p in paths),
         feedback_rows=feedback_rows,
         precomputed_cuts=precomputed_cuts,
     )

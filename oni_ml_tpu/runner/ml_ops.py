@@ -670,7 +670,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="suspicion threshold (ml_ops.sh:17-18 defaults TOL=1.1)",
     )
     p.add_argument("--data-dir", default=None, help="working dir (LPATH)")
-    p.add_argument("--flow-path", default=None)
+    p.add_argument(
+        "--flow-path", default=None,
+        help="netflow CSV input: file, directory, glob, or "
+        "comma-separated list — multiple files ingest as one corpus "
+        "with joint quantile cuts (the reference's HDFS FLOW_PATH "
+        "location; config 3's 30-day corpus)",
+    )
     p.add_argument("--dns-path", default=None)
     p.add_argument("--top-domains", default=None, help="top-1m.csv path")
     p.add_argument(

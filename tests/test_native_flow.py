@@ -140,11 +140,65 @@ def test_absurd_cut_lists_rejected(tmp_path):
         native_flow.featurize_flow_file(str(path), precomputed_cuts=cuts)
 
 
-def test_directory_path_errors(tmp_path):
-    # fread on a directory yields 0 bytes + error; must raise, not return
-    # an empty day.
-    with pytest.raises(OSError):
+def test_empty_directory_errors(tmp_path):
+    # A directory input expands to its files; an EMPTY expansion must
+    # raise, not return an empty day.
+    with pytest.raises(OSError, match="no flow input files"):
         native_flow.featurize_flow_file(str(tmp_path))
+
+
+def test_multi_file_ingest_matches_concatenated(tmp_path):
+    """Comma list / glob / directory inputs featurize identically to
+    the concatenated single file: one joint ECDF over the union, part
+    headers dropped (the reference's removeHeader over an HDFS
+    location, flow_pre_lda.scala:249)."""
+    path, lines = make_day(tmp_path, n=400)
+    # Split into three "part files", each carrying the same header line
+    # (Spark part files all carry it; removeHeader drops the copies).
+    header, rows = lines[0], lines[1:]
+    parts_dir = tmp_path / "parts"
+    parts_dir.mkdir()
+    for i, chunk in enumerate((rows[:150], rows[150:300], rows[300:])):
+        (parts_dir / f"part-{i:05d}.csv").write_text(
+            "\n".join([header] + chunk) + "\n"
+        )
+    whole = native_flow.featurize_flow_file(str(path))
+    for spec in (
+        ",".join(
+            str(parts_dir / f"part-{i:05d}.csv") for i in range(3)
+        ),
+        str(parts_dir / "part-*.csv"),
+        str(parts_dir),
+    ):
+        multi = native_flow.featurize_flow_file(spec)
+        assert_parity(multi, whole) if isinstance(
+            multi, native_flow.NativeFlowFeatures
+        ) else None
+        assert multi.num_events == whole.num_events
+        assert multi.word_counts() == whole.word_counts()
+        assert multi.rows == whole.rows
+
+
+def test_multi_file_python_fallback_matches(tmp_path):
+    """The pure-Python fallback chains files with the same header
+    semantics as the native path."""
+    from itertools import chain
+
+    path, lines = make_day(tmp_path, n=120)
+    header, rows = lines[0], lines[1:]
+    p1, p2 = tmp_path / "a.csv", tmp_path / "b.csv"
+    p1.write_text("\n".join([header] + rows[:60]) + "\n")
+    p2.write_text("\n".join([header] + rows[60:]) + "\n")
+    with open(path) as f:
+        whole = pyflow.featurize_flow(line.rstrip("\n") for line in f)
+    from oni_ml_tpu.features.lineio import iter_raw_lines
+
+    multi = pyflow.featurize_flow(
+        chain.from_iterable(iter_raw_lines(str(p)) for p in (p1, p2))
+    )
+    assert multi.num_events == whole.num_events
+    assert multi.word_counts() == whole.word_counts()
+    assert multi.rows == whole.rows
 
 
 def test_pickle_roundtrip(tmp_path):
