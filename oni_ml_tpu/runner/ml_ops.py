@@ -155,15 +155,22 @@ def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
 
 
 def _dns_sources(path: str) -> list:
-    """Comma-separated DNS input list -> ordered featurizer sources: CSV
-    paths stay paths (streamed through the native featurizer); parquet
-    files become pre-projected row lists (the reference reads Hive
-    parquet, dns_pre_lda.scala:142).  Listed order is preserved — the
-    first-seen id contract depends on event order."""
+    """DNS input spec -> ordered featurizer sources: CSV paths stay
+    paths (streamed through the native featurizer); parquet files
+    become pre-projected row lists (the reference reads Hive parquet,
+    dns_pre_lda.scala:142).  The spec takes the same forms as
+    FLOW_PATH — comma list, directories, globs
+    (features.native_flow.expand_flow_paths) — and order is preserved:
+    the first-seen id contract depends on event order.  An empty
+    expansion raises rather than producing an empty day."""
+    from ..features.native_flow import expand_flow_paths
+
+    paths = expand_flow_paths(path)
+    if not paths:
+        raise OSError(f"no DNS input files match {path!r}")
     return [
         _read_parquet_rows(p) if p.endswith(".parquet") else p
-        for p in path.split(",")
-        if p
+        for p in paths
     ]
 
 
@@ -677,7 +684,12 @@ def build_parser() -> argparse.ArgumentParser:
         "with joint quantile cuts (the reference's HDFS FLOW_PATH "
         "location; config 3's 30-day corpus)",
     )
-    p.add_argument("--dns-path", default=None)
+    p.add_argument(
+        "--dns-path", default=None,
+        help="DNS input: CSV/parquet file, directory, glob, or "
+        "comma-separated list (the reference's comma-separated Hive "
+        "parquet paths, dns_pre_lda.scala:142)",
+    )
     p.add_argument("--top-domains", default=None, help="top-1m.csv path")
     p.add_argument(
         "--qtiles", default=None,

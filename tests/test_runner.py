@@ -437,3 +437,28 @@ def test_eval_holdout_rejects_online_and_bad_frac(flow_day):
         from oni_ml_tpu.models.evaluate import hash_split
 
         hash_split(["a", "b"], 1.5)
+
+
+def test_dns_sources_expand_dir_and_glob(tmp_path):
+    """dns_path accepts directories and globs like FLOW_PATH; empty
+    expansions raise instead of producing an empty day."""
+    import pytest
+
+    from oni_ml_tpu.runner.ml_ops import _dns_sources
+
+    d = tmp_path / "dns_parts"
+    d.mkdir()
+    for i in range(3):
+        (d / f"part-{i}.csv").write_text(
+            ",".join(dns_row(ip=f"10.3.0.{i}")) + "\n"
+        )
+    by_dir = _dns_sources(str(d))
+    by_glob = _dns_sources(str(d / "part-*.csv"))
+    by_list = _dns_sources(",".join(str(d / f"part-{i}.csv")
+                                    for i in range(3)))
+    assert by_dir == by_glob == by_list
+    assert len(by_dir) == 3
+    empty = tmp_path / "empty_dir_"
+    empty.mkdir()
+    with pytest.raises(OSError, match="no DNS input files"):
+        _dns_sources(str(empty))
