@@ -259,27 +259,41 @@ def expand_flow_paths(path: str) -> list[str]:
     spec is a comma-separated list whose pieces may be files,
     directories (every regular file inside, sorted), or globs (sorted
     expansion).  Listed order is preserved — the first-seen id
-    contract depends on event order.  Header semantics across files
-    match the reference's removeHeader: the first line of the FIRST
-    file is the header, and any later line equal to it is dropped
-    (identical part-file headers vanish)."""
+    contract depends on event order.  Directory and glob expansion
+    skips names starting with '_' or '.' — Spark's hiddenFileFilter
+    semantics, so a real job-output dir's _SUCCESS / .part-*.crc /
+    _metadata markers never reach the featurizer.  Header semantics
+    across files match the reference's removeHeader: the first line of
+    the FIRST file is the header, and any later line equal to it is
+    dropped (identical part-file headers vanish)."""
     import glob as _glob
+
+    def visible(p: str) -> bool:
+        return not os.path.basename(p).startswith(("_", "."))
+
+    def expand_dir(d: str) -> list[str]:
+        return [
+            p for p in sorted(os.path.join(d, n) for n in os.listdir(d))
+            if os.path.isfile(p) and visible(p)
+        ]
 
     out: list[str] = []
     for piece in path.split(","):
         if not piece:
             continue
         if os.path.isdir(piece):
-            out.extend(
-                p for p in sorted(
-                    os.path.join(piece, n) for n in os.listdir(piece)
-                )
-                if os.path.isfile(p)
-            )
+            out.extend(expand_dir(piece))
         elif _glob.has_magic(piece):
-            out.extend(sorted(_glob.glob(piece)))
+            # A glob may match day DIRECTORIES (/data/flow/2016*) —
+            # expand each like the directory branch, never hand a
+            # directory path to the reader.
+            for p in sorted(_glob.glob(piece)):
+                if os.path.isdir(p):
+                    out.extend(expand_dir(p))
+                elif visible(p):
+                    out.append(p)
         else:
-            out.append(piece)
+            out.append(piece)      # explicitly named files always pass
     return out
 
 

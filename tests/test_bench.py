@@ -65,6 +65,9 @@ def test_em_utilization_fields():
 
 
 def _patch_phases(bench, monkeypatch):
+    # In-process phase execution: the monkeypatched bench_* stubs below
+    # don't exist inside the production path's phase subprocesses.
+    monkeypatch.setenv("BENCH_INPROC", "1")
     monkeypatch.setattr(
         bench, "bench_em",
         lambda *a, **k: {"docs_per_sec": 1000.0, "t_iter": 0.004,
@@ -84,7 +87,8 @@ def _patch_phases(bench, monkeypatch):
     )
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: True)
     monkeypatch.setattr(
-        bench, "bench_convergence", lambda *a, **k: (1.5, 20, -1e5)
+        bench, "bench_convergence",
+        lambda *a, **k: (1.5, 20, -1e5, "fused+sparse"),
     )
 
 
@@ -136,6 +140,17 @@ def test_bench_main_headline_survives_secondary_failure(capsys, monkeypatch):
     assert rec["secondary"]["dns_scoring"]["value"] > 0
 
 
+def test_bench_phase_subprocess_unknown_phase_reports_error():
+    """The production per-phase isolation path: a phase subprocess that
+    exits non-zero (here: unknown phase name, rc=2) must come back as a
+    (None, error) pair, not an exception or a bogus payload."""
+    import bench
+
+    payload, err = bench._run_phase_subprocess("no_such_phase", 60.0)
+    assert payload is None
+    assert "rc=2" in err and "no_such_phase" in err
+
+
 def test_bench_online_svi_smoke():
     import bench
 
@@ -154,7 +169,10 @@ def test_bench_main_aborts_cleanly_when_backend_wedged(capsys, monkeypatch):
 def test_bench_convergence_smoke():
     import bench
 
-    s, iters, ll = bench.bench_convergence(
+    s, iters, ll, engine = bench.bench_convergence(
         k=4, v=128, b=32, l=16, em_tol=1e-3, max_iters=24, chunk=8
     )
     assert s > 0 and 0 < iters <= 24 and np.isfinite(ll)
+    # CPU-pinned test env: dense is TPU-gated, so the engine label must
+    # report what actually ran (review finding: it was hardcoded once).
+    assert engine == "fused+sparse"

@@ -179,6 +179,41 @@ def test_multi_file_ingest_matches_concatenated(tmp_path):
         assert multi.rows == whole.rows
 
 
+def test_job_output_markers_skipped(tmp_path):
+    """Spark hiddenFileFilter semantics: a real job-output dir carries
+    _SUCCESS / .part-*.crc / _metadata markers alongside the part
+    files — directory and glob expansion must skip '_'/'.'-prefixed
+    names (they are checksums/flags, not data), while explicitly named
+    files always pass."""
+    path, lines = make_day(tmp_path, n=60)
+    out_dir = tmp_path / "job_out"
+    out_dir.mkdir()
+    (out_dir / "part-00000.csv").write_text("\n".join(lines) + "\n")
+    (out_dir / "_SUCCESS").write_text("")
+    (out_dir / "_metadata").write_bytes(b"\x00\x01binary")
+    (out_dir / ".part-00000.csv.crc").write_bytes(b"\x00crc")
+    assert native_flow.expand_flow_paths(str(out_dir)) == [
+        str(out_dir / "part-00000.csv")
+    ]
+    assert native_flow.expand_flow_paths(str(out_dir / "*")) == [
+        str(out_dir / "part-00000.csv")
+    ]
+    # Explicit naming bypasses the filter.
+    assert native_flow.expand_flow_paths(str(out_dir / "_SUCCESS")) == [
+        str(out_dir / "_SUCCESS")
+    ]
+    # A glob matching day DIRECTORIES expands each like the directory
+    # branch (multi-day spec: /data/flow/2016*) — never returns a
+    # directory path for the reader to open().
+    assert native_flow.expand_flow_paths(str(tmp_path / "job_*")) == [
+        str(out_dir / "part-00000.csv")
+    ]
+    whole = native_flow.featurize_flow_file(str(path))
+    multi = native_flow.featurize_flow_file(str(out_dir))
+    assert multi.num_events == whole.num_events
+    assert multi.word_counts() == whole.word_counts()
+
+
 def test_multi_file_python_fallback_matches(tmp_path):
     """The pure-Python fallback chains files with the same header
     semantics as the native path."""

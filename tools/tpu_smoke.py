@@ -19,9 +19,12 @@ CPU fallback would silently re-run the interpret path the test suite
 already covers).
 """
 
+import os
 import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
