@@ -652,8 +652,8 @@ def _with_watchdog(record: _Record, budget_s: float):
         proc = _CURRENT_PHASE_PROC
         if proc is not None:            # don't orphan a wedged child
             try:                        # holding the chip grant
-                proc.kill()
-            except OSError:
+                proc.terminate()        # TERM, not KILL: a mid-claim
+            except OSError:             # SIGKILL can wedge the grant
                 pass
         for d in list(_E2E_WORKDIRS):
             shutil.rmtree(d, ignore_errors=True)
@@ -822,7 +822,10 @@ PHASES = [
     ("lda_em_throughput_config4_v512k", phase_config4, 480.0),
     ("pipeline_e2e", phase_pipeline_e2e, 900.0),
     ("pipeline_e2e_dns", phase_pipeline_e2e_dns, 720.0),
-    ("lda_online_svi", phase_online_svi, 480.0),
+    # SVI ships every micro-batch host->device (~150 MB over the
+    # tunneled backend for the 24-step run) plus two scan compiles —
+    # the slowest phase end-to-end even when healthy.
+    ("lda_online_svi", phase_online_svi, 900.0),
 ]
 
 
@@ -865,8 +868,16 @@ def _run_phase_subprocess(name: str, timeout: float):
     try:
         out, errout = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.communicate()
+        # SIGTERM first with a grace window: SIGKILLing a process
+        # mid-chip-claim has been observed to wedge the grant for
+        # every later process (>1h), which costs far more than the
+        # 15s grace.
+        proc.terminate()
+        try:
+            proc.communicate(timeout=15.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
         return None, f"timeout after {timeout:.0f}s (wedged device call?)"
     finally:
         _CURRENT_PHASE_PROC = None

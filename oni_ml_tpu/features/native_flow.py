@@ -286,11 +286,16 @@ def expand_flow_paths(path: str) -> list[str]:
         elif _glob.has_magic(piece):
             # A glob may match day DIRECTORIES (/data/flow/2016*) —
             # expand each like the directory branch, never hand a
-            # directory path to the reader.
+            # directory path to the reader.  A pattern whose basename
+            # itself starts with '_'/'.' is a DELIBERATE selection of
+            # hidden names (dir/_2016*.csv), so those matches pass.
+            deliberate = os.path.basename(piece).startswith(("_", "."))
             for p in sorted(_glob.glob(piece)):
+                if not (visible(p) or deliberate):
+                    continue            # _logs/, _temporary/, .crc ...
                 if os.path.isdir(p):
                     out.extend(expand_dir(p))
-                elif visible(p):
+                else:
                     out.append(p)
         else:
             out.append(piece)      # explicitly named files always pass

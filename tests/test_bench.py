@@ -23,6 +23,25 @@ def test_bench_em_sparse_smoke():
     assert 0 < em["mean_vi"] <= 3
 
 
+def test_bench_em_compact_smoke():
+    """phase_config4's engine path at toy scale: with the full-V dense
+    gate off (CPU), compact=True must route through the compact-vocab
+    dense engine (plan_compact + compact_stack_batches) and report its
+    width/unique-word evidence fields."""
+    import bench
+
+    em = bench.bench_em(4, 4096, 32, 16, chunk=2, rounds=1,
+                        var_max_iters=3, compact=True,
+                        word_law="loguniform")
+    assert np.isfinite(em["docs_per_sec"]) and em["docs_per_sec"] > 0
+    assert em["use_dense"] is True           # compact engine IS dense
+    assert em["engine_variant"] == "compact"
+    # log-uniform draw over [1, 4096) from 32*16 tokens: far fewer
+    # uniques than V, padded up to the compact width
+    assert 0 < em["unique_words"] <= 32 * 16
+    assert em["unique_words"] <= em["compact_width"] < 4096
+
+
 def test_bench_dns_scoring_smoke():
     import bench
 

@@ -208,6 +208,19 @@ def test_job_output_markers_skipped(tmp_path):
     assert native_flow.expand_flow_paths(str(tmp_path / "job_*")) == [
         str(out_dir / "part-00000.csv")
     ]
+    # Hidden DIRECTORIES matched by a glob are skipped too (_logs/,
+    # mid-job _temporary/ attempt dirs).
+    logs = out_dir / "_logs"
+    logs.mkdir()
+    (logs / "history.csv").write_text("not,flow,data\n")
+    assert native_flow.expand_flow_paths(str(out_dir / "*")) == [
+        str(out_dir / "part-00000.csv")
+    ]
+    # ...but a pattern whose basename itself starts with '_' is a
+    # deliberate selection of hidden names and passes.
+    assert native_flow.expand_flow_paths(str(out_dir / "_SUC*")) == [
+        str(out_dir / "_SUCCESS")
+    ]
     whole = native_flow.featurize_flow_file(str(path))
     multi = native_flow.featurize_flow_file(str(out_dir))
     assert multi.num_events == whole.num_events
