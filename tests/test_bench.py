@@ -159,6 +159,46 @@ def test_bench_main_headline_survives_secondary_failure(capsys, monkeypatch):
     assert rec["secondary"]["dns_scoring"]["value"] > 0
 
 
+def test_bench_backend_dead_skips_device_phases_keeps_host_phases(
+    capsys, monkeypatch
+):
+    """Once a device phase times out AND the backend re-probe fails
+    twice, remaining DEVICE phases are skipped (not left to hang in
+    backend init for their full timeouts) while host-only scoring
+    phases still run."""
+    import bench
+
+    _patch_phases(bench, monkeypatch)
+    monkeypatch.setattr(
+        bench, "bench_convergence",
+        lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("timeout after 300s (wedged device call?)")
+        ),
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # The initial responsiveness gate also uses _backend_responsive;
+    # let the run start (first call True), then fail probes mid-run.
+    gate = iter([True])
+    monkeypatch.setattr(
+        bench, "_backend_responsive",
+        lambda *a, **k: next(gate, False),
+    )
+    assert bench.main() == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    sec = rec["secondary"]
+    assert "timeout" in sec["lda_em_convergence"]["error"]
+    # Host-only phases after the wedge still produced numbers.
+    assert sec["dns_scoring"]["value"] > 0
+    assert sec["flow_scoring"]["value"] > 0
+    # Device phases after the wedge were skipped, not timed out.
+    for name in ("lda_em_throughput_k50_v50k",
+                 "lda_em_throughput_config4_v512k",
+                 "pipeline_e2e", "pipeline_e2e_dns", "lda_online_svi"):
+        assert sec[name] == {"error": "skipped: backend wedged earlier in run"}
+    # The phase before the wedge ran normally.
+    assert sec["lda_em_throughput_fresh_start"]["value"] > 0
+
+
 def test_bench_phase_subprocess_unknown_phase_reports_error():
     """The production per-phase isolation path: a phase subprocess that
     exits non-zero (here: unknown phase name, rc=2) must come back as a
