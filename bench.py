@@ -399,7 +399,16 @@ def _write_flow_day(f, n_events, n_src=4000, n_dst=2000, seed=11,
                     chunk=200_000):
     """Write a synthetic 27-column netflow day (no header) to an open
     text file, chunked so multi-million-event days don't hold every
-    line in RAM."""
+    line in RAM.
+
+    Layout follows the reference schema exactly (features/flow.py
+    FLOW_COLUMNS: hour@4, minute@5, second@6, tdur@7, sip@8, dip@9,
+    sport@10, dport@11, proto@12, flag@13, fwd@14, stos@15, ipkt@16,
+    ibyt@17, then 9 unused columns).  An earlier version carried an
+    extra leading timestamp column that shifted everything one right —
+    the featurizer then read sip="0.0" and a dip-string port for every
+    row, collapsing the synthetic day to one port bucket and a
+    degenerate vocabulary."""
     rng = np.random.default_rng(seed)
     svc = np.asarray([80, 443, 22, 53, 8080, 25])
     for start in range(0, n_events, chunk):
@@ -414,12 +423,12 @@ def _write_flow_day(f, n_events, n_src=4000, n_dst=2000, seed=11,
         ipkts = rng.integers(1, 100, size=m)
         ibyts = rng.integers(40, 100_000, size=m)
         f.write("\n".join(
-            "2016-01-22,1453420800,2016,1,22,"
+            "2016-01-22 00:00:00,2016,1,22,"
             f"{hours[i]},{mins[i]},{secs[i]},0.0,"
             f"10.0.{sip_i[i] >> 8}.{sip_i[i] & 255},"
             f"10.1.{dip_i[i] >> 8}.{dip_i[i] & 255},"
             f"{sports[i]},{dports[i]},TCP,,0,0,{ipkts[i]},{ibyts[i]},"
-            "0,0,0,0,0,0,0,"
+            "0,0,0,0,0,0,0,0,0"
             for i in range(m)
         ) + "\n")
 

@@ -81,12 +81,32 @@ class NativeLib:
                 if not self._build() and not os.path.exists(self._lib_path):
                     self._failed = True
                     return None
-            try:
-                lib = ctypes.CDLL(self._lib_path)
-            except OSError:
-                self._failed = True
-                return None
-            self._configure(lib)
+            for attempt in (0, 1):
+                try:
+                    lib = ctypes.CDLL(self._lib_path)
+                    self._configure(lib)
+                    break
+                except OSError:
+                    self._failed = True
+                    return None
+                except AttributeError:
+                    # A prebuilt .so missing a newly added export even
+                    # though mtimes looked fresh (copied binary, touch,
+                    # clock skew).  One rebuild usually fixes it; if
+                    # the toolchain is absent (or the symbol name is
+                    # simply wrong in configure), warn and degrade to
+                    # the Python fallback instead of crashing callers.
+                    if attempt == 0 and self._build():
+                        continue
+                    import warnings
+
+                    warnings.warn(
+                        f"{self._lib_path}: native symbol configuration "
+                        "failed after rebuild attempt; using the Python "
+                        "fallback paths"
+                    )
+                    self._failed = True
+                    return None
             self._lib = lib
             return self._lib
 

@@ -149,4 +149,28 @@ char* dns_emit(
   return to_heap(out, out_len);
 }
 
+// word_counts file ("ip,word,count" one line per aggregated pair,
+// formats.write_word_counts layout): built as one buffer from the
+// interned string tables + the featurizer's aggregated id arrays.
+// stage_pre previously materialized ~1.5M Python (str,str,int) tuples
+// and wrote one line at a time — half the pre stage's wall-clock on a
+// 2M-event day.
+char* wc_emit(
+    const char* ip_blob, const int64_t* ip_off,
+    const char* word_blob, const int64_t* word_off,
+    const int32_t* wc_ip, const int32_t* wc_word, const int64_t* wc_count,
+    int64_t n, int64_t* out_len) {
+  std::string out;
+  out.reserve((size_t)n * 48);
+  for (int64_t i = 0; i < n; i++) {
+    out.append(seg(ip_blob, ip_off, wc_ip[i]));
+    out += ',';
+    out.append(seg(word_blob, word_off, wc_word[i]));
+    out += ',';
+    append_i64(out, wc_count[i]);
+    out += '\n';
+  }
+  return to_heap(out, out_len);
+}
+
 }  // extern "C"

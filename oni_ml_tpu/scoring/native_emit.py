@@ -27,6 +27,12 @@ _F64P = ctypes.POINTER(ctypes.c_double)
 
 def _configure(lib: ctypes.CDLL) -> None:
     lib.emit_free.argtypes = [ctypes.c_void_p]
+    lib.wc_emit.restype = ctypes.c_void_p
+    lib.wc_emit.argtypes = (
+        [ctypes.c_char_p, _I64P] * 2
+        + [_I32P, _I32P, _I64P]
+        + [ctypes.c_int64, _I64P]
+    )
     lib.flow_emit.restype = ctypes.c_void_p
     lib.flow_emit.argtypes = (
         [ctypes.c_char_p, _I64P] * 3
@@ -134,6 +140,33 @@ def flow_emit(features, src_scores, dest_scores, order) -> bytes | None:
         _i64p(holds[11]),
         _f64p(holds[12]), _f64p(holds[13]),
         _i64p(holds[14]), len(holds[14]), ctypes.byref(out_len),
+    )
+    return _collect(lib, ptr, out_len)
+
+
+def word_counts_emit(features) -> bytes | None:
+    """The `ip,word,count` word_counts file as one buffer, straight
+    from a native container's interned tables + aggregated id arrays
+    (NativeFlowFeatures / NativeDnsFeatures both carry wc_ip / wc_word
+    / wc_count).  None when the native library is unavailable; output
+    bit-identical to formats.write_word_counts over .word_counts()."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    ip_blob, ip_off = _table_blob(features.ip_table)
+    word_blob, word_off = _table_blob(features.word_table)
+    holds = [
+        ip_off, word_off,
+        np.ascontiguousarray(features.wc_ip, np.int32),
+        np.ascontiguousarray(features.wc_word, np.int32),
+        np.ascontiguousarray(features.wc_count, np.int64),
+    ]
+    out_len = ctypes.c_int64(0)
+    ptr = lib.wc_emit(
+        ip_blob, _i64p(holds[0]),
+        word_blob, _i64p(holds[1]),
+        _i32p(holds[2]), _i32p(holds[3]), _i64p(holds[4]),
+        len(holds[2]), ctypes.byref(out_len),
     )
     return _collect(lib, ptr, out_len)
 

@@ -42,6 +42,44 @@ def test_bench_em_compact_smoke():
     assert em["unique_words"] <= em["compact_width"] < 4096
 
 
+def test_bench_flow_day_matches_schema(tmp_path):
+    """The synthetic flow day must align with FLOW_COLUMNS — an earlier
+    version carried an extra leading column, so the featurizer read
+    sip='0.0' and a dip string as the port for EVERY row, collapsing
+    the benched vocabulary to one port bucket."""
+    import io
+
+    import bench
+    from oni_ml_tpu.features.flow import FLOW_COLUMNS, NUM_FLOW_COLUMNS
+    from oni_ml_tpu.features.native_flow import featurize_flow_file
+
+    buf = io.StringIO()
+    bench._write_flow_day(buf, 500, n_src=50, n_dst=20)
+    lines = buf.getvalue().strip().splitlines()
+    assert len(lines) == 500
+    cols = lines[0].split(",")
+    assert len(cols) == NUM_FLOW_COLUMNS
+    assert cols[FLOW_COLUMNS["sip"]].startswith("10.0.")
+    assert cols[FLOW_COLUMNS["dip"]].startswith("10.1.")
+    assert int(cols[FLOW_COLUMNS["dport"]]) in (80, 443, 22, 53, 8080, 25)
+    assert 0 <= int(cols[FLOW_COLUMNS["hour"]]) < 24
+    p = tmp_path / "day.csv"
+    p.write_text(buf.getvalue())
+    feats = featurize_flow_file(str(p))
+    if not hasattr(feats, "ip_table"):     # pure-Python fallback (no g++)
+        import pytest
+
+        pytest.skip("native featurizer unavailable")
+    ips = set(feats.ip_table)
+    assert "0.0" not in ips
+    # Both endpoints present as documents; multiple port buckets.
+    assert any(ip.startswith("10.0.") for ip in ips)
+    assert any(ip.startswith("10.1.") for ip in ips)
+    words = {feats.word_table[w] for w in feats.sw_id[:feats.num_raw_events]}
+    ports = {w.split("_")[0] for w in words}
+    assert len(ports) > 1 and "111111.0" not in ports
+
+
 def test_bench_dns_scoring_smoke():
     import bench
 

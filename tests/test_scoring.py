@@ -270,3 +270,65 @@ def test_odd_key_inline_predicate_in_sync():
     assert list(got) == list(range(len(cases)))
     assert _lut_rows(lut, ["missing", "y\x00"], fallback_row=-1).tolist() \
         == [-1, -1]
+
+
+def _wc_parity(feats, tmp_path):
+    from oni_ml_tpu.io import formats
+    from oni_ml_tpu.scoring import native_emit
+
+    blob = native_emit.word_counts_emit(feats)
+    if blob is None:  # no toolchain: nothing to compare
+        return
+    path = tmp_path / "wc.dat"
+    formats.write_word_counts(str(path), feats.word_counts())
+    assert blob == path.read_bytes()
+
+
+def test_native_word_counts_emit_flow(tmp_path):
+    """wc_emit parity: the C++ word_counts buffer is byte-identical to
+    formats.write_word_counts over the container's Python triples.
+    The day comes from bench._write_flow_day (schema-correct since the
+    round-3 column-shift fix), so the parity runs over realistic
+    multi-word/multi-ip tables, not a degenerate single-source day."""
+    import bench
+    from oni_ml_tpu.features.native_flow import featurize_flow_file
+
+    p = tmp_path / "day.csv"
+    with open(p, "w") as f:
+        bench._write_flow_day(f, 400, n_src=40, n_dst=20)
+    _wc_parity(featurize_flow_file(str(p)), tmp_path)
+
+
+def test_native_word_counts_emit_dns(tmp_path):
+    import numpy as np
+
+    from oni_ml_tpu.features.native_dns import featurize_dns_sources
+
+    rng = np.random.default_rng(6)
+    rows = [
+        ["t", str(1454000000 + i), str(int(rng.integers(40, 1500))),
+         f"10.0.{i % 7}.{i % 11}", f"s{i % 9}.dom{i % 13}.com", "1",
+         str(int(rng.integers(1, 17))), str(int(rng.integers(0, 4)))]
+        for i in range(500)
+    ]
+    _wc_parity(featurize_dns_sources([rows]), tmp_path)
+
+
+def test_native_lib_missing_symbol_degrades(tmp_path):
+    """A prebuilt .so predating a newly added export (no compiler to
+    rebuild) must degrade to the Python fallback (load() -> None), not
+    crash the caller with AttributeError at symbol-configure time."""
+    from oni_ml_tpu.native_build import NativeLib
+
+    src = tmp_path / "t.cpp"
+    src.write_text('extern "C" int foo() { return 1; }\n')
+
+    def configure(lib):
+        lib.no_such_symbol.restype = None   # AttributeError on lookup
+
+    import pytest
+
+    nl = NativeLib(str(src), str(tmp_path / "t.so"), configure)
+    with pytest.warns(UserWarning, match="native symbol configuration"):
+        assert nl.load() is None
+    assert not nl.available()
