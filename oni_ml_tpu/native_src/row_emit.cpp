@@ -149,6 +149,30 @@ char* dns_emit(
   return to_heap(out, out_len);
 }
 
+// Fused gather-dot for event scoring: out[i] = <theta[ip_idx[i]],
+// p[w_idx[i]]> in float64, accumulated k=0..K-1 in index order —
+// bit-identical to the numpy einsum path (same IEEE add order).  The
+// numpy path materializes two [N, K] float64 gather temporaries
+// (~1.6 GB at a 5M-event day) before the dot; this reads the two rows
+// and writes one double per event.  flow_post_lda.scala:227-239's
+// per-event Map lookup + dot, minus the lookups (ids are pre-resolved
+// against the interned tables by score.py's O(unique) LUT).
+// No FMA fusion (both build paths pass -ffp-contract=off globally):
+// a fused multiply-add rounds once where numpy rounds twice, and the
+// golden scoring bytes (str(score)) must not move.
+void score_dot(
+    const double* theta, const double* p, int64_t k,
+    const int32_t* ip_idx, const int32_t* w_idx, int64_t n,
+    double* out) {
+  for (int64_t i = 0; i < n; i++) {
+    const double* a = theta + (int64_t)ip_idx[i] * k;
+    const double* b = p + (int64_t)w_idx[i] * k;
+    double s = 0.0;
+    for (int64_t j = 0; j < k; j++) s += a[j] * b[j];
+    out[i] = s;
+  }
+}
+
 // word_counts file ("ip,word,count" one line per aggregated pair,
 // formats.write_word_counts layout): built as one buffer from the
 // interned string tables + the featurizer's aggregated id arrays.

@@ -27,6 +27,11 @@ _F64P = ctypes.POINTER(ctypes.c_double)
 
 def _configure(lib: ctypes.CDLL) -> None:
     lib.emit_free.argtypes = [ctypes.c_void_p]
+    lib.score_dot.restype = None
+    lib.score_dot.argtypes = [
+        _F64P, _F64P, ctypes.c_int64,
+        _I32P, _I32P, ctypes.c_int64, _F64P,
+    ]
     lib.wc_emit.restype = ctypes.c_void_p
     lib.wc_emit.argtypes = (
         [ctypes.c_char_p, _I64P] * 2
@@ -142,6 +147,36 @@ def flow_emit(features, src_scores, dest_scores, order) -> bytes | None:
         _i64p(holds[14]), len(holds[14]), ctypes.byref(out_len),
     )
     return _collect(lib, ptr, out_len)
+
+
+def score_dot(theta, p, ip_idx, word_idx) -> "np.ndarray | None":
+    """out[i] = <theta[ip_idx[i]], p[word_idx[i]]> in float64, k-order
+    accumulation — bit-identical to the numpy einsum path (fp-contract
+    pinned off in the C).  None when the native library is
+    unavailable."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    theta = np.ascontiguousarray(theta, np.float64)
+    p = np.ascontiguousarray(p, np.float64)
+    if theta.shape[1] != p.shape[1]:
+        raise ValueError(f"K mismatch: theta {theta.shape} vs p {p.shape}")
+    ip_idx = np.ascontiguousarray(ip_idx, np.int32)
+    word_idx = np.ascontiguousarray(word_idx, np.int32)
+    if len(ip_idx) != len(word_idx):
+        # The numpy path raised a broadcast error here; the C loop
+        # would read past the shorter buffer.
+        raise ValueError(
+            f"index length mismatch: {len(ip_idx)} ips vs "
+            f"{len(word_idx)} words"
+        )
+    out = np.empty(len(ip_idx), np.float64)
+    lib.score_dot(
+        _f64p(theta), _f64p(p), theta.shape[1],
+        _i32p(ip_idx), _i32p(word_idx), len(ip_idx),
+        out.ctypes.data_as(_F64P),
+    )
+    return out
 
 
 def word_counts_emit(features) -> bytes | None:

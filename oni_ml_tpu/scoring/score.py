@@ -186,14 +186,31 @@ def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20)
     n = len(ip_idx)
     theta = np.asarray(model.theta, np.float64)
     p = np.asarray(model.p, np.float64)
+    from . import native_emit
+
+    got = native_emit.score_dot(theta, p, ip_idx, word_idx)
+    if got is not None:
+        # Fused C gather-dot: no [N, K] gather temporaries (numpy
+        # materializes ~1.6 GB of them on a 5M-event day — the gathers,
+        # not the einsum, were 90% of the stage).  Bit-identical
+        # accumulation order; parity pinned by the golden emit tests
+        # and test_score_dot_native_matches_numpy.
+        return got
     out = np.empty(n, dtype=np.float64)
+    k = theta.shape[1]
     for lo in range(0, n, batch):
         hi = min(lo + batch, n)
-        out[lo:hi] = np.einsum(
-            "ik,ik->i",
-            theta[np.asarray(ip_idx[lo:hi], np.int32)],
-            p[np.asarray(word_idx[lo:hi], np.int32)],
-        )
+        a = theta[np.asarray(ip_idx[lo:hi], np.int32)]
+        b = p[np.asarray(word_idx[lo:hi], np.int32)]
+        # Sequential k-order accumulation — bit-identical to the C
+        # fast path above AND to the reference's per-event fold
+        # (flow_post_lda.scala:231: zip/map/sum over the k pairs).
+        # np.einsum uses SIMD partial sums whose add order differs in
+        # the last ulp, which moves str(score) bytes in the scored CSV.
+        acc = a[:, 0] * b[:, 0]
+        for j in range(1, k):
+            acc = acc + a[:, j] * b[:, j]
+        out[lo:hi] = acc
     return out
 
 
