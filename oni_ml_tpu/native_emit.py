@@ -1,15 +1,18 @@
-"""ctypes binding for the native scored-CSV emitter
-(oni_ml_tpu/native_src/row_emit.cpp).
+"""ctypes binding for the native emit/score library
+(oni_ml_tpu/native_src/row_emit.cpp) — package-level because it serves
+three layers: the pre stage's word_counts buffer (runner), the corpus
+stage's model.dat buffer (io.formats), and the score stage's scored-CSV
+assembly + fused gather-dot (scoring).
 
-Row assembly dominates the score stage (>90% on a 400k-event day);
-this builds the whole output buffer in C++ from the arena blobs and
-numeric columns the Native*Features containers already hold.  Output is
-bit-identical to the Python emit loop (pinned by
-tests/test_scoring.py's emit-parity tests and the golden fixture).
+Each emitter builds its whole output buffer in C++ from the arena
+blobs / numeric columns / CSR arrays the callers already hold, and each
+is byte-identical to its Python fallback loop (pinned by the parity
+tests in tests/test_scoring.py and tests/test_formats.py, plus the
+golden fixture).
 
-Only native-backed feature containers qualify — the pure-Python
-DnsFeatures/FlowFeatures keep rows as lists and take the Python loop.
-"""
+The row emitters qualify only for native-backed feature containers —
+the pure-Python DnsFeatures/FlowFeatures keep rows as lists and take
+the Python loop."""
 
 from __future__ import annotations
 
@@ -18,7 +21,7 @@ import os
 
 import numpy as np
 
-from ..native_build import NativeLib
+from .native_build import NativeLib
 
 _I32P = ctypes.POINTER(ctypes.c_int32)
 _I64P = ctypes.POINTER(ctypes.c_int64)
@@ -31,6 +34,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.score_dot.argtypes = [
         _F64P, _F64P, ctypes.c_int64,
         _I32P, _I32P, ctypes.c_int64, _F64P,
+    ]
+    lib.model_emit.restype = ctypes.c_void_p
+    lib.model_emit.argtypes = [
+        _I64P, ctypes.c_int64, _I32P, _I64P, _I64P,
     ]
     lib.wc_emit.restype = ctypes.c_void_p
     lib.wc_emit.argtypes = (
@@ -57,13 +64,13 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 _LIB = NativeLib(
     os.path.join(
-        os.path.dirname(__file__), "..", "native_src", "row_emit.cpp"
+        os.path.dirname(__file__), "native_src", "row_emit.cpp"
     ),
     os.path.join(os.path.dirname(__file__), "_native", "liboni_emit.so"),
     _configure,
     deps=(
         os.path.join(
-            os.path.dirname(__file__), "..", "native_src", "common.h"
+            os.path.dirname(__file__), "native_src", "common.h"
         ),
     ),
 )
@@ -170,6 +177,18 @@ def score_dot(theta, p, ip_idx, word_idx) -> "np.ndarray | None":
             f"index length mismatch: {len(ip_idx)} ips vs "
             f"{len(word_idx)} words"
         )
+    # Range check: the C loop would silently dot whatever memory an
+    # out-of-range id points at.  Negative ids raise too — numpy
+    # fancy indexing would WRAP them (usually into the fallback row,
+    # masking a caller bug), so _batched_scores' fallback applies the
+    # same check to keep the two engines behavior-identical.
+    # (In-repo callers always come through the fallback-row LUT,
+    # which never produces these.)
+    if len(ip_idx) and (
+        int(ip_idx.min()) < 0 or int(ip_idx.max()) >= theta.shape[0]
+        or int(word_idx.min()) < 0 or int(word_idx.max()) >= p.shape[0]
+    ):
+        raise IndexError("model-row index out of range")
     out = np.empty(len(ip_idx), np.float64)
     lib.score_dot(
         _f64p(theta), _f64p(p), theta.shape[1],
@@ -177,6 +196,39 @@ def score_dot(theta, p, ip_idx, word_idx) -> "np.ndarray | None":
         out.ctypes.data_as(_F64P),
     )
     return out
+
+
+def model_emit(doc_ptr, word_idx, counts) -> bytes | None:
+    """The LDA-C model.dat buffer ("N w:c ..." per doc) from CSR arrays
+    — byte-identical to formats.write_model_dat's line loop.  None when
+    the native library is unavailable."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    holds = [
+        np.ascontiguousarray(doc_ptr, np.int64),
+        np.ascontiguousarray(word_idx, np.int32),
+        np.ascontiguousarray(counts, np.int64),
+    ]
+    ptr = holds[0]
+    n_docs = len(ptr) - 1
+    if n_docs <= 0:
+        return b""                        # empty corpus: empty file
+    # The C loop trusts doc_ptr as in-bounds slice offsets — enforce
+    # what the Python fallback got for free from numpy indexing.
+    if (
+        len(holds[1]) != len(holds[2])
+        or ptr[0] != 0
+        or np.any(np.diff(ptr) < 0)
+        or int(ptr[-1]) > len(holds[1])
+    ):
+        raise ValueError("CSR arrays inconsistent with doc_ptr")
+    out_len = ctypes.c_int64(0)
+    ptr = lib.model_emit(
+        _i64p(holds[0]), n_docs, _i32p(holds[1]), _i64p(holds[2]),
+        ctypes.byref(out_len),
+    )
+    return _collect(lib, ptr, out_len)
 
 
 def word_counts_emit(features) -> bytes | None:

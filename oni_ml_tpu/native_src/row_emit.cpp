@@ -173,6 +173,30 @@ void score_dot(
   }
 }
 
+// model.dat (LDA-C corpus): "N w1:c1 ... wN:cN" per document from the
+// CSR arrays (formats.write_model_dat layout, lda_pre.py:84-94).  The
+// Python writer built ~9.4M "w:c" fragments through a list — 9 s of a
+// 5M-event day's corpus stage.
+char* model_emit(
+    const int64_t* doc_ptr, int64_t n_docs,
+    const int32_t* word_idx, const int64_t* counts,
+    int64_t* out_len) {
+  std::string out;
+  out.reserve((size_t)(n_docs ? doc_ptr[n_docs] : 0) * 12 + n_docs * 8);
+  for (int64_t d = 0; d < n_docs; d++) {
+    int64_t lo = doc_ptr[d], hi = doc_ptr[d + 1];
+    append_i64(out, hi - lo);
+    for (int64_t j = lo; j < hi; j++) {
+      out += ' ';
+      append_i64(out, word_idx[j]);
+      out += ':';
+      append_i64(out, counts[j]);
+    }
+    out += '\n';
+  }
+  return to_heap(out, out_len);
+}
+
 // word_counts file ("ip,word,count" one line per aggregated pair,
 // formats.write_word_counts layout): built as one buffer from the
 // interned string tables + the featurizer's aggregated id arrays.

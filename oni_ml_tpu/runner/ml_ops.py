@@ -252,7 +252,7 @@ def stage_pre(ctx: RunContext) -> dict:
     # (pinned by tests/test_scoring.py::test_native_word_counts_emit_*).
     n_wc = None
     if hasattr(features, "wc_ip"):
-        from ..scoring.native_emit import word_counts_emit
+        from ..native_emit import word_counts_emit
 
         blob = word_counts_emit(features)
         if blob is not None:

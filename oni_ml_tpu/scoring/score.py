@@ -186,7 +186,7 @@ def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20)
     n = len(ip_idx)
     theta = np.asarray(model.theta, np.float64)
     p = np.asarray(model.p, np.float64)
-    from . import native_emit
+    from .. import native_emit
 
     got = native_emit.score_dot(theta, p, ip_idx, word_idx)
     if got is not None:
@@ -196,6 +196,16 @@ def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20)
         # accumulation order; parity pinned by the golden emit tests
         # and test_score_dot_native_matches_numpy.
         return got
+    # Same range check the native path applies (native_emit.score_dot):
+    # numpy would silently WRAP negative ids — usually into the
+    # fallback row, masking a caller bug — so both engines raise.
+    ip_arr = np.asarray(ip_idx)
+    w_arr = np.asarray(word_idx)
+    if n and (
+        int(ip_arr.min()) < 0 or int(ip_arr.max()) >= theta.shape[0]
+        or int(w_arr.min()) < 0 or int(w_arr.max()) >= p.shape[0]
+    ):
+        raise IndexError("model-row index out of range")
     out = np.empty(n, dtype=np.float64)
     k = theta.shape[1]
     for lo in range(0, n, batch):
@@ -252,7 +262,7 @@ def _flow_scored(features, model: ScoringModel, threshold: float):
     order = _keep_order(min_scores, threshold)
     blob = rows = None
     if hasattr(features, "sip_id"):
-        from . import native_emit
+        from .. import native_emit
 
         blob = native_emit.flow_emit(features, src_scores, dest_scores, order)
     if blob is None:
@@ -321,7 +331,7 @@ def _dns_scored(features, model: ScoringModel, threshold: float):
     order = _keep_order(scores, threshold)
     blob = rows = None
     if hasattr(features, "word_id"):
-        from . import native_emit
+        from .. import native_emit
 
         blob = native_emit.dns_emit(features, scores, order)
     if blob is None:

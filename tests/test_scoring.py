@@ -119,7 +119,8 @@ def _weird_flow_day(tmp_path, n=400):
 
 
 def test_native_flow_emit_matches_python_bytes(tmp_path):
-    from oni_ml_tpu.scoring import native_emit, score_flow_csv
+    from oni_ml_tpu import native_emit
+    from oni_ml_tpu.scoring import score_flow_csv
     from oni_ml_tpu.scoring.score import _batched_scores, _keep_order
 
     if not native_emit.available():
@@ -154,7 +155,8 @@ def test_native_flow_emit_matches_python_bytes(tmp_path):
 
 def test_native_dns_emit_matches_python_bytes():
     from oni_ml_tpu.features import native_dns
-    from oni_ml_tpu.scoring import native_emit, score_dns_csv
+    from oni_ml_tpu import native_emit
+    from oni_ml_tpu.scoring import score_dns_csv
     from oni_ml_tpu.scoring.score import _batched_scores, _keep_order
 
     if not (native_emit.available() and native_dns.available()):
@@ -274,7 +276,7 @@ def test_odd_key_inline_predicate_in_sync():
 
 def _wc_parity(feats, tmp_path):
     from oni_ml_tpu.io import formats
-    from oni_ml_tpu.scoring import native_emit
+    from oni_ml_tpu import native_emit
 
     blob = native_emit.word_counts_emit(feats)
     if blob is None:  # no toolchain: nothing to compare
@@ -346,7 +348,7 @@ def test_score_dot_native_matches_numpy():
     """The C gather-dot must be BIT-identical to the einsum path (same
     k-order accumulation, fp-contract off): scored CSVs embed
     str(score), so even one ulp moves golden bytes."""
-    from oni_ml_tpu.scoring import native_emit
+    from oni_ml_tpu import native_emit
 
     if not native_emit.available():
         import pytest
@@ -367,3 +369,38 @@ def test_score_dot_native_matches_numpy():
         got = native_emit.score_dot(theta, p, ia, ib)
         assert got.dtype == np.float64
         assert np.array_equal(got, want)   # bitwise, not allclose
+
+
+def test_batched_scores_rejects_out_of_range_ids_both_engines():
+    """Both scoring engines must RAISE on out-of-range/negative model
+    rows — numpy fancy indexing would silently wrap -1 into the
+    fallback row, masking a caller bug, and the C loop would read
+    arbitrary memory."""
+    import pytest
+
+    import oni_ml_tpu.native_emit as ne
+    from oni_ml_tpu.scoring.score import _batched_scores
+
+    class M:
+        theta = np.ones((4, 3))
+        p = np.ones((5, 3))
+
+    bad = [
+        (np.array([-1, 0], np.int32), np.array([0, 1], np.int32)),
+        (np.array([0, 4], np.int32), np.array([0, 1], np.int32)),
+        (np.array([0, 1], np.int32), np.array([0, 5], np.int32)),
+    ]
+    real = ne.score_dot
+    for engines in ("native", "fallback"):
+        if engines == "fallback":
+            ne.score_dot = lambda *a, **k: None
+        try:
+            for ia, ib in bad:
+                with pytest.raises(IndexError):
+                    _batched_scores(M(), ia, ib)
+            ok = _batched_scores(
+                M(), np.array([0, 3], np.int32), np.array([4, 0], np.int32)
+            )
+            assert np.allclose(ok, 3.0)
+        finally:
+            ne.score_dot = real
