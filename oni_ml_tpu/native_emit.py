@@ -168,8 +168,8 @@ def score_dot(theta, p, ip_idx, word_idx) -> "np.ndarray | None":
     p = np.ascontiguousarray(p, np.float64)
     if theta.shape[1] != p.shape[1]:
         raise ValueError(f"K mismatch: theta {theta.shape} vs p {p.shape}")
-    ip_idx = np.ascontiguousarray(ip_idx, np.int32)
-    word_idx = np.ascontiguousarray(word_idx, np.int32)
+    ip_idx = np.asarray(ip_idx)
+    word_idx = np.asarray(word_idx)
     if len(ip_idx) != len(word_idx):
         # The numpy path raised a broadcast error here; the C loop
         # would read past the shorter buffer.
@@ -177,18 +177,21 @@ def score_dot(theta, p, ip_idx, word_idx) -> "np.ndarray | None":
             f"index length mismatch: {len(ip_idx)} ips vs "
             f"{len(word_idx)} words"
         )
-    # Range check: the C loop would silently dot whatever memory an
-    # out-of-range id points at.  Negative ids raise too — numpy
-    # fancy indexing would WRAP them (usually into the fallback row,
-    # masking a caller bug), so _batched_scores' fallback applies the
-    # same check to keep the two engines behavior-identical.
-    # (In-repo callers always come through the fallback-row LUT,
-    # which never produces these.)
+    # Range check BEFORE the int32 cast (an int64 id of 2**32 would
+    # wrap to 0 and silently score row 0): the C loop would otherwise
+    # dot whatever memory an out-of-range id points at.  Negative ids
+    # raise too — numpy fancy indexing would WRAP them (usually into
+    # the fallback row, masking a caller bug), so _batched_scores'
+    # fallback applies the same pre-cast check to keep the two engines
+    # behavior-identical.  (In-repo callers always come through the
+    # fallback-row LUT, which never produces these.)
     if len(ip_idx) and (
         int(ip_idx.min()) < 0 or int(ip_idx.max()) >= theta.shape[0]
         or int(word_idx.min()) < 0 or int(word_idx.max()) >= p.shape[0]
     ):
         raise IndexError("model-row index out of range")
+    ip_idx = np.ascontiguousarray(ip_idx, np.int32)
+    word_idx = np.ascontiguousarray(word_idx, np.int32)
     out = np.empty(len(ip_idx), np.float64)
     lib.score_dot(
         _f64p(theta), _f64p(p), theta.shape[1],

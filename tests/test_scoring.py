@@ -404,3 +404,21 @@ def test_batched_scores_rejects_out_of_range_ids_both_engines():
             assert np.allclose(ok, 3.0)
         finally:
             ne.score_dot = real
+
+
+def test_score_dot_rejects_pre_cast_overflow_ids():
+    """Range validation must run BEFORE the int32 cast: an int64 id of
+    2**32 wraps to 0 post-cast and would silently score row 0."""
+    import pytest
+
+    from oni_ml_tpu import native_emit
+
+    if not native_emit.available():
+        pytest.skip("native lib unavailable")
+    theta = np.ones((4, 3))
+    p = np.ones((5, 3))
+    with pytest.raises(IndexError):
+        native_emit.score_dot(
+            theta, p,
+            np.array([2 ** 32, 0], np.int64), np.array([0, 1], np.int64),
+        )

@@ -1,0 +1,114 @@
+"""On-chip profiling probes for the fused-EM iteration — run these the
+next time a healthy TPU grant is attached (they were authored in round 3
+while the session's device tunnel was down, so the numbers they produce
+are the first thing round 4 should capture).
+
+    python tools/tpu_probes.py [cap_sweep] [alpha_ab] [chunk_sweep]
+
+(no args = all three).  Each probe prints one JSON line per
+measurement.  What they answer:
+
+cap_sweep — fixed-cost decomposition of one EM iteration.  docs/s at
+  forced var_max_iters caps, warm start OFF so the cap is the actual
+  trip count; regressing t_iter on the cap gives slope = per-VI-
+  iteration cost and intercept = the fixed per-EM-iteration cost (XLA
+  glue + corpus streaming + tail pass).  Round 3's driver-parity bench
+  measured 3.13 ms/iter at mean_vi 5.37 against a ~0.9 ms historical
+  glue estimate — the intercept says where the next headline factor
+  must come from.
+
+alpha_ab — attribute the alpha-Newton update's cost.  estimate_alpha
+  runs an up-to-100-trip SCALAR Newton while_loop (digamma/trigamma
+  per trip) inside every EM iteration — the TPU's worst-case shape.
+  If the A/B shows it material, the candidate fix is a fixed-depth
+  fori_loop(8) from the warm previous alpha (quadratic convergence
+  makes 8 plenty mid-run), which also removes a dynamic trip count.
+
+chunk_sweep — host-dispatch amortization.  Round-2 data said 8->32
+  chunk doubled throughput and 32->64 was flat; re-check at the
+  current (much faster) iteration time, where the same absolute
+  dispatch overhead is a LARGER fraction of each iteration.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+))
+
+K, V, B, L = 20, 8192, 4096, 128          # headline shape (config 1)
+
+
+def cap_sweep():
+    import bench
+
+    for cap in (1, 3, 6, 12, 20):
+        em = bench.bench_em(K, V, B, L, rounds=3, var_max_iters=cap,
+                            warm_start=False, precision="bf16")
+        print(json.dumps({
+            "probe": "cap_sweep", "cap": cap,
+            "t_iter_ms": round(em["t_iter"] * 1e3, 3),
+            "mean_vi": round(em["mean_vi"], 2),
+            "docs_per_sec": round(em["docs_per_sec"]),
+        }), flush=True)
+
+
+def alpha_ab():
+    import bench
+    from oni_ml_tpu.models import fused
+
+    orig = fused.make_chunk_runner
+
+    def no_alpha(**kw):
+        kw["estimate_alpha"] = False
+        return orig(**kw)
+
+    try:
+        for label, maker in (("newton", orig), ("fixed", no_alpha)):
+            fused.make_chunk_runner = maker
+            em = bench.bench_em(K, V, B, L, rounds=3, warm_start=True,
+                                precision="bf16")
+            print(json.dumps({
+                "probe": "alpha_ab", "alpha": label,
+                "t_iter_ms": round(em["t_iter"] * 1e3, 3),
+                "docs_per_sec": round(em["docs_per_sec"]),
+            }), flush=True)
+    finally:
+        fused.make_chunk_runner = orig
+
+
+def chunk_sweep():
+    import bench
+
+    for chunk in (16, 32, 64, 128):
+        em = bench.bench_em(K, V, B, L, chunk=chunk, rounds=3,
+                            warm_start=True, precision="bf16")
+        print(json.dumps({
+            "probe": "chunk_sweep", "chunk": chunk,
+            "t_iter_ms": round(em["t_iter"] * 1e3, 3),
+            "docs_per_sec": round(em["docs_per_sec"]),
+        }), flush=True)
+
+
+def main() -> int:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print("tpu_probes: backend is not TPU — these probes measure "
+              "device behavior; run on the chip host", file=sys.stderr)
+        return 2
+    which = sys.argv[1:] or ["cap_sweep", "alpha_ab", "chunk_sweep"]
+    for name in which:
+        fn = globals().get(name)
+        if fn is None:
+            print(f"tpu_probes: unknown probe {name!r}", file=sys.stderr)
+            return 2
+        fn()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
