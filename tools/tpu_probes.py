@@ -31,13 +31,10 @@ chunk_sweep — host-dispatch amortization.  Round-2 data said 8->32
 """
 
 import json
+import os
 import sys
 
-import numpy as np
-
-sys.path.insert(0, __import__("os").path.dirname(
-    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
-))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 K, V, B, L = 20, 8192, 4096, 128          # headline shape (config 1)
 
