@@ -318,8 +318,12 @@ def _featurize_native(
         tstamp = _copy(lib.dfz_tstamp(h), n, np.float64)
         frame_len = _copy(lib.dfz_frame_len(h), n, np.float64)
         entropy = _copy(lib.dfz_entropy(h), n, np.float64)
-        sub_len = _copy(lib.dfz_sublen(h), n, np.int64)
-        n_parts = _copy(lib.dfz_nparts(h), n, np.int64)
+        # int32 — matching the C featurizer's own storage, so a
+        # hostile >32767-char subdomain cannot wrap here while the C
+        # binner sees the true value (the emit binding widens to int64
+        # at call time; int64 storage was pure pickle bloat).
+        sub_len = _copy(lib.dfz_sublen(h), n, np.int32)
+        n_parts = _copy(lib.dfz_nparts(h), n, np.int32)
 
         time_cuts = ecdf_cuts(tstamp, DECILES)
         frame_length_cuts = ecdf_cuts(frame_len, DECILES)
@@ -374,10 +378,10 @@ def _featurize_native(
             subdomain_length=sub_len,
             num_periods=n_parts,
             subdomain_entropy=entropy,
-            top_domain=_copy(lib.dfz_top(h), n, np.int64),
+            top_domain=_copy(lib.dfz_top(h), n, np.int16),   # {0,1,2}
             wc_ip=_copy(lib.dfz_wc_ip(h), nwc, np.int32),
             wc_word=_copy(lib.dfz_wc_word(h), nwc, np.int32),
-            wc_count=_copy(lib.dfz_wc_count(h), nwc, np.int64),
+            wc_count=_copy(lib.dfz_wc_count(h), nwc, np.int32),
             num_raw_events=int(lib.dfz_num_raw(h)),
             time_cuts=time_cuts,
             frame_length_cuts=frame_length_cuts,

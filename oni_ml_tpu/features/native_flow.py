@@ -374,12 +374,16 @@ def _featurize_native(
             sw_id=_copy(lib.ffz_ids(h, 3), n, np.int32),
             dw_id=_copy(lib.ffz_ids(h, 4), n, np.int32),
             num_time=num_time,
-            ibyt_bin=_copy(lib.ffz_bins(h, 1), n, np.int64),
-            ipkt_bin=_copy(lib.ffz_bins(h, 2), n, np.int64),
-            time_bin=_copy(lib.ffz_bins(h, 0), n, np.int64),
+            # Bin values are 0-10: int16 storage shrinks features.pkl
+            # by ~90 MB on a 5M-event day (native_emit widens back to
+            # the C emitters' int64 at call time).
+            ibyt_bin=_copy(lib.ffz_bins(h, 1), n, np.int16),
+            ipkt_bin=_copy(lib.ffz_bins(h, 2), n, np.int16),
+            time_bin=_copy(lib.ffz_bins(h, 0), n, np.int16),
             wc_ip=_copy(lib.ffz_wc_ip(h), nwc, np.int32),
             wc_word=_copy(lib.ffz_wc_word(h), nwc, np.int32),
-            wc_count=_copy(lib.ffz_wc_count(h), nwc, np.int64),
+            wc_count=_copy(lib.ffz_wc_count(h), nwc,
+                           np.int32),   # day counts: < 2^31 always
             num_raw_events=int(lib.ffz_num_raw(h)),
             time_cuts=time_cuts,
             ibyt_cuts=ibyt_cuts,
