@@ -11,10 +11,13 @@ achieved TFLOP/s / GB/s against the chip peaks, so the number is
 auditable against the roofline instead of free-floating.
 
 Secondary metrics (carried as extra keys on the single JSON line the
-driver records): the opt-in warm-start engine, config-3 scale (K=50,
-V=50k — BASELINE.json config 3), streaming SVI steady state (config
-5), wall-clock to convergence (BASELINE.json's first named metric),
-and DNS scoring throughput/p50 (BASELINE.md names "DNS scoring p50").
+driver records): the reference-semantics fresh-start engine (warm
+start is the production default; the secondary keeps the delta
+attributable), wall-clock to convergence (BASELINE.json's first named
+metric), DNS + flow scoring throughput/p50, config-3 scale (K=50,
+V=50k), config-4 huge-V (V=512k, compact-vocab dense engine),
+streaming SVI steady state (config 5), and two full synthetic days
+end-to-end (the reference's actual unit of work).
 
 Wedge-proofing (round 2 lost its entire evidence to one transient
 unresponsive chip grant; round 3's first capture lost its last four
