@@ -926,13 +926,23 @@ def _run_phase_subprocess(name: str, timeout: float):
 def _run_phase(name: str, fn, timeout: float, inproc: bool):
     """Dispatch one phase: a fresh subprocess under a hard timeout (the
     production path), or in-process when BENCH_INPROC=1 (tests — their
-    monkeypatched bench_* stubs don't exist in a subprocess)."""
+    monkeypatched bench_* stubs don't exist in a subprocess).
+
+    Successful payloads gain `phase_wall_s` (compile + backend init +
+    measurement, i.e. the phase's cost to the round-end run) so the
+    recorded JSON shows where a slow or wedged run spent its time."""
+    t0 = time.perf_counter()
     if inproc:
         try:
-            return fn(), None
+            payload, err = fn(), None
         except Exception as exc:
-            return None, str(exc)[:300]
-    return _run_phase_subprocess(name, timeout)
+            payload, err = None, str(exc)[:300]
+    else:
+        payload, err = _run_phase_subprocess(name, timeout)
+    wall = round(time.perf_counter() - t0, 1)
+    if isinstance(payload, dict):
+        payload["phase_wall_s"] = wall
+    return payload, err, wall
 
 
 def run_phase(name: str) -> int:
@@ -990,11 +1000,12 @@ def main() -> int:
     head_name, head_fn, head_timeout, _ = PHASES[0]
     payload = None
     for attempt in range(3):
-        payload, err = _run_phase(head_name, head_fn, head_timeout, inproc)
+        payload, err, wall = _run_phase(head_name, head_fn, head_timeout,
+                                        inproc)
         if payload is not None:
             break
-        print(f"bench: headline attempt {attempt + 1} failed: {err}",
-              file=sys.stderr)
+        print(f"bench: headline attempt {attempt + 1} failed after "
+              f"{wall:.0f}s: {err}", file=sys.stderr)
         if attempt < 2 and not _backend_responsive(
             attempt_timeouts=(RECOVERY_PROBE,), backoffs=()
         ):
@@ -1014,6 +1025,7 @@ def main() -> int:
         engine=payload.get("engine"),
         utilization=payload.get("utilization", {}),
         mean_vi_iters=payload.get("mean_vi_iters"),
+        phase_wall_s=payload.get("phase_wall_s"),
         prev_round=_prev_round_headline(),
     )
 
@@ -1027,12 +1039,13 @@ def main() -> int:
                 name, {"error": "skipped: backend wedged earlier in run"}
             )
             continue
-        payload, err = _run_phase(name, fn, timeout, inproc)
+        payload, err, wall = _run_phase(name, fn, timeout, inproc)
         if payload is not None:
             record.add_secondary(name, payload)
             continue
-        print(f"bench: phase {name} failed: {err}", file=sys.stderr)
-        record.add_secondary(name, {"error": err})
+        print(f"bench: phase {name} failed after {wall:.0f}s: {err}",
+              file=sys.stderr)
+        record.add_secondary(name, {"error": err, "phase_wall_s": wall})
         # A timeout usually means the grant wedged mid-phase: one
         # gentle probe, one recovery wait, one more probe — then write
         # the backend off for the remaining device phases.

@@ -179,6 +179,13 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
     # prev_round must carry the latest prior driver-captured headline
     # (BENCH_r01.json in-repo: 483336 docs/s).
     assert rec["prev_round"] and rec["prev_round"]["value"] > 0
+    # Every phase carries its wall-clock so the record shows where a
+    # slow round-end run spent its time.
+    assert rec["phase_wall_s"] >= 0
+    assert all(
+        "error" in v or v.get("phase_wall_s", -1) >= 0
+        for v in rec["secondary"].values()
+    )
 
 
 def test_bench_main_headline_survives_secondary_failure(capsys, monkeypatch):
@@ -193,7 +200,8 @@ def test_bench_main_headline_survives_secondary_failure(capsys, monkeypatch):
     )
     assert bench.main() == 0
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert rec["secondary"]["lda_online_svi"] == {"error": "boom"}
+    svi = rec["secondary"]["lda_online_svi"]
+    assert svi["error"] == "boom" and svi["phase_wall_s"] >= 0
     assert rec["secondary"]["dns_scoring"]["value"] > 0
 
 
