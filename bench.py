@@ -1036,7 +1036,8 @@ def main() -> int:
             # init against a grant already proven dead; host-only
             # phases still run.
             record.add_secondary(
-                name, {"error": "skipped: backend wedged earlier in run"}
+                name, {"error": "skipped: backend wedged earlier in run",
+                       "phase_wall_s": 0.0}
             )
             continue
         payload, err, wall = _run_phase(name, fn, timeout, inproc)

@@ -28,6 +28,12 @@ class LDAConfig:
     num_topics: int = 20
     alpha_init: float = 2.5
     estimate_alpha: bool = True
+    # Cap on the per-M-step alpha-Newton while_loop (lda-c's
+    # MAX_ALPHA_ITER).  A scalar while_loop is the TPU's worst shape;
+    # warm-started mid-EM Newton converges in a handful of trips, so a
+    # small cap is a candidate throughput knob — measure with
+    # tools/tpu_probes.py alpha_ab before lowering.  Default = lda-c.
+    alpha_max_iters: int = 100
     em_max_iters: int = 100
     em_tol: float = 1e-4
     var_max_iters: int = 20

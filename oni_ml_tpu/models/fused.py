@@ -294,6 +294,7 @@ def make_chunk_runner(
     warm_start: bool = False,
     dense_e_step_fn: Callable | None = None,
     dense_precision: str = "f32",
+    alpha_max_iters: int = 100,
 ):
     """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
     n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
@@ -412,7 +413,8 @@ def make_chunk_runner(
             gammas.append(g)
         new_beta = m_fn(total_ss)
         new_alpha = (
-            update_alpha(total_ass, alpha, num_docs, k)
+            update_alpha(total_ass, alpha, num_docs, k,
+                         max_iters=alpha_max_iters)
             if estimate_alpha
             else alpha
         )

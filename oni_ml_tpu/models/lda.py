@@ -49,14 +49,22 @@ def _alpha_objective_grads(log_a: jnp.ndarray, ss: jnp.ndarray, d: int, k: int):
 
 
 @partial(jax.jit, static_argnums=(2, 3))
-def update_alpha(alpha_ss: jnp.ndarray, alpha_init: jnp.ndarray, d: int, k: int):
+def update_alpha(alpha_ss: jnp.ndarray, alpha_init: jnp.ndarray, d: int, k: int,
+                 max_iters: int = 100):
     """Maximize L(a) = D(lgam(Ka) - K lgam(a)) + a * ss over the symmetric
     Dirichlet parameter with Newton iterations in log space.
 
     This is the standard lda-c `opt_alpha` scheme: iterate
     log a <- log a - df / (d2f * a + df) from the current alpha, which is
     Newton's method on the reparameterized objective and keeps a > 0.
-    """
+
+    `max_iters` (lda-c's MAX_ALPHA_ITER=100 by default) bounds the
+    scalar Newton while_loop — the worst shape for a TPU (sequenced
+    scalar digamma/trigamma per trip).  Mid-EM the warm start from the
+    previous alpha converges in a handful of trips, so a small cap
+    (LDAConfig.alpha_max_iters; tools/tpu_probes.py's alpha_ab probe
+    measures the cost) trades nothing measurable in practice; the
+    default preserves lda-c semantics exactly."""
     ss = alpha_ss
 
     def body(state):
@@ -67,7 +75,7 @@ def update_alpha(alpha_ss: jnp.ndarray, alpha_init: jnp.ndarray, d: int, k: int)
 
     def cond(state):
         log_a, df_abs, it = state
-        return jnp.logical_and(it < 100, df_abs > 1e-5)
+        return jnp.logical_and(it < max_iters, df_abs > 1e-5)
 
     log_a0 = jnp.log(alpha_init)
     log_a, _, _ = jax.lax.while_loop(
@@ -436,7 +444,8 @@ class LDATrainer:
 
             log_beta = self._m_step(total_ss)
             if cfg.estimate_alpha:
-                alpha = update_alpha(total_ass, alpha, num_docs, k)
+                alpha = update_alpha(total_ass, alpha, num_docs, k,
+                                     max_iters=cfg.alpha_max_iters)
 
             ll = float(total_ll)
             conv = self._log_iteration(
@@ -854,6 +863,7 @@ class LDATrainer:
             warm_start=cfg.warm_start_gamma,
             dense_e_step_fn=dense_e_fn,
             dense_precision=cfg.dense_precision,
+            alpha_max_iters=cfg.alpha_max_iters,
         )
 
         ll_prev_dev = jnp.asarray(

@@ -179,12 +179,11 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
     # prev_round must carry the latest prior driver-captured headline
     # (BENCH_r01.json in-repo: 483336 docs/s).
     assert rec["prev_round"] and rec["prev_round"]["value"] > 0
-    # Every phase carries its wall-clock so the record shows where a
-    # slow round-end run spent its time.
+    # Every phase — success or error stub — carries its wall-clock so
+    # the record shows where a slow round-end run spent its time.
     assert rec["phase_wall_s"] >= 0
     assert all(
-        "error" in v or v.get("phase_wall_s", -1) >= 0
-        for v in rec["secondary"].values()
+        v.get("phase_wall_s", -1) >= 0 for v in rec["secondary"].values()
     )
 
 
@@ -240,7 +239,8 @@ def test_bench_backend_dead_skips_device_phases_keeps_host_phases(
     for name in ("lda_em_throughput_k50_v50k",
                  "lda_em_throughput_config4_v512k",
                  "pipeline_e2e", "pipeline_e2e_dns", "lda_online_svi"):
-        assert sec[name] == {"error": "skipped: backend wedged earlier in run"}
+        assert sec[name] == {"error": "skipped: backend wedged earlier in run",
+                             "phase_wall_s": 0.0}
     # The phase before the wedge ran normally.
     assert sec["lda_em_throughput_fresh_start"]["value"] > 0
 

@@ -153,3 +153,24 @@ def test_train_corpus_writes_reference_files(tmp_path, small_problem):
     assert other["num_topics"] == K and other["num_terms"] == V
     assert ll.shape == (3, 2)
     np.testing.assert_allclose(lb, result.log_beta, atol=1e-9)
+
+
+def test_alpha_max_iters_knob():
+    """LDAConfig.alpha_max_iters bounds the alpha-Newton loop: a cap of
+    1 from a far-off init must land elsewhere than the full 100-trip
+    optimization, while the default exactly reproduces lda-c's cap."""
+    # ss chosen so the optimum is interior (~1.0 at d=100, k=20:
+    # df = d*k*(digamma(20a) - digamma(a)) + ss = 0 around a=1).
+    ss = jnp.float32(-7094.0)
+    far = jnp.float32(50.0)
+    full = float(update_alpha(ss, far, 100, 20))
+    one = float(update_alpha(ss, far, 100, 20, max_iters=1))
+    default = float(update_alpha(ss, far, 100, 20, max_iters=100))
+    assert default == full
+    assert 0.5 < full < 2.0         # interior optimum reached
+    assert one != full              # the cap genuinely binds
+    # Warm start: re-optimizing FROM the optimum converges immediately,
+    # so even a tiny cap reproduces it — the premise behind lowering
+    # the cap mid-EM.
+    warm = float(update_alpha(ss, jnp.float32(full), 100, 20, max_iters=2))
+    assert abs(warm - full) < 1e-4 * abs(full)
