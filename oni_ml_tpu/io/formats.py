@@ -251,18 +251,38 @@ def write_doc_results(path: str, doc_names: Sequence[str], gamma: np.ndarray) ->
             f.write(f"{name},{norm}\n")
 
 
-def read_doc_results(path: str) -> tuple[list[str], np.ndarray]:
+def _read_keyed_matrix(path: str) -> tuple[list[str], np.ndarray]:
+    """Shared reader for `key,v1 v2 ... vK` CSVs (doc_results /
+    word_results): one float64 parse over the whole file instead of an
+    np.array call per row — the per-row version was ~1 s of the score
+    stage at 48k model rows.  Raises on ragged rows (the per-row
+    version silently produced an object array)."""
     names: list[str] = []
-    rows: list[np.ndarray] = []
+    flat: list[str] = []
+    k = -1
     with contract_open(path) as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:
                 continue
             name, vals = line.split(",", 1)
+            pieces = vals.replace('"', "").split()
+            if k < 0:
+                k = len(pieces)
+            elif len(pieces) != k:
+                raise ValueError(
+                    f"ragged value row for {name!r} in {path}: "
+                    f"{len(pieces)} fields, expected {k}"
+                )
             names.append(name)
-            rows.append(np.array(vals.replace('"', "").split(), dtype=np.float64))
-    return names, np.asarray(rows)
+            flat.extend(pieces)
+    if not names:
+        return names, np.zeros((0, 0), np.float64)
+    return names, np.array(flat, dtype=np.float64).reshape(len(names), k)
+
+
+def read_doc_results(path: str) -> tuple[list[str], np.ndarray]:
+    return _read_keyed_matrix(path)
 
 
 def write_word_results(path: str, vocab: Sequence[str], log_beta: np.ndarray) -> None:
@@ -278,17 +298,7 @@ def write_word_results(path: str, vocab: Sequence[str], log_beta: np.ndarray) ->
 
 
 def read_word_results(path: str) -> tuple[list[str], np.ndarray]:
-    words: list[str] = []
-    rows: list[np.ndarray] = []
-    with contract_open(path) as f:
-        for line in f:
-            line = line.rstrip("\n")
-            if not line:
-                continue
-            word, vals = line.split(",", 1)
-            words.append(word)
-            rows.append(np.array(vals.replace('"', "").split(), dtype=np.float64))
-    return words, np.asarray(rows)
+    return _read_keyed_matrix(path)
 
 
 # ---------------------------------------------------------------------------

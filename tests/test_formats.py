@@ -215,3 +215,16 @@ def test_native_model_emit_matches_python(tmp_path):
     assert np.array_equal(ptr2, ptr)
     assert np.array_equal(widx2, widx)
     assert np.array_equal(cnts2, cnts.astype(np.int64))
+
+
+def test_keyed_matrix_reader_ragged_raises(tmp_path):
+    """The batched doc/word-results reader must reject ragged rows
+    loudly (the old per-row reader silently built an object array)."""
+    p = tmp_path / "d.csv"
+    p.write_text("a,0.5 0.5\nb,0.2 0.3 0.5\n")
+    with pytest.raises(ValueError, match="ragged"):
+        formats.read_doc_results(str(p))
+    # Multi-space separation stays accepted (split() semantics).
+    p.write_text("a,0.5  0.5\nb,0.25 0.75\n")
+    names, mat = formats.read_doc_results(str(p))
+    assert names == ["a", "b"] and mat.shape == (2, 2)
