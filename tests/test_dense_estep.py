@@ -293,7 +293,8 @@ def test_forced_dense_with_vocab_sharding_raises():
     from oni_ml_tpu.models.lda import LDATrainer
     from oni_ml_tpu.parallel import make_mesh
 
-    mesh = make_mesh(data=2, model=2)
+    with pytest.warns(UserWarning, match="left idle"):
+        mesh = make_mesh(data=2, model=2)   # 2x2 of the 8 virtual devices
     trainer = LDATrainer(
         LDAConfig(num_topics=4, dense_em="on"), num_terms=200, mesh=mesh,
         vocab_sharded=True,
