@@ -21,7 +21,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ..native_build import NativeLib
+from ..native_build import NativeLib, narrow_counts_i32
 from .dns import DnsFeatures, featurize_dns
 from .quantiles import DECILES, QUINTILES, ecdf_cuts
 
@@ -110,6 +110,9 @@ def _copy(ptr, n, dtype):
     if n == 0:
         return np.zeros(0, dtype=dtype)
     return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+_narrow_i32 = narrow_counts_i32   # shared guard (native_build)
 
 
 def _table(lib, h, which: int) -> list[str]:
@@ -381,7 +384,7 @@ def _featurize_native(
             top_domain=_copy(lib.dfz_top(h), n, np.int16),   # {0,1,2}
             wc_ip=_copy(lib.dfz_wc_ip(h), nwc, np.int32),
             wc_word=_copy(lib.dfz_wc_word(h), nwc, np.int32),
-            wc_count=_copy(lib.dfz_wc_count(h), nwc, np.int32),
+            wc_count=_narrow_i32(_copy(lib.dfz_wc_count(h), nwc, np.int64)),
             num_raw_events=int(lib.dfz_num_raw(h)),
             time_cuts=time_cuts,
             frame_length_cuts=frame_length_cuts,

@@ -21,7 +21,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ..native_build import NativeLib
+from ..native_build import NativeLib, narrow_counts_i32
 from .flow import FLOW_COLUMNS, FlowFeatures, _jvm_double, featurize_flow
 from .quantiles import DECILES, QUINTILES, ecdf_cuts
 
@@ -107,6 +107,9 @@ def _copy(ptr, n, dtype):
     if n == 0:
         return np.zeros(0, dtype=dtype)
     return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+_narrow_i32 = narrow_counts_i32   # shared guard (native_build)
 
 
 def _table(lib, h, which: int) -> list[str]:
@@ -382,8 +385,8 @@ def _featurize_native(
             time_bin=_copy(lib.ffz_bins(h, 0), n, np.int16),
             wc_ip=_copy(lib.ffz_wc_ip(h), nwc, np.int32),
             wc_word=_copy(lib.ffz_wc_word(h), nwc, np.int32),
-            wc_count=_copy(lib.ffz_wc_count(h), nwc,
-                           np.int32),   # day counts: < 2^31 always
+            wc_count=_narrow_i32(_copy(lib.ffz_wc_count(h), nwc,
+                                       np.int64)),
             num_raw_events=int(lib.ffz_num_raw(h)),
             time_cuts=time_cuts,
             ibyt_cuts=ibyt_cuts,

@@ -16,6 +16,24 @@ import subprocess
 import threading
 from typing import Callable
 
+import numpy as np
+
+
+def narrow_counts_i32(counts: "np.ndarray") -> "np.ndarray":
+    """int64 C-side counts -> int32 storage, guarded: astype wraps
+    silently on overflow, which would corrupt corpus counts on an
+    adversarial or multi-day aggregated input (round-3 advisor
+    finding).  A single day can't reach 2^31 events per (ip, word)
+    pair, but the invariant is now checked, not assumed.  Shared by
+    features/native_flow.py and features/native_dns.py."""
+    if counts.size and int(counts.max()) >= 2**31:
+        raise OverflowError(
+            f"per-(ip, word) event count {int(counts.max())} exceeds "
+            "int32 storage; widen wc_count to int64 before aggregating "
+            "inputs this large"
+        )
+    return counts.astype(np.int32, copy=False)
+
 
 class NativeLib:
     """Lazy, thread-safe loader for one native .so."""
@@ -81,11 +99,23 @@ class NativeLib:
                 if not self._build() and not os.path.exists(self._lib_path):
                     self._failed = True
                     return None
+            return self._load_configured()
+
+    def _load_configured(self) -> ctypes.CDLL | None:
+        """CDLL + configure with one rebuild retry.  The retry loads
+        from a COPY at a unique temp path: glibc's dlopen matches
+        already-loaded objects by name string, so re-CDLL'ing
+        self._lib_path after os.replace would hand back the same stale
+        handle that just failed (round-3 advisor finding).  Caller
+        holds self._lock."""
+        load_path = self._lib_path
+        try:
             for attempt in (0, 1):
                 try:
-                    lib = ctypes.CDLL(self._lib_path)
+                    lib = ctypes.CDLL(load_path)
                     self._configure(lib)
-                    break
+                    self._lib = lib
+                    return self._lib
                 except OSError:
                     self._failed = True
                     return None
@@ -97,7 +127,20 @@ class NativeLib:
                     # simply wrong in configure), warn and degrade to
                     # the Python fallback instead of crashing callers.
                     if attempt == 0 and self._build():
-                        continue
+                        import shutil
+                        import tempfile
+
+                        try:
+                            fd, load_path = tempfile.mkstemp(
+                                suffix=".so",
+                                prefix=os.path.basename(self._lib_path)
+                                + ".",
+                            )
+                            os.close(fd)
+                            shutil.copy2(self._lib_path, load_path)
+                            continue
+                        except OSError:
+                            pass  # full/RO tempdir: degrade, don't raise
                     import warnings
 
                     warnings.warn(
@@ -107,8 +150,16 @@ class NativeLib:
                     )
                     self._failed = True
                     return None
-            self._lib = lib
-            return self._lib
+            self._failed = True
+            return None
+        finally:
+            if load_path != self._lib_path:
+                # Linux keeps the mapping alive after unlink; don't
+                # leave rebuild copies behind in the tempdir.
+                try:
+                    os.unlink(load_path)
+                except OSError:
+                    pass
 
     def available(self) -> bool:
         return self.load() is not None

@@ -158,9 +158,11 @@ def flow_emit(features, src_scores, dest_scores, order) -> bytes | None:
 
 def score_dot(theta, p, ip_idx, word_idx) -> "np.ndarray | None":
     """out[i] = <theta[ip_idx[i]], p[word_idx[i]]> in float64, k-order
-    accumulation — bit-identical to the numpy einsum path (fp-contract
-    pinned off in the C).  None when the native library is
-    unavailable."""
+    accumulation — bit-identical to the sequential k-order fold (the
+    reference's zip/map/sum; fp-contract pinned off in the C).  NOT
+    einsum: np.einsum's SIMD partial sums round in a different order
+    in the last ulp, which is exactly why scoring/score.py dropped it.
+    None when the native library is unavailable."""
     lib = _LIB.load()
     if lib is None:
         return None

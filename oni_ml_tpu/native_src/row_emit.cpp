@@ -151,7 +151,10 @@ char* dns_emit(
 
 // Fused gather-dot for event scoring: out[i] = <theta[ip_idx[i]],
 // p[w_idx[i]]> in float64, accumulated k=0..K-1 in index order —
-// bit-identical to the numpy einsum path (same IEEE add order).  The
+// bit-identical to the sequential k-order fold (the reference's
+// zip/map/sum).  NOT einsum: np.einsum's SIMD partial sums round in
+// a different order in the last ulp (that is why score.py replaced
+// it and the golden CSVs moved).  The
 // numpy path materializes two [N, K] float64 gather temporaries
 // (~1.6 GB at a 5M-event day) before the dot; this reads the two rows
 // and writes one double per event.  flow_post_lda.scala:227-239's

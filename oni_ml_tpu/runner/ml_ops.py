@@ -447,17 +447,32 @@ def _completion_score(ctx: RunContext, log_beta, alpha, corpus=None) -> dict:
 def stage_score(ctx: RunContext) -> dict:
     with open(ctx.path("features.pkl"), "rb") as f:
         features = pickle.load(f)
-    # Spilled raw rows (stage_pre) are referenced by path; fail with a
-    # recoverable message if the spill file vanished since.
+    # Spilled raw rows (stage_pre) are referenced by the path recorded
+    # at pre time.  The spill file lives beside features.pkl, so a
+    # moved/renamed/published day dir invalidates the recorded path
+    # while the file itself is right here — when (and ONLY when) the
+    # recorded path is gone, re-resolve against this day dir (round-3
+    # advisor finding: the stale path used to surface as a bare
+    # FileNotFoundError deep in scoring; a valid recorded path always
+    # wins, so a stale same-named spill here can't be silently
+    # substituted), failing recoverably, naming the move, when neither
+    # location has the file.
     for attr in ("lines_blob", "rows_blob"):
         blob = getattr(features, attr, None)
-        if blob is not None and hasattr(blob, "path") and not os.path.exists(
-            blob.path
-        ):
+        if blob is None or not hasattr(blob, "path"):
+            continue
+        if os.path.exists(blob.path):
+            continue  # recorded path valid: never silently substitute
+        local = ctx.path(os.path.basename(blob.path))
+        if os.path.exists(local):
+            blob.path = local
+        else:
             raise FileNotFoundError(
                 f"features.pkl references spilled raw rows at {blob.path}, "
-                "which no longer exists — re-run the pre stage "
-                "(--stages pre --force)"
+                f"and no {os.path.basename(blob.path)} exists in this day "
+                f"directory ({ctx.day_dir}) either — the spill file was "
+                "deleted or the day dir moved without it; re-run the pre "
+                "stage (--stages pre --force)"
             )
     sc = ctx.config.scoring
     fallback = sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback

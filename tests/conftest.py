@@ -13,15 +13,21 @@ import os
 # long as no device backend has been instantiated yet (nothing queries
 # devices during sitecustomize), so flip the platform through the config
 # API instead.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-# Keep test numerics deterministic and f32-stable on CPU.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+#
+# ONI_ML_TPU_TESTS_ON_TPU=1 skips the pin so the TPU-gated checks
+# (tests/test_tpu_smoke.py) can reach the real chip:
+#     ONI_ML_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_tpu_smoke.py
+# Only run single tests that way — the full suite assumes 8 devices.
+if os.environ.get("ONI_ML_TPU_TESTS_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # Keep test numerics deterministic and f32-stable on CPU.
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

@@ -166,6 +166,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
     assert {"metric", "value", "unit", "vs_baseline", "prev_round"} <= set(rec)
     assert rec["metric"] == "lda_em_throughput"
     assert set(rec["secondary"]) == {
+        "mosaic_smoke",
         "lda_em_throughput_fresh_start",
         "lda_em_throughput_k50_v50k",
         "lda_em_throughput_config4_v512k",
@@ -263,12 +264,41 @@ def test_bench_online_svi_smoke():
     assert np.isfinite(dps) and dps > 0
 
 
-def test_bench_main_aborts_cleanly_when_backend_wedged(capsys, monkeypatch):
+def test_bench_main_emits_structured_failure_when_backend_wedged(
+    capsys, monkeypatch
+):
+    """Rounds 2 and 3 both ended parsed=null because a dead backend
+    produced NO stdout.  The wedged path must now exit 1 with a final
+    parseable line: value=null, an error string, and provenance-marked
+    last_good evidence — never a fake measurement."""
     import bench
 
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: False)
     assert bench.main() == 1
-    assert capsys.readouterr().out.strip() == ""  # no fake JSON line
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(last)
+    assert rec["metric"] == "lda_em_throughput"
+    assert rec["value"] is None
+    assert "backend unavailable" in rec["error"]
+    lg = rec["last_good"]
+    assert lg is not None and lg["value"] > 0 and "provenance" in lg
+
+
+def test_bench_gate_schedule_bounded(monkeypatch):
+    """The initial probe gate must fit under BENCH_GATE_S (round 3's
+    ~40-min gentle window outran the driver's timeout: rc=124, no
+    record).  Worst case = every probe times out + every backoff —
+    including budgets below one PROBE_S (the probe clamps down)."""
+    import bench
+
+    for budget in (60.0, 120.0, 600.0, 1800.0):
+        probes, backoffs = bench._gate_schedule(budget)
+        assert sum(probes) + sum(backoffs) <= budget
+        assert len(probes) >= 1
+    # default comes from the module constant and is driver-safe
+    monkeypatch.delenv("BENCH_GATE_S", raising=False)
+    probes, backoffs = bench._gate_schedule()
+    assert sum(probes) + sum(backoffs) <= 600.0
 
 
 def test_bench_convergence_smoke():

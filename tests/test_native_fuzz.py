@@ -238,3 +238,18 @@ def test_fuzz_emit_kernels_parity(seed, tmp_path):
         want = np.zeros(0)
     got = native_emit.score_dot(theta, pm, ia, ib)
     assert np.array_equal(got, want)
+
+
+def test_narrow_i32_guards_overflow():
+    """wc_count narrowing must raise, not wrap (round-3 advisor
+    finding: astype(int32) silently corrupts counts >= 2^31)."""
+    import pytest
+
+    from oni_ml_tpu.features import native_dns, native_flow
+
+    for mod in (native_flow, native_dns):
+        ok = mod._narrow_i32(np.array([0, 5, 2**31 - 1], dtype=np.int64))
+        assert ok.dtype == np.int32 and ok.tolist() == [0, 5, 2**31 - 1]
+        assert mod._narrow_i32(np.zeros(0, dtype=np.int64)).dtype == np.int32
+        with pytest.raises(OverflowError):
+            mod._narrow_i32(np.array([1, 2**31], dtype=np.int64))

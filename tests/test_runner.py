@@ -388,6 +388,37 @@ def test_pre_stage_spills_raw_lines(flow_day):
         run_pipeline(cfg, "20160122", "flow", stages=["score"])
 
 
+def test_moved_day_dir_rescore(flow_day):
+    """features.pkl records the spill path from pre time; a published/
+    moved/renamed day dir must still re-score — stage_score re-resolves
+    the spill beside features.pkl instead of trusting the stale
+    absolute path (round-3 advisor finding: the stale path surfaced as
+    a confusing FileNotFoundError)."""
+    import dataclasses
+    import shutil
+
+    from oni_ml_tpu.features import native_flow
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    if not native_flow.available():
+        pytest.skip("native flow featurizer unavailable")
+    cfg, tmp_path = flow_day
+    run_pipeline(cfg, "20160122", "flow", force=True)
+    old_day = tmp_path / "20160122"
+    results = (old_day / "flow_results.csv").read_bytes()
+
+    # Move the whole data dir (publish/rename scenario): the recorded
+    # spill path now points into a directory that no longer exists.
+    new_root = tmp_path.parent / (tmp_path.name + "_moved")
+    shutil.move(str(tmp_path), str(new_root))
+    tmp_path.mkdir()  # keep the fixture's dir alive for pytest cleanup
+    cfg2 = dataclasses.replace(cfg, data_dir=str(new_root))
+    (new_root / "20160122" / "flow_results.csv").unlink()
+    run_pipeline(cfg2, "20160122", "flow", stages=["score"])
+    assert (new_root / "20160122" / "flow_results.csv").read_bytes() \
+        == results
+
+
 def test_eval_holdout_true_held_out_split(flow_day):
     """--eval-holdout: beta trains on the hash-split remainder, the
     excluded docs' per-token ll is recorded, and the file contract is
