@@ -420,21 +420,31 @@ def make_chunk_runner(
         )
         return new_beta, new_alpha, total_ll, tuple(gammas), vi_max
 
-    def run_chunk_impl(log_beta, alpha, ll_prev, groups, n_steps,
-                       gammas_in=None, have_prev=None) -> ChunkResult:
-        dtype = log_beta.dtype
-        # Gamma buffers must exist in the carry before the first iteration
-        # writes them.  `gammas_in`/`have_prev` carry the PREVIOUS chunk's
-        # posteriors across the host boundary so warm start survives chunk
-        # boundaries (without them iteration chunk*i+1 restarted fresh);
-        # when absent, zeros are never read back (warm gates on step>0).
+    def _resolve_gammas(groups, gammas_in, have_prev, dtype):
+        """Gamma buffers must exist in the carry before the first
+        iteration writes them.  `gammas_in`/`have_prev` carry the
+        PREVIOUS chunk's posteriors across the host boundary so warm
+        start survives chunk boundaries (without them iteration
+        chunk*i+1 restarted fresh); when absent, zeros are never read
+        back (warm gates on step>0)."""
         if gammas_in is None:
-            gamma0 = initial_gammas(groups, k, dtype,
-                                    dense_wmajor=dense_wmajor)
-            have_prev = jnp.asarray(False)
-        else:
-            gamma0 = gammas_in
-            have_prev = jnp.asarray(have_prev)
+            return (
+                initial_gammas(groups, k, dtype,
+                               dense_wmajor=dense_wmajor),
+                jnp.asarray(False),
+            )
+        return gammas_in, jnp.asarray(have_prev)
+
+    def _chunk_loop(model0, alpha, ll_prev, gammas0, n_steps, have_prev,
+                    iterate, dtype):
+        """Shared chunk while-loop skeleton — warm gating, the device
+        convergence rule, and step/ll/vi bookkeeping live HERE once,
+        for both the generic impl and the dense fast path (a change to
+        the stop rule or the warm gate must not be able to land in one
+        and not the other).  `iterate(model, alpha, gammas, warm) ->
+        (model', alpha', ll, gammas', vi)` supplies the EM iteration
+        body; `model` is whatever beta representation the path carries
+        (log-space [K, V], or padded exp-space [K, W])."""
         lls0 = jnp.zeros((chunk,), dtype)
         vi0 = jnp.zeros((chunk,), jnp.int32)
 
@@ -443,7 +453,7 @@ def make_chunk_runner(
             return (step < jnp.minimum(n_steps, chunk)) & ~converged
 
         def body(state):
-            log_beta, alpha, ll_prev, step, lls, vis, _, gammas_prev = state
+            model, alpha, ll_prev, step, lls, vis, _, gammas_prev = state
             # Warm start once ANY gamma exists: produced this chunk
             # (step>0) or carried in from the previous one (have_prev).
             warm = (
@@ -451,34 +461,142 @@ def make_chunk_runner(
                 if warm_start
                 else jnp.asarray(False)
             )
-            new_beta, new_alpha, ll, gammas, vi_max = em_iteration(
-                log_beta, alpha, groups, gammas_prev, warm
+            model, new_alpha, ll, gammas, vi = iterate(
+                model, alpha, gammas_prev, warm
             )
             # The first-ever iteration (ll_prev = nan) never stops — the
-            # reference's "no previous likelihood" case.  The host recomputes
-            # logged convergence values in float64 from the returned lls.
+            # reference's "no previous likelihood" case.  The host
+            # recomputes logged convergence values in float64 from the
+            # returned lls.
             conv = jnp.abs((ll_prev - ll) / ll_prev)
             converged = ~jnp.isnan(ll_prev) & (conv < em_tol)
             return (
-                new_beta,
+                model,
                 new_alpha,
                 ll,
                 step + 1,
                 lls.at[step].set(ll),
-                vis.at[step].set(vi_max),
+                vis.at[step].set(jnp.asarray(vi, jnp.int32)),
                 converged,
                 gammas,
             )
 
         state = (
-            log_beta, alpha, ll_prev, jnp.asarray(0, jnp.int32),
-            lls0, vi0, jnp.asarray(False), gamma0,
+            model0, alpha, ll_prev, jnp.asarray(0, jnp.int32),
+            lls0, vi0, jnp.asarray(False), gammas0,
         )
+        return jax.lax.while_loop(cond, body, state)
+
+    def run_chunk_impl(log_beta, alpha, ll_prev, groups, n_steps,
+                       gammas_in=None, have_prev=None) -> ChunkResult:
+        dtype = log_beta.dtype
+        gamma0, have_prev = _resolve_gammas(groups, gammas_in, have_prev,
+                                            dtype)
+
+        def iterate(log_beta, alpha, gammas_prev, warm):
+            return em_iteration(log_beta, alpha, groups, gammas_prev, warm)
+
         log_beta, alpha, ll_prev, step, lls, vis, converged, gammas = (
-            jax.lax.while_loop(cond, body, state)
+            _chunk_loop(log_beta, alpha, ll_prev, gamma0, n_steps,
+                        have_prev, iterate, dtype)
         )
         return ChunkResult(
             log_beta, alpha, ll_prev, lls, step, converged, gammas, vis
         )
 
-    return jax.jit(run_chunk_impl, compiler_options=compiler_options)
+    # -- single-dense-group fast path ------------------------------------
+    # The production/bench common case (one full-V dense group, stock
+    # M-step, no mesh override) carries exp(beta) in the kernel's padded
+    # [K, W] layout across EM iterations instead of log-space [K, V]:
+    # each iteration is kernel -> elementwise exp-space M-step
+    # (ss / total), eliminating the per-iteration exp(log_beta) pass,
+    # the log() in m_step, the [V, K] transposes, and the EStepResult
+    # assembly — all XLA glue the perf decomposition charges to the
+    # ~0.9 ms/EM-iteration fixed cost.  Log-space beta is reconstructed
+    # ONCE at the chunk boundary; log(ss / total) differs from m_step's
+    # log(ss) - log(total) by at most 1 ulp (same floor: entries with
+    # zero mass pin to LOG_ZERO exactly).
+    dense_fast_ok = m_fn is estep.m_step and dense_e_step_fn is None
+
+    def _is_single_dense(groups) -> bool:
+        return (
+            dense_fast_ok
+            and len(groups) == 1
+            and len(groups[0]) == 2          # (C, mask): full-V dense
+            and groups[0][0].shape[0] == 1   # one stacked batch
+        )
+
+    def run_chunk_impl_fast(log_beta, alpha, ll_prev, groups, n_steps,
+                            gammas_in=None, have_prev=None) -> ChunkResult:
+        from jax.scipy.special import gammaln
+
+        from ..ops import dense_estep
+
+        C, mask = (a[0] for a in groups[0])
+        dtype = log_beta.dtype
+        w = C.shape[0] if dense_wmajor else C.shape[1]
+        exp_beta0 = jnp.exp(log_beta)
+        if w != v:
+            exp_beta0 = jnp.pad(exp_beta0, ((0, 0), (0, w - v)))
+        fp = (
+            dense_estep.dense_fixed_point_w
+            if dense_wmajor
+            else dense_estep.dense_fixed_point
+        )
+        interp = jax.default_backend() != "tpu"
+        gamma0, have_prev = _resolve_gammas(groups, gammas_in, have_prev,
+                                            dtype)
+        # exp(LOG_ZERO) — the exact value exp(m_step's floor) produces,
+        # so zero-mass entries round-trip to LOG_ZERO bit-exactly.
+        exp_zero = jnp.asarray(np.exp(np.float64(estep.LOG_ZERO)), dtype)
+
+        def iterate(exp_beta, alpha, g_prev, warm):
+            gamma, t, docll, ass, iters = fp(
+                exp_beta, alpha, C, mask, var_max_iters, var_tol,
+                interpret=interp, gamma_prev=g_prev,
+                warm=jnp.asarray(warm, jnp.int32),
+                precision=dense_precision,
+            )
+            alpha_const = gammaln(k * alpha) - k * gammaln(alpha)
+            ll = docll.sum() + mask.sum() * alpha_const
+            new_alpha = (
+                update_alpha(ass.sum(), alpha, num_docs, k,
+                             max_iters=alpha_max_iters)
+                if estimate_alpha
+                else alpha
+            )
+            suff = exp_beta * t                       # [K, W]
+            total = suff.sum(-1, keepdims=True)       # pad cols are 0
+            new_exp = jnp.where(suff > 0, suff / total, exp_zero)
+            return new_exp, new_alpha, ll, gamma, iters
+
+        exp_beta, alpha, ll_prev, step, lls, vis, converged, gamma = (
+            _chunk_loop(exp_beta0, alpha, ll_prev, gamma0[0][0], n_steps,
+                        have_prev, iterate, dtype)
+        )
+        # Reconstruct log-space beta once.  A zero-step chunk must
+        # return the INPUT log_beta (log(exp(x)) drifts an ulp).
+        eb = exp_beta[:, :v]
+        new_log = jnp.where(
+            eb > exp_zero, jnp.log(jnp.maximum(eb, 1e-300)),
+            estep.LOG_ZERO
+        )
+        log_out = jnp.where(step > 0, new_log, log_beta)
+        return ChunkResult(
+            log_out, alpha, ll_prev, lls, step, converged,
+            (gamma[None],), vis,
+        )
+
+    def run_chunk_dispatch(log_beta, alpha, ll_prev, groups, n_steps,
+                           gammas_in=None, have_prev=None) -> ChunkResult:
+        if _is_single_dense(groups):
+            return run_chunk_impl_fast(
+                log_beta, alpha, ll_prev, groups, n_steps,
+                gammas_in=gammas_in, have_prev=have_prev,
+            )
+        return run_chunk_impl(
+            log_beta, alpha, ll_prev, groups, n_steps,
+            gammas_in=gammas_in, have_prev=have_prev,
+        )
+
+    return jax.jit(run_chunk_dispatch, compiler_options=compiler_options)

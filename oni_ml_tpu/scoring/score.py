@@ -23,7 +23,7 @@ DnsFeatures) instead of re-running it the way the post scripts do.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -40,12 +40,6 @@ class ScoringModel:
     theta: np.ndarray            # [D+1, K], row D = fallback
     word_index: dict[str, int]
     p: np.ndarray                # [V+1, K], row V = fallback
-    # Lazy sorted-key lookup tables: (keys U-array sorted, rows int32).
-    # ip_rows/word_rows over the featurizer's interned tables (hundreds
-    # of thousands of uniques on a real day) ran a Python dict.get per
-    # key; a vectorized searchsorted is ~20x that.
-    _ip_lut: tuple | None = field(default=None, init=False, repr=False)
-    _word_lut: tuple | None = field(default=None, init=False, repr=False)
 
     @property
     def num_topics(self) -> int:
@@ -85,86 +79,30 @@ class ScoringModel:
         return cls.from_results(doc_names, doc_topic, vocab, word_topic, fallback)
 
     def ip_rows(self, ips: list[str]) -> np.ndarray:
-        if self._ip_lut is None:
-            self._ip_lut = _make_lut(self.ip_index)
-        return _lut_rows(self._ip_lut, ips, len(self.ip_index))
+        return _index_rows(self.ip_index, ips, len(self.ip_index))
 
     def word_rows(self, words: list[str]) -> np.ndarray:
-        if self._word_lut is None:
-            self._word_lut = _make_lut(self.word_index)
-        return _lut_rows(self._word_lut, words, len(self.word_index))
+        return _index_rows(self.word_index, words, len(self.word_index))
 
 
-# Vector-path width cap: numpy U arrays cost 4*maxlen bytes PER ELEMENT
-# (one 253-char DNS name would make every element ~1KB).  Real keys here
-# are short (IPs <= 45 chars, discretized words ~10-30); longer strings
-# are rare hostiles and take the dict path.  A query only ever equals a
-# key of its own length, so splitting by length preserves semantics.
-_MAX_LUT_CHARS = 48
+def _index_rows(index: dict[str, int], queries: list[str],
+                fallback_row: int) -> np.ndarray:
+    """Row per query via one dict.get pass into a preallocated int32
+    array; misses get the fallback row.
 
-
-def _odd_key(s: str) -> bool:
-    """Keys/queries the vectorized path cannot represent faithfully:
-    numpy's U dtype strips TRAILING NUL characters on conversion (only
-    trailing: 'a\\x00b' round-trips, 'a\\x00' becomes 'a') — a hostile
-    'foo\\x00' would collide with 'foo' — and over-long strings would
-    blow up the fixed-width array.
-
-    _lut_rows inlines this predicate for the per-query hot loop
-    (score.py, odd_idx comprehension) — keep the two in sync; a
-    build/query classification mismatch silently returns fallback
-    rows (drift-pinned by test_odd_key_inline_predicate_in_sync)."""
-    return len(s) > _MAX_LUT_CHARS or s.endswith("\x00")
-
-
-def _make_lut(index: dict[str, int]):
-    """dict -> ((sorted key U-array, row array) | None, oddball dict).
-
-    Oddball keys (_odd_key) live in a side dict; _lut_rows routes
-    oddball queries through it, so lookup semantics stay exactly
-    dict.get's."""
-    odd = {k: v for k, v in index.items() if _odd_key(k)}
-    plain = [(k, v) for k, v in index.items() if not _odd_key(k)]
-    if not plain:
-        return None, odd
-    keys = np.asarray([k for k, _ in plain], dtype=np.str_)
-    rows = np.asarray([v for _, v in plain], np.int32)
-    order = np.argsort(keys)
-    return (keys[order], rows[order]), odd
-
-
-def _lut_rows(lut_odd, queries: list[str], fallback_row: int) -> np.ndarray:
-    """Row per query via searchsorted; misses get the fallback row.
-    Queries keep their own U-width (numpy compares by code point, no
-    truncation); oddball queries (_odd_key) are blanked out of the
-    array — '' keeps its width small — and resolved via the side dict,
-    matching dict/str lookup semantics exactly."""
-    lut, odd = lut_odd
-    # Inline the _odd_key predicate: at O(unique)≈O(events) scale (a
-    # high-cardinality DNS day resolves hundreds of thousands of table
-    # keys) the per-key function call was ~20% of the whole scoring
-    # stage (profiled 0.26 s of 1.35 s on a 400k-event day).
-    odd_idx = [
-        i for i, s in enumerate(queries)
-        if len(s) > _MAX_LUT_CHARS or s.endswith("\x00")
-    ]
-    if lut is None:
-        out = np.full(len(queries), fallback_row, np.int32)
-    else:
-        keys, rows = lut
-        plain = queries
-        if odd_idx:
-            plain = list(queries)
-            for i in odd_idx:
-                plain[i] = ""   # keeps the array narrow; fixed up below
-        q = np.asarray(plain, dtype=np.str_)
-        pos = np.clip(np.searchsorted(keys, q), 0, len(keys) - 1)
-        out = np.where(keys[pos] == q, rows[pos], fallback_row).astype(
-            np.int32
-        )
-    for i in odd_idx:
-        out[i] = odd.get(queries[i], fallback_row)
-    return out
+    This replaced a sorted-U-array searchsorted LUT (round-4 DNS p50
+    reconciliation): on a high-cardinality DNS day the queries are the
+    featurizer's interned table — O(unique) ≈ O(events), ~400k keys —
+    and the LUT path spent ~0.7 s/day converting them into a fixed-
+    width numpy U array (4·48 B per element) before the search, 3.7×
+    the cost of just probing the dict (measured 0.33 s vs 0.09 s on a
+    395k-key table).  A generator into np.fromiter has no per-key
+    Python-function cost, and dict semantics need no oddball side path
+    for NULs or over-long hostile strings."""
+    get = index.get
+    return np.fromiter(
+        (get(s, fallback_row) for s in queries), np.int32, len(queries)
+    )
 
 
 def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20):

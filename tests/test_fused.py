@@ -90,3 +90,102 @@ def test_fused_progress_and_likelihood_stream(problem, tmp_path):
     assert len(lines) == 5
     ll0 = float(lines[0].split("\t")[0])
     np.testing.assert_allclose(ll0, res.likelihoods[0][0], rtol=1e-6)
+
+
+def test_dense_fast_path_matches_stock_chunk_runner():
+    """The single-dense-group exp-space fast path (run_chunk_impl_fast)
+    must match the generic impl — same likelihood trajectory, beta,
+    alpha, gammas — including across a warm chunk boundary.  The stock
+    path is summoned by passing an m_step wrapper the `is` check cannot
+    recognize (exactly how a custom m_step_fn opts out)."""
+    import jax.numpy as jnp
+
+    from oni_ml_tpu.models import fused
+    from oni_ml_tpu.ops import dense_estep, estep
+
+    rng = np.random.default_rng(5)
+    k, v, b, l = 4, 96, 16, 8
+    noise = rng.uniform(size=(k, v)) + 1.0 / v
+    log_beta = jnp.asarray(
+        np.log(noise / noise.sum(-1, keepdims=True)), jnp.float32
+    )
+    widx = jnp.asarray(rng.integers(0, v, size=(b, l)), jnp.int32)
+    cnts = jnp.asarray(rng.integers(1, 5, size=(b, l)), jnp.float32)
+    dense = dense_estep.densify(widx, cnts, v)
+    groups = ((dense[None], jnp.ones((1, b), jnp.float32)),)
+
+    kw = dict(
+        num_docs=b, num_topics=k, num_terms=v, chunk=3,
+        var_max_iters=8, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
+        warm_start=True,
+    )
+    fast = fused.make_chunk_runner(**kw)
+    stock = fused.make_chunk_runner(
+        m_step_fn=lambda ss: estep.m_step(ss), **kw
+    )
+
+    a0 = jnp.float32(2.5)
+    nan = jnp.float32(np.nan)
+    rf = fast(log_beta, a0, nan, groups, 3)
+    rs = stock(log_beta, a0, nan, groups, 3)
+    assert int(rf.steps_done) == int(rs.steps_done) == 3
+    np.testing.assert_allclose(rf.lls, rs.lls, rtol=1e-5)
+    np.testing.assert_allclose(rf.log_beta, rs.log_beta, atol=1e-4)
+    np.testing.assert_allclose(rf.alpha, rs.alpha, rtol=1e-5)
+    np.testing.assert_allclose(rf.gammas[0], rs.gammas[0],
+                               rtol=1e-4, atol=1e-4)
+
+    # Warm chunk boundary: feed each path its own carry, compare again.
+    rf2 = fast(rf.log_beta, rf.alpha, rf.ll_prev, groups, 2,
+               rf.gammas, True)
+    rs2 = stock(rs.log_beta, rs.alpha, rs.ll_prev, groups, 2,
+                rs.gammas, True)
+    assert int(rf2.steps_done) == int(rs2.steps_done) == 2
+    np.testing.assert_allclose(rf2.lls[:2], rs2.lls[:2], rtol=1e-5)
+    np.testing.assert_allclose(rf2.log_beta, rs2.log_beta, atol=1e-4)
+    # Warm start actually engaged: inner iterations collapsed vs cold.
+    assert int(rf2.vi_iters[0]) <= int(rf.vi_iters[0])
+
+    # Zero-step chunk returns the input beta bit-exactly (the exp/log
+    # round-trip must not drift it).
+    rf0 = fast(rf.log_beta, rf.alpha, rf.ll_prev, groups, 0,
+               rf.gammas, True)
+    assert int(rf0.steps_done) == 0
+    np.testing.assert_array_equal(rf0.log_beta, rf.log_beta)
+
+
+def test_dense_fast_path_matches_stock_wmajor():
+    """Same equivalence under the W-major corpus layout (the production
+    default on TPU)."""
+    import jax.numpy as jnp
+
+    from oni_ml_tpu.models import fused
+    from oni_ml_tpu.ops import dense_estep, estep
+
+    rng = np.random.default_rng(9)
+    k, v, b, l = 4, 96, 16, 8
+    noise = rng.uniform(size=(k, v)) + 1.0 / v
+    log_beta = jnp.asarray(
+        np.log(noise / noise.sum(-1, keepdims=True)), jnp.float32
+    )
+    widx = jnp.asarray(rng.integers(0, v, size=(b, l)), jnp.int32)
+    cnts = jnp.asarray(rng.integers(1, 5, size=(b, l)), jnp.float32)
+    dense_t = jnp.transpose(dense_estep.densify(widx, cnts, v))  # [W, B]
+    groups = ((dense_t[None], jnp.ones((1, b), jnp.float32)),)
+
+    kw = dict(
+        num_docs=b, num_topics=k, num_terms=v, chunk=4,
+        var_max_iters=8, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
+        warm_start=True, dense_wmajor=True,
+    )
+    fast = fused.make_chunk_runner(**kw)
+    stock = fused.make_chunk_runner(
+        m_step_fn=lambda ss: estep.m_step(ss), **kw
+    )
+    a0, nan = jnp.float32(2.5), jnp.float32(np.nan)
+    rf = fast(log_beta, a0, nan, groups, 4)
+    rs = stock(log_beta, a0, nan, groups, 4)
+    assert int(rf.steps_done) == int(rs.steps_done) == 4
+    np.testing.assert_allclose(rf.lls, rs.lls, rtol=1e-5)
+    np.testing.assert_allclose(rf.log_beta, rs.log_beta, atol=1e-4)
+    np.testing.assert_allclose(rf.alpha, rs.alpha, rtol=1e-5)

@@ -243,35 +243,23 @@ def test_surrogate_bytes_flow_through_scoring():
     assert b"evil\xe9\x80.bad" in blob
 
 
-def test_odd_key_inline_predicate_in_sync():
-    """_lut_rows inlines _odd_key for the per-query hot loop; a drift
-    between build-time (_make_lut via _odd_key) and query-time (inline)
-    classification silently yields fallback rows.  Pin them together
-    over the boundary cases, and pin end-to-end that boundary keys
-    still resolve."""
-    from oni_ml_tpu.scoring.score import (
-        _MAX_LUT_CHARS,
-        _lut_rows,
-        _make_lut,
-        _odd_key,
-    )
+def test_index_rows_hostile_keys_exact_dict_semantics():
+    """Model-row resolution must match dict.get exactly for hostile
+    keys — NULs anywhere, over-long strings, empty — with misses on
+    the fallback row.  (The former searchsorted LUT needed an oddball
+    side path for these; the dict path is exact by construction, and
+    this pins it stays so.)"""
+    from oni_ml_tpu.scoring.score import _index_rows
 
     cases = [
-        "", "a", "a" * _MAX_LUT_CHARS, "a" * (_MAX_LUT_CHARS + 1),
-        "x\x00", "x\x00y", "\x00", "a" * _MAX_LUT_CHARS + "\x00",
+        "", "a", "a" * 48, "a" * 49, "a" * 300,
+        "x\x00", "x\x00y", "\x00", "a" * 48 + "\x00",
     ]
-    inline = [
-        s for s in cases
-        if len(s) > _MAX_LUT_CHARS or s.endswith("\x00")
-    ]
-    assert inline == [s for s in cases if _odd_key(s)]
-    # End-to-end: every boundary key round-trips through the LUT.
     index = {s: i for i, s in enumerate(cases)}
-    lut = _make_lut(index)
-    got = _lut_rows(lut, cases, fallback_row=-1)
-    assert list(got) == list(range(len(cases)))
-    assert _lut_rows(lut, ["missing", "y\x00"], fallback_row=-1).tolist() \
-        == [-1, -1]
+    assert _index_rows(index, cases, -1).tolist() == list(range(len(cases)))
+    assert _index_rows(index, ["missing", "y\x00"], -1).tolist() == [-1, -1]
+    assert _index_rows({}, ["a"], 7).tolist() == [7]
+    assert _index_rows(index, [], -1).tolist() == []
 
 
 def _wc_parity(feats, tmp_path):

@@ -1,0 +1,133 @@
+"""Config-3-at-spec demonstration: a 30-day corpus through one pre pass.
+
+BASELINE.json config 3 is "flow LDA at scale: 30-day corpus, 50 topics,
+full IP-pair vocabulary" — the shape the reference ran on a Spark
+cluster (dns_pre_lda.scala:1-2 notes the cluster-scale pre stage;
+SURVEY §2.2).  Round 3 demonstrated 3 days / 6M events; this tool runs
+the full 30 days on one host and records the evidence the extrapolation
+in docs/performance.md was standing in for:
+
+    python tools/config3_30day.py [--events-per-day 5000000] [--days 30]
+                                  [--keep] [--out JSON_PATH]
+
+Writes 30 synthetic day files (bench._write_flow_day schema, distinct
+seeds so IPs/words overlap across days the way real traffic does), runs
+the runner's pre stage over the glob with the ingest-time spill, builds
+the Corpus, and prints one JSON line: events, raw input bytes, peak RSS
+(ru_maxrss), per-stage walls, docs/vocab of the resulting corpus.  RSS
+staying a small multiple of the numeric arrays — NOT of the raw bytes —
+is the claim under test (features/blob.py).
+
+CPU-only by design: the pre stage is host code; config 3's training
+number is bench.py's `lda_em_throughput_k50_v50k` phase on the chip.
+"""
+
+import argparse
+import json
+import os
+import resource
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events-per-day", type=int, default=5_000_000)
+    ap.add_argument("--days", type=int, default=30)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON record here")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the workdir (implied by --workdir)")
+    ap.add_argument("--workdir", default=None,
+                    help="run in this directory instead of a fresh "
+                         "tempdir; NEVER deleted (the tool only "
+                         "auto-deletes directories it created)")
+    args = ap.parse_args()
+    if args.workdir:
+        args.keep = True
+
+    import bench
+    from oni_ml_tpu.config import (
+        FeedbackConfig, LDAConfig, PipelineConfig, ScoringConfig,
+    )
+    from oni_ml_tpu.io.corpus import Corpus
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    work = args.workdir or tempfile.mkdtemp(prefix="oni_config3_")
+    os.makedirs(work, exist_ok=True)
+    rec = {"metric": "config3_30day_pre", "days": args.days,
+           "events_per_day": args.events_per_day}
+    try:
+        # -- generate ----------------------------------------------------
+        t0 = time.perf_counter()
+        raw_bytes = 0
+        day_files = []
+        for d in range(args.days):
+            path = os.path.join(work, f"flow_201601{d + 1:02d}.csv")
+            with open(path, "w") as f:
+                # Distinct seed per day; shared address/port space so
+                # vocabulary and documents accumulate sub-linearly
+                # across days (real traffic: same hosts, same services).
+                bench._write_flow_day(
+                    f, args.events_per_day, n_src=4000, n_dst=2000,
+                    seed=100 + d,
+                )
+            raw_bytes += os.path.getsize(path)
+            day_files.append(path)
+            print(f"config3: day {d + 1}/{args.days} written "
+                  f"({raw_bytes / 1e9:.1f} GB total)", file=sys.stderr)
+        rec["gen_wall_s"] = round(time.perf_counter() - t0, 1)
+        rec["raw_gb"] = round(raw_bytes / 1e9, 2)
+
+        # -- pre stage over the 30-file glob (ingest-time spill) ---------
+        cfg = PipelineConfig(
+            data_dir=work,
+            flow_path=os.path.join(work, "flow_201601*.csv"),
+            lda=LDAConfig(num_topics=50),
+            feedback=FeedbackConfig(),
+            scoring=ScoringConfig(),
+        )
+        rss0_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        t1 = time.perf_counter()
+        metrics = run_pipeline(cfg, "20160131", "flow", force=True,
+                               stages=["pre"])
+        rec["pre_wall_s"] = round(time.perf_counter() - t1, 1)
+        pre = next(m for m in metrics if m.get("stage") == "pre")
+        rec["events"] = pre["events"]
+        rec["word_count_rows"] = pre["word_count_rows"]
+
+        # -- corpus build ------------------------------------------------
+        day_dir = os.path.join(work, "20160131")
+        t2 = time.perf_counter()
+        corpus = Corpus.from_word_counts_file(
+            os.path.join(day_dir, "word_counts.dat")
+        )
+        rec["corpus_wall_s"] = round(time.perf_counter() - t2, 1)
+        rec["num_docs"] = corpus.num_docs
+        rec["vocab_size"] = corpus.num_terms
+
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        rec["peak_rss_gb"] = round(peak_kb / 1e6, 2)
+        rec["baseline_rss_gb"] = round(rss0_kb / 1e6, 2)
+        rec["rss_over_raw"] = round((peak_kb * 1e3) / raw_bytes, 3)
+        spill = os.path.join(day_dir, "raw_lines.bin")
+        rec["spill_gb"] = round(os.path.getsize(spill) / 1e9, 2) \
+            if os.path.exists(spill) else None
+    finally:
+        if not args.keep:
+            shutil.rmtree(work, ignore_errors=True)
+
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

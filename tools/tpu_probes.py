@@ -3,9 +3,10 @@ next time a healthy TPU grant is attached (they were authored in round 3
 while the session's device tunnel was down, so the numbers they produce
 are the first thing round 4 should capture).
 
-    python tools/tpu_probes.py [cap_sweep] [alpha_ab] [chunk_sweep]
+    python tools/tpu_probes.py [cap_sweep] [alpha_ab] [fastpath_ab]
+                               [chunk_sweep]
 
-(no args = all three).  Each probe prints one JSON line per
+(no args = all four).  Each probe prints one JSON line per
 measurement.  What they answer:
 
 cap_sweep — fixed-cost decomposition of one EM iteration.  docs/s at
@@ -23,6 +24,11 @@ alpha_ab — attribute the alpha-Newton update's cost.  estimate_alpha
   If the A/B shows it material, the candidate fix is a fixed-depth
   fori_loop(8) from the warm previous alpha (quadratic convergence
   makes 8 plenty mid-run), which also removes a dynamic trip count.
+
+fastpath_ab — the round-4 exp-space single-dense-group fast path
+  (fused.run_chunk_impl_fast) vs the generic chunk impl: how much of
+  the fixed glue the in-loop exp/log/transpose elimination actually
+  buys on chip.
 
 chunk_sweep — host-dispatch amortization.  Round-2 data said 8->32
   chunk doubled throughput and 32->64 was flat; re-check at the
@@ -77,6 +83,39 @@ def alpha_ab():
         fused.make_chunk_runner = orig
 
 
+def fastpath_ab():
+    """Exp-space single-dense-group fast path (round-4
+    fused.run_chunk_impl_fast) vs the generic chunk impl: measures the
+    per-EM-iteration glue the fast path removes (the exp(log_beta)
+    pass, m_step's log, two [V, K] transposes, EStepResult assembly).
+    The generic impl is summoned by wrapping m_step so the fast path's
+    `is` eligibility check cannot recognize it."""
+    import bench
+    from oni_ml_tpu.models import fused
+    from oni_ml_tpu.ops import estep
+
+    orig = fused.make_chunk_runner
+
+    def stock(**kw):
+        if kw.get("m_step_fn") in (None, estep.m_step):
+            kw["m_step_fn"] = lambda ss: estep.m_step(ss)
+        return orig(**kw)
+
+    try:
+        for label, maker in (("fast", orig), ("stock", stock)):
+            fused.make_chunk_runner = maker
+            em = bench.bench_em(K, V, B, L, rounds=3, warm_start=True,
+                                precision="bf16")
+            print(json.dumps({
+                "probe": "fastpath_ab", "path": label,
+                "t_iter_ms": round(em["t_iter"] * 1e3, 3),
+                "mean_vi": round(em["mean_vi"], 2),
+                "docs_per_sec": round(em["docs_per_sec"]),
+            }), flush=True)
+    finally:
+        fused.make_chunk_runner = orig
+
+
 def chunk_sweep():
     import bench
 
@@ -97,7 +136,8 @@ def main() -> int:
         print("tpu_probes: backend is not TPU — these probes measure "
               "device behavior; run on the chip host", file=sys.stderr)
         return 2
-    which = sys.argv[1:] or ["cap_sweep", "alpha_ab", "chunk_sweep"]
+    which = sys.argv[1:] or ["cap_sweep", "alpha_ab", "fastpath_ab",
+                             "chunk_sweep"]
     for name in which:
         fn = globals().get(name)
         if fn is None:
