@@ -110,10 +110,12 @@ def main() -> int:
         rec["num_docs"] = corpus.num_docs
         rec["vocab_size"] = corpus.num_terms
 
+        # ru_maxrss is KiB on Linux: binary factor, not decimal
+        # (round-4 review finding: /1e6 understated the GB by 2.4%).
         peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        rec["peak_rss_gb"] = round(peak_kb / 1e6, 2)
-        rec["baseline_rss_gb"] = round(rss0_kb / 1e6, 2)
-        rec["rss_over_raw"] = round((peak_kb * 1e3) / raw_bytes, 3)
+        rec["peak_rss_gb"] = round(peak_kb * 1024 / 1e9, 2)
+        rec["baseline_rss_gb"] = round(rss0_kb * 1024 / 1e9, 2)
+        rec["rss_over_raw"] = round((peak_kb * 1024) / raw_bytes, 3)
         spill = os.path.join(day_dir, "raw_lines.bin")
         rec["spill_gb"] = round(os.path.getsize(spill) / 1e9, 2) \
             if os.path.exists(spill) else None
