@@ -728,6 +728,15 @@ class _Record:
             if self.data is not None:
                 print(json.dumps(self.data), flush=True)
 
+    def emit_raw(self):
+        """Signal-safe emit: os.write bypasses buffered stdout, which
+        CPython forbids re-entering from a signal handler that landed
+        mid-print (RuntimeError: reentrant call inside BufferedWriter).
+        Used by the salvage paths only."""
+        with self.lock:
+            if self.data is not None:
+                os.write(1, (json.dumps(self.data) + "\n").encode())
+
 
 # Temp workdirs the watchdog must remove before os._exit (which skips
 # finally: blocks — a wedged pipeline_e2e would otherwise orphan a
@@ -761,11 +770,29 @@ def _with_watchdog(record: _Record, budget_s: float):
 
 def _salvage_and_exit(record: _Record, reason: str) -> "None":
     """Last-resort exit shared by the watchdog and the SIGTERM handler:
-    clean up, then ALWAYS leave a parseable last line — the grown
-    record (exit 0) or a structured failure (exit 1).  os._exit because
-    a hung device call cannot be unwound any other way."""
+    ALWAYS leave a parseable last line — the grown record (exit 0) or a
+    structured failure (exit 1) — then clean up.  os._exit because a
+    hung device call cannot be unwound any other way.
+
+    Ordering and IO discipline (round-4 review findings): the record is
+    written FIRST via os.write (a supervisor escalating TERM->KILL
+    after a short grace must never catch us mid-rmtree of a multi-GB
+    e2e workdir with the record unprinted, and buffered print cannot
+    be re-entered from a signal handler that landed mid-print)."""
     import shutil
 
+    rc = 0
+    if record.data is not None:
+        record.emit_raw()
+    else:
+        rc = 1
+        os.write(1, (json.dumps({
+            "metric": "lda_em_throughput",
+            "value": None,
+            "unit": "docs/sec",
+            "error": reason,
+            "last_good": _last_good_record(),
+        }) + "\n").encode())
     try:
         from __graft_entry__ import current_probe_proc
 
@@ -782,11 +809,7 @@ def _salvage_and_exit(record: _Record, reason: str) -> "None":
         shutil.rmtree(d, ignore_errors=True)
     if _RUN_E2E_DIR:
         shutil.rmtree(_RUN_E2E_DIR, ignore_errors=True)
-    if record.data is not None:
-        record.emit()
-        os._exit(0)
-    _emit_failure(reason)
-    os._exit(1)
+    os._exit(rc)
 
 
 def _install_sigterm_salvage(record: _Record) -> None:
@@ -799,8 +822,9 @@ def _install_sigterm_salvage(record: _Record) -> None:
     import signal
 
     def on_term(signum, frame):
-        print("bench: SIGTERM from supervising process — salvaging the "
-              "record", file=sys.stderr)
+        # os.write: buffered stderr may be mid-write on this thread.
+        os.write(2, b"bench: SIGTERM from supervising process - "
+                    b"salvaging the record\n")
         _salvage_and_exit(
             record, "terminated by supervising process before the "
             "headline completed"

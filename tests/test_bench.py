@@ -339,14 +339,26 @@ def test_bench_sigterm_salvages_parseable_record(tmp_path):
     # Wait for the readiness marker (not a fixed sleep: the import
     # chain can exceed any guess on a loaded machine, and a TERM
     # before the handler is installed dies with default semantics).
+    # select() keeps the deadline real — a bare readline() would block
+    # past it if bench hangs pre-marker, and busy-spin at EOF.
+    import select
+
     deadline = time.time() + 120
     ready = False
-    while time.time() < deadline:
+    while time.time() < deadline and proc.poll() is None:
+        r, _, _ = select.select([proc.stderr], [], [], 1.0)
+        if not r:
+            continue
         line = proc.stderr.readline()
+        if not line:
+            break  # EOF: bench died before the marker
         if "salvage handler installed" in line:
             ready = True
             break
-    assert ready, "bench.py never printed the readiness marker"
+    if not ready:
+        proc.kill()
+        proc.communicate()
+        raise AssertionError("bench.py never printed the readiness marker")
     time.sleep(1.0)  # let it enter the probe gate
     proc.send_signal(signal.SIGTERM)
     out, _ = proc.communicate(timeout=60)

@@ -1,0 +1,64 @@
+"""tools/chip_session.py orchestration mechanics (no TPU needed).
+
+The capture runbook must preserve step output — including on nonzero
+exit and timeout — and extract the bench record from the LAST parseable
+JSON line (success payload or bench's structured failure record).
+Steps are stubbed with tiny shell commands; the real TPU sequence is
+exercised by the runbook itself on a healthy grant.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools"),
+)
+
+import chip_session
+
+
+def _steps(*specs):
+    return [
+        (name, [sys.executable, "-c", code], timeout)
+        for name, code, timeout in specs
+    ]
+
+
+def test_partial_output_survives_failure_and_timeout(tmp_path, monkeypatch):
+    out = tmp_path / "cap.json"
+    monkeypatch.setattr(chip_session, "STEPS", _steps(
+        ("ok", "print('line1'); print('line2')", 30),
+        ("fails", "print('partial result'); raise SystemExit(2)", 30),
+        ("hangs", "import time; print('before hang', flush=True); "
+                  "time.sleep(60)", 2),
+        ("bench", "print('noise'); "
+                  "print('{\"metric\": \"m\", \"value\": 1.5}')", 30),
+    ))
+    monkeypatch.setattr(sys, "argv", ["chip_session", "--out", str(out)])
+    rc = chip_session.main()
+    assert rc == 1  # fails/hangs steps were not green
+    log = (tmp_path / "cap.json.log").read_text()
+    assert "line1" in log and "line2" in log
+    assert "partial result" in log          # nonzero exit keeps output
+    assert "before hang" in log             # timeout keeps output
+    rec = json.loads(out.read_text())
+    assert rec == {"metric": "m", "value": 1.5}
+
+
+def test_bench_failure_record_is_captured(tmp_path, monkeypatch):
+    """bench exiting 1 with a structured failure line must still
+    produce the capture file (round-4 review finding: the failure
+    record was discarded one layer up)."""
+    out = tmp_path / "cap.json"
+    fail = json.dumps({"metric": "lda_em_throughput", "value": None,
+                       "error": "backend unavailable"})
+    monkeypatch.setattr(chip_session, "STEPS", _steps(
+        ("bench", f"print('{fail}'); raise SystemExit(1)", 30),
+    ))
+    monkeypatch.setattr(sys, "argv", ["chip_session", "--out", str(out)])
+    assert chip_session.main() == 1
+    rec = json.loads(out.read_text())
+    assert rec["value"] is None and "backend unavailable" in rec["error"]
