@@ -92,19 +92,19 @@ def test_fused_progress_and_likelihood_stream(problem, tmp_path):
     np.testing.assert_allclose(ll0, res.likelihoods[0][0], rtol=1e-6)
 
 
-def test_dense_fast_path_matches_stock_chunk_runner():
-    """The single-dense-group exp-space fast path (run_chunk_impl_fast)
-    must match the generic impl — same likelihood trajectory, beta,
-    alpha, gammas — including across a warm chunk boundary.  The stock
-    path is summoned by passing an m_step wrapper the `is` check cannot
-    recognize (exactly how a custom m_step_fn opts out)."""
+def _dense_fast_problem(seed, *, k=4, v=96, b=16, l=8, mask=None,
+                        wmajor=False, **runner_kw):
+    """Shared scaffold for the fast-vs-stock equivalence tests:
+    synthetic (log_beta, groups) plus a (fast, stock) runner pair.
+    The stock (generic) impl is summoned by passing an m_step wrapper
+    the fast path's `is` eligibility check cannot recognize — exactly
+    how a custom m_step_fn opts out in production."""
     import jax.numpy as jnp
 
     from oni_ml_tpu.models import fused
     from oni_ml_tpu.ops import dense_estep, estep
 
-    rng = np.random.default_rng(5)
-    k, v, b, l = 4, 96, 16, 8
+    rng = np.random.default_rng(seed)
     noise = rng.uniform(size=(k, v)) + 1.0 / v
     log_beta = jnp.asarray(
         np.log(noise / noise.sum(-1, keepdims=True)), jnp.float32
@@ -112,16 +112,33 @@ def test_dense_fast_path_matches_stock_chunk_runner():
     widx = jnp.asarray(rng.integers(0, v, size=(b, l)), jnp.int32)
     cnts = jnp.asarray(rng.integers(1, 5, size=(b, l)), jnp.float32)
     dense = dense_estep.densify(widx, cnts, v)
-    groups = ((dense[None], jnp.ones((1, b), jnp.float32)),)
-
+    if wmajor:
+        dense = jnp.transpose(dense)           # [W, B]
+    m = (jnp.ones((b,), jnp.float32) if mask is None
+         else jnp.asarray(mask, jnp.float32))
+    groups = ((dense[None], m[None]),)
     kw = dict(
-        num_docs=b, num_topics=k, num_terms=v, chunk=3,
-        var_max_iters=8, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
-        warm_start=True,
+        num_topics=k, num_terms=v, var_tol=1e-6,
+        em_tol=0.0, estimate_alpha=True, dense_wmajor=wmajor,
     )
+    kw.update(runner_kw)
+    kw.setdefault("var_max_iters", 8)
+    kw.setdefault("num_docs", b)
     fast = fused.make_chunk_runner(**kw)
     stock = fused.make_chunk_runner(
         m_step_fn=lambda ss: estep.m_step(ss), **kw
+    )
+    return log_beta, groups, fast, stock
+
+
+def test_dense_fast_path_matches_stock_chunk_runner():
+    """The single-dense-group exp-space fast path (run_chunk_impl_fast)
+    must match the generic impl — same likelihood trajectory, beta,
+    alpha, gammas — including across a warm chunk boundary."""
+    import jax.numpy as jnp
+
+    log_beta, groups, fast, stock = _dense_fast_problem(
+        5, chunk=3, warm_start=True
     )
 
     a0 = jnp.float32(2.5)
@@ -159,28 +176,8 @@ def test_dense_fast_path_matches_stock_wmajor():
     default on TPU)."""
     import jax.numpy as jnp
 
-    from oni_ml_tpu.models import fused
-    from oni_ml_tpu.ops import dense_estep, estep
-
-    rng = np.random.default_rng(9)
-    k, v, b, l = 4, 96, 16, 8
-    noise = rng.uniform(size=(k, v)) + 1.0 / v
-    log_beta = jnp.asarray(
-        np.log(noise / noise.sum(-1, keepdims=True)), jnp.float32
-    )
-    widx = jnp.asarray(rng.integers(0, v, size=(b, l)), jnp.int32)
-    cnts = jnp.asarray(rng.integers(1, 5, size=(b, l)), jnp.float32)
-    dense_t = jnp.transpose(dense_estep.densify(widx, cnts, v))  # [W, B]
-    groups = ((dense_t[None], jnp.ones((1, b), jnp.float32)),)
-
-    kw = dict(
-        num_docs=b, num_topics=k, num_terms=v, chunk=4,
-        var_max_iters=8, var_tol=1e-6, em_tol=0.0, estimate_alpha=True,
-        warm_start=True, dense_wmajor=True,
-    )
-    fast = fused.make_chunk_runner(**kw)
-    stock = fused.make_chunk_runner(
-        m_step_fn=lambda ss: estep.m_step(ss), **kw
+    log_beta, groups, fast, stock = _dense_fast_problem(
+        9, chunk=4, warm_start=True, wmajor=True
     )
     a0, nan = jnp.float32(2.5), jnp.float32(np.nan)
     rf = fast(log_beta, a0, nan, groups, 4)
@@ -189,3 +186,41 @@ def test_dense_fast_path_matches_stock_wmajor():
     np.testing.assert_allclose(rf.lls, rs.lls, rtol=1e-5)
     np.testing.assert_allclose(rf.log_beta, rs.log_beta, atol=1e-4)
     np.testing.assert_allclose(rf.alpha, rs.alpha, rtol=1e-5)
+
+
+def test_dense_fast_path_masked_docs_and_cold_start():
+    """Edge shapes through the fast path: padded (masked-out) documents
+    must not contribute to beta/likelihood, and warm_start=False must
+    match the stock impl with no gamma carry."""
+    import jax.numpy as jnp
+
+    from oni_ml_tpu.ops import dense_estep
+
+    mask = [1, 1, 1, 1, 1, 0, 0, 0]
+    log_beta, groups, fast, stock = _dense_fast_problem(
+        13, k=3, v=64, b=8, l=6, mask=mask, chunk=3,
+        var_max_iters=6, warm_start=False, num_docs=5,
+    )
+    a0, nan = jnp.float32(2.5), jnp.float32(np.nan)
+    rf = fast(log_beta, a0, nan, groups, 3)
+    rs = stock(log_beta, a0, nan, groups, 3)
+    np.testing.assert_allclose(rf.lls, rs.lls, rtol=1e-5)
+    np.testing.assert_allclose(rf.log_beta, rs.log_beta, atol=1e-4)
+    np.testing.assert_allclose(rf.alpha, rs.alpha, rtol=1e-5)
+
+    # Masked docs truly inert: rerunning with the masked rows' counts
+    # scrambled must not change beta or the likelihood trajectory.
+    rng = np.random.default_rng(99)
+    c_arr = np.asarray(
+        rng.integers(1, 4, size=(8, 6)), np.float32
+    )  # any counts; only rows 5+ differ between the two runs
+    w_arr = np.asarray(rng.integers(0, 64, size=(8, 6)), np.int32)
+    d1 = dense_estep.densify(jnp.asarray(w_arr), jnp.asarray(c_arr), 64)
+    c2 = c_arr.copy()
+    c2[5:] = rng.integers(10, 50, size=(3, 6))
+    d2 = dense_estep.densify(jnp.asarray(w_arr), jnp.asarray(c2), 64)
+    m = jnp.asarray(mask, jnp.float32)
+    ra = fast(log_beta, a0, nan, ((d1[None], m[None]),), 3)
+    rb = fast(log_beta, a0, nan, ((d2[None], m[None]),), 3)
+    np.testing.assert_allclose(rb.lls, ra.lls, rtol=1e-6)
+    np.testing.assert_allclose(rb.log_beta, ra.log_beta, atol=1e-6)
