@@ -224,3 +224,43 @@ def test_dense_fast_path_masked_docs_and_cold_start():
     rb = fast(log_beta, a0, nan, ((d2[None], m[None]),), 3)
     np.testing.assert_allclose(rb.lls, ra.lls, rtol=1e-6)
     np.testing.assert_allclose(rb.log_beta, ra.log_beta, atol=1e-6)
+
+
+@pytest.mark.parametrize("seed,k,v,b,l,warm", [
+    (21, 3, 100, 8, 5, True),    # v below the tile (pads 100 -> 128)
+    (22, 2, 128, 8, 11, False),  # v exactly on the 128-lane tile
+    (23, 5, 200, 16, 7, True),   # v off-tile (pads to 256), odd K
+    (24, 6, 32, 32, 4, False),   # tiny model, wider batch, cold start
+])
+def test_dense_fast_path_fuzz_shapes(seed, k, v, b, l, warm):
+    """Shape sweep through the fast-vs-stock equivalence — guards
+    padding-width interactions (v on/off the 128-lane tile), odd K,
+    and both warm/cold starts at shapes the fixed tests don't hit.
+    (B < 8 is NOT in the sweep: the kernel's doc block needs 8
+    sublanes — pinned as a clean refusal below.)"""
+    import jax.numpy as jnp
+
+    log_beta, groups, fast, stock = _dense_fast_problem(
+        seed, k=k, v=v, b=b, l=l, chunk=2, warm_start=warm,
+    )
+    a0, nan = jnp.float32(2.5), jnp.float32(np.nan)
+    rf = fast(log_beta, a0, nan, groups, 2)
+    rs = stock(log_beta, a0, nan, groups, 2)
+    np.testing.assert_allclose(rf.lls, rs.lls, rtol=1e-5)
+    np.testing.assert_allclose(rf.log_beta, rs.log_beta, atol=1e-4)
+    np.testing.assert_allclose(rf.alpha, rs.alpha, rtol=1e-5)
+
+
+def test_dense_fast_path_sub8_batch_refuses_cleanly():
+    """The dense kernel's doc block needs 8 sublanes, so a B=4 dense
+    group must fail with the explicit no-VMEM-feasible-block error —
+    not silently mis-tile.  (In production the trainer's dense gates
+    check feasibility per batch and route such shapes to the sparse
+    engine before any dense group exists.)"""
+    import jax.numpy as jnp
+
+    log_beta, groups, fast, _ = _dense_fast_problem(
+        25, k=3, v=64, b=4, l=4, chunk=2, warm_start=False,
+    )
+    with pytest.raises(ValueError, match="no VMEM-feasible doc block"):
+        fast(log_beta, jnp.float32(2.5), jnp.float32(np.nan), groups, 2)
