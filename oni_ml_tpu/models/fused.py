@@ -37,6 +37,15 @@ from ..io import Batch
 from ..ops import estep
 
 
+# Which chunk impl the most recent run_chunk TRACE selected ("fast" |
+# "generic"; None before any trace).  Observability only — the two
+# impls are equivalence-pinned, so without this marker a regression
+# that silently stopped the fast path from ENGAGING (an eligibility
+# check drifting) would pass every correctness test while costing the
+# headline its glue win.  tests/test_fused.py pins engagement.
+LAST_CHUNK_PLAN = None
+
+
 class StackedGroups(NamedTuple):
     """Shape-grouped batches, stacked for `lax.scan`.
 
@@ -589,11 +598,14 @@ def make_chunk_runner(
 
     def run_chunk_dispatch(log_beta, alpha, ll_prev, groups, n_steps,
                            gammas_in=None, have_prev=None) -> ChunkResult:
+        global LAST_CHUNK_PLAN
         if _is_single_dense(groups):
+            LAST_CHUNK_PLAN = "fast"
             return run_chunk_impl_fast(
                 log_beta, alpha, ll_prev, groups, n_steps,
                 gammas_in=gammas_in, have_prev=have_prev,
             )
+        LAST_CHUNK_PLAN = "generic"
         return run_chunk_impl(
             log_beta, alpha, ll_prev, groups, n_steps,
             gammas_in=gammas_in, have_prev=have_prev,

@@ -264,3 +264,26 @@ def test_dense_fast_path_sub8_batch_refuses_cleanly():
     )
     with pytest.raises(ValueError, match="no VMEM-feasible doc block"):
         fast(log_beta, jnp.float32(2.5), jnp.float32(np.nan), groups, 2)
+
+
+def test_fast_path_engages_for_production_dense_shape(problem, monkeypatch):
+    """The trainer's forced-dense single-group path must actually
+    SELECT the fast impl (fused.LAST_CHUNK_PLAN) — the equivalence
+    tests alone can't catch an eligibility regression that silently
+    reroutes every production run to the generic impl."""
+    from oni_ml_tpu.models import fused
+
+    cfg = dict(num_topics=4, alpha_init=2.5, seed=3, em_max_iters=2,
+               em_tol=0.0, fused_em_chunk=2, batch_size=64,
+               min_bucket_len=64)  # batch >= docs: one dense group
+
+    monkeypatch.setenv("ONI_ML_TPU_ESTEP", "dense")
+    fused.LAST_CHUNK_PLAN = None
+    train_corpus(problem, LDAConfig(**cfg))
+    assert fused.LAST_CHUNK_PLAN == "fast"
+
+    # The compact engine (3-tuple groups) must stay on the generic impl.
+    monkeypatch.setenv("ONI_ML_TPU_ESTEP", "compact")
+    fused.LAST_CHUNK_PLAN = None
+    train_corpus(problem, LDAConfig(**cfg))
+    assert fused.LAST_CHUNK_PLAN == "generic"
