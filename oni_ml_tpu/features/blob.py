@@ -35,6 +35,14 @@ class MmapBlob:
 
     def __init__(self, path: str):
         self.path = path
+        # Size at spill time, carried through the pickle: the runner's
+        # post-move re-resolution uses it as an identity check, so a
+        # stale same-named spill from an earlier interrupted run in a
+        # copied day dir cannot be silently scored against mismatched
+        # offsets (round-4 advisor finding).
+        self.size: int | None = (
+            os.path.getsize(path) if os.path.exists(path) else None
+        )
         self._arr: np.ndarray | None = None
 
     def _a(self) -> np.ndarray:
@@ -61,10 +69,11 @@ class MmapBlob:
         return a.ctypes.data_as(ctypes.c_char_p)
 
     def __getstate__(self):
-        return {"path": self.path}
+        return {"path": self.path, "size": self.size}
 
     def __setstate__(self, state):
         self.path = state["path"]
+        self.size = state.get("size")  # pre-round-5 pickles lack it
         self._arr = None
 
 

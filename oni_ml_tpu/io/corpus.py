@@ -178,12 +178,28 @@ def make_batches(
     batch_size: int,
     min_bucket_len: int = 16,
     pad_batch_to_multiple: bool = True,
+    pad_multiple: "int | None" = None,
 ) -> list[Batch]:
     """Bucket docs by unique-word count, pad to (batch_size, bucket_len).
 
     Returns batches ordered by bucket then position; the union of doc_index
     over all batches (where doc_mask == 1) is exactly range(num_docs).
+
+    With `pad_multiple` set, an under-full bucket pads its batch axis
+    to the next multiple of it instead of the full `batch_size` (full
+    buckets still pad to batch_size for shape reuse across chunks).
+    Under a power-law doc-length distribution (realistic config-3
+    corpora: a few hot IPs with huge documents) the tail buckets hold
+    a handful of docs each, and padding those to [batch_size,
+    bucket_len] costs batch_size/len(docs) times the E-step compute
+    and memory for nothing.  `pad_multiple` must be divisible by the
+    mesh's data axis so every batch remains shardable — train_corpus /
+    train_corpus_online thread it from their mesh; the None default
+    keeps the old full-batch_size padding, so direct callers that
+    shard over meshes this module can't see stay correct.
     """
+    if pad_multiple is None:
+        pad_multiple = batch_size
     lengths = corpus.doc_lengths()
     buckets: dict[int, list[int]] = {}
     for d in range(corpus.num_docs):
@@ -195,9 +211,11 @@ def make_batches(
     batches: list[Batch] = []
     for L in sorted(buckets):
         docs = buckets[L]
+        bucket_b = min(batch_size,
+                       -(-len(docs) // pad_multiple) * pad_multiple)
         for start in range(0, len(docs), batch_size):
             chunk = docs[start : start + batch_size]
-            B = batch_size if pad_batch_to_multiple else len(chunk)
+            B = bucket_b if pad_batch_to_multiple else len(chunk)
             widx = np.zeros((B, L), dtype=np.int32)
             cnts = np.zeros((B, L), dtype=np.float32)
             didx = np.zeros((B,), dtype=np.int32)

@@ -523,8 +523,12 @@ def make_chunk_runner(
     # assembly — all XLA glue the perf decomposition charges to the
     # ~0.9 ms/EM-iteration fixed cost.  Log-space beta is reconstructed
     # ONCE at the chunk boundary; log(ss / total) differs from m_step's
-    # log(ss) - log(total) by at most 1 ulp (same floor: entries with
-    # zero mass pin to LOG_ZERO exactly).
+    # log(ss) - log(total) by at most 1 ulp for quotients down to
+    # exp(-100); BELOW that window (ss/total < ~3.8e-44, where m_step
+    # would emit log values in about (-103, -100]) the reconstruction
+    # clamps to LOG_ZERO — a deliberate floor on probabilities ~1e-44,
+    # covered by the 1e-5-rtol equivalence pins (tests/test_fused.py).
+    # Entries with exactly zero mass pin to LOG_ZERO in both paths.
     dense_fast_ok = m_fn is estep.m_step and dense_e_step_fn is None
 
     def _is_single_dense(groups) -> bool:

@@ -990,7 +990,9 @@ def train_corpus(
             e_fn = sharded.make_data_parallel_e_step(mesh)
 
     batches = make_batches(
-        corpus, batch_size=config.batch_size, min_bucket_len=config.min_bucket_len
+        corpus, batch_size=config.batch_size,
+        min_bucket_len=config.min_bucket_len,
+        pad_multiple=mesh.shape[DATA_AXIS] if mesh is not None else 8,
     )
     trainer = LDATrainer(
         config,

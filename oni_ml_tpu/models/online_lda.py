@@ -610,7 +610,9 @@ def train_corpus_online(
     from ..io import make_batches
 
     batches = make_batches(
-        corpus, batch_size=config.batch_size, min_bucket_len=config.min_bucket_len
+        corpus, batch_size=config.batch_size,
+        min_bucket_len=config.min_bucket_len,
+        pad_multiple=(mesh.shape["data"] if mesh is not None else 8),
     )
     ckpt_path = (
         os.path.join(out_dir, "checkpoint.npz")

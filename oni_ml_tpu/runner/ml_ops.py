@@ -465,6 +465,25 @@ def stage_score(ctx: RunContext) -> dict:
             continue  # recorded path valid: never silently substitute
         local = ctx.path(os.path.basename(blob.path))
         if os.path.exists(local):
+            # Identity check before adopting: a same-named spill of a
+            # DIFFERENT size (stale leftover of an earlier interrupted
+            # run in a copied day dir) would be scored against
+            # mismatched row offsets — wrong lines, not an error
+            # (round-4 advisor finding).  Size at spill time rides in
+            # the pickle; pre-round-5 pickles lack it and keep the
+            # old adopt-by-name behavior.
+            want = getattr(blob, "size", None)
+            have = os.path.getsize(local)
+            if want is not None and have != want:
+                raise FileNotFoundError(
+                    f"features.pkl references spilled raw rows at "
+                    f"{blob.path} ({want} bytes at pre time); this day "
+                    f"directory ({ctx.day_dir}) has a same-named "
+                    f"{os.path.basename(blob.path)} of {have} bytes — "
+                    "a stale spill from a different run, refusing to "
+                    "score against mismatched offsets; re-run the pre "
+                    "stage (--stages pre --force)"
+                )
             blob.path = local
         else:
             raise FileNotFoundError(

@@ -155,6 +155,44 @@ def test_make_batches_covers_all_docs():
     assert sorted(seen) == list(range(c.num_docs))
 
 
+def test_make_batches_underfull_bucket_not_padded_to_batch_size():
+    """A bucket holding far fewer docs than batch_size pads its batch
+    axis to the next pad_multiple, not the full batch_size: under a
+    power-law doc-length distribution (realistic config-3 corpora) the
+    huge-doc tail buckets hold a handful of docs each, and full padding
+    would multiply their E-step compute and memory by
+    batch_size/len(bucket) for nothing (round 5)."""
+    triples = []
+    for d in range(3):                      # 3 huge docs -> bucket 128
+        for w in range(100):
+            triples.append((f"big{d}", f"w{w}", 1))
+    for d in range(2000):                   # 2000 small docs -> bucket 16
+        triples.append((f"s{d}", f"w{d % 100}", 1))
+        triples.append((f"s{d}", f"w{(d + 1) % 100}", 1))
+    c = Corpus.from_word_counts(triples)
+    batches = make_batches(c, batch_size=1024, min_bucket_len=16,
+                           pad_multiple=8)
+    by_len = {}
+    for b in batches:
+        by_len.setdefault(b.bucket_len, []).append(b)
+    (big,) = by_len[128]
+    assert big.word_idx.shape == (8, 128)   # NOT (1024, 128)
+    small = by_len[16]
+    assert small[0].word_idx.shape == (1024, 16)  # full bucket: reuse
+    for b in batches:                       # stays data-axis shardable
+        assert b.word_idx.shape[0] % 8 == 0
+    seen = sorted(
+        i for b in batches for i in b.doc_index[b.doc_mask == 1].tolist()
+    )
+    assert seen == list(range(c.num_docs))  # coverage invariant holds
+
+    # Without pad_multiple the old full-batch_size padding stands:
+    # direct callers that shard over meshes this module can't see
+    # (e.g. a 16-wide data axis) must not regress to B=8 batches.
+    default = make_batches(c, batch_size=1024, min_bucket_len=16)
+    assert all(b.word_idx.shape[0] == 1024 for b in default)
+
+
 def test_non_utf8_round_trips_python_reader(tmp_path):
     """Hostile raw wire bytes must survive the word_counts -> corpus ->
     words.dat round trip via surrogateescape in the pure-Python reader

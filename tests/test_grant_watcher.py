@@ -1,0 +1,105 @@
+"""tools/grant_watcher.py trigger logic (no TPU, no real time).
+
+The watcher's value is its DECISIONS — when to fire chip_session, what
+each exit code means for re-arming, how capture files are named — so
+those are tested pure, with probe/capture/sleep injected.
+"""
+
+import fnmatch
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools"),
+)
+
+import grant_watcher
+
+
+def test_next_action_contract():
+    # rc 0: mission complete, stop regardless of budget.
+    assert grant_watcher.next_action(0, 1, 3)[0] == "stop"
+    # rc 1 under budget: re-arm at normal cadence.
+    assert grant_watcher.next_action(1, 1, 3) == ("rearm", 1.0)
+    # rc 2 under budget: re-arm gentler (doubled interval).
+    assert grant_watcher.next_action(2, 1, 3) == ("rearm", 2.0)
+    # Budget exhausted: stop even on red/wedged.
+    assert grant_watcher.next_action(1, 3, 3)[0] == "stop"
+    assert grant_watcher.next_action(2, 3, 3)[0] == "stop"
+    # A capture runner that itself died (rc None) still consumes budget
+    # and re-arms gently rather than crashing the policy.
+    assert grant_watcher.next_action(None, 1, 3)[0] == "rearm"
+
+
+def test_capture_paths_unique_and_glob_compatible(tmp_path):
+    p1 = grant_watcher.capture_out_path("r05", 1, str(tmp_path))
+    p2 = grant_watcher.capture_out_path("r05", 2, str(tmp_path))
+    assert p1 != p2
+    # Both must match the glob bench._last_good_record() reads, so any
+    # attempt's record is visible to later failure records.
+    for p in (p1, p2):
+        assert fnmatch.fnmatch(os.path.basename(p),
+                               "r*_session_capture.json")
+    assert os.path.basename(p1) == "r05_session_capture.json"
+    assert os.path.basename(p2) == "r05a2_session_capture.json"
+
+
+def test_round_tag_derived_from_bench_records(tmp_path):
+    assert grant_watcher.current_round_tag(str(tmp_path)) == "r01"
+    (tmp_path / "BENCH_r04.json").write_text("{}")
+    assert grant_watcher.current_round_tag(str(tmp_path)) == "r05"
+
+
+def _run_watch(probe_results, capture_rcs, **kw):
+    """Drive watch() with scripted probe/capture outcomes; record the
+    capture paths and sleep intervals it chooses."""
+    probes = iter(probe_results)
+    rcs = iter(capture_rcs)
+    events = {"captures": [], "sleeps": []}
+
+    def probe(timeout):
+        return next(probes)
+
+    def capture(out):
+        events["captures"].append(os.path.basename(out))
+        return next(rcs)
+
+    def sleep(s):
+        events["sleeps"].append(s)
+
+    rc = grant_watcher.watch(
+        interval_s=100.0, max_captures=3, round_tag="r05",
+        probe=probe, capture=capture, sleep=sleep, log=lambda m: None,
+        **kw)
+    return rc, events
+
+
+def test_watch_fires_on_first_alive_probe_and_stops_on_green():
+    rc, ev = _run_watch([None, None, 1], [0])
+    assert rc == 0
+    assert ev["captures"] == ["r05_session_capture.json"]
+    # Two dead probes slept at the base interval before the grant came.
+    assert ev["sleeps"] == [100.0, 100.0]
+
+
+def test_watch_rearms_after_wedge_with_longer_backoff():
+    # Wedged capture (rc 2) -> doubled interval; next alive probe fires
+    # attempt 2 under its own name; green stops the loop.
+    rc, ev = _run_watch([1, 1], [2, 0])
+    assert rc == 0
+    assert ev["captures"] == ["r05_session_capture.json",
+                              "r05a2_session_capture.json"]
+    assert ev["sleeps"] == [200.0]      # the post-wedge sleep doubled
+
+
+def test_watch_budget_exhaustion_returns_nonzero():
+    rc, ev = _run_watch([1, 1, 1], [1, 1, 1])
+    assert rc == 1
+    assert len(ev["captures"]) == 3     # budget respected, then stop
+
+
+def test_watch_once_mode_single_decision():
+    rc, ev = _run_watch([None], [], once=True)
+    assert rc == 1 and ev["captures"] == [] and ev["sleeps"] == []

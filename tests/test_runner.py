@@ -419,6 +419,34 @@ def test_moved_day_dir_rescore(flow_day):
         == results
 
 
+def test_moved_day_dir_stale_spill_refused(flow_day):
+    """Re-resolution adopts a same-named spill ONLY when its size
+    matches the one recorded at pre time: a stale raw_lines.bin left
+    behind by an earlier interrupted run in a copied day dir would
+    otherwise be silently scored against mismatched row offsets —
+    wrong lines, not an error (round-4 advisor finding)."""
+    import dataclasses
+    import shutil
+
+    from oni_ml_tpu.features import native_flow
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    if not native_flow.available():
+        pytest.skip("native flow featurizer unavailable")
+    cfg, tmp_path = flow_day
+    run_pipeline(cfg, "20160122", "flow", force=True)
+    new_root = tmp_path.parent / (tmp_path.name + "_moved2")
+    shutil.move(str(tmp_path), str(new_root))
+    tmp_path.mkdir()  # keep the fixture's dir alive for pytest cleanup
+    day = new_root / "20160122"
+    spill = day / "raw_lines.bin"
+    spill.write_bytes(spill.read_bytes() + b"stale trailing garbage\n")
+    (day / "flow_results.csv").unlink()
+    cfg2 = dataclasses.replace(cfg, data_dir=str(new_root))
+    with pytest.raises(FileNotFoundError, match="stale spill"):
+        run_pipeline(cfg2, "20160122", "flow", stages=["score"])
+
+
 def test_eval_holdout_true_held_out_split(flow_day):
     """--eval-holdout: beta trains on the hash-split remainder, the
     excluded docs' per-token ll is recorded, and the file contract is

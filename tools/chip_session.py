@@ -20,12 +20,33 @@ Sequence (each step its own subprocess; a wedge costs one step):
                                  failure record) is saved as the
                                  session capture
 
-The bench step's outer timeout (16000 s) deliberately exceeds
-bench.py's own worst-case watchdog budget (~14,200 s with every device
-phase wedging) — the inner watchdog must lose to nothing, so its
-best-known record or structured failure line is always emitted and
-captured.  Timeouts SIGTERM with a grace window (never SIGKILL first —
-round-3 post-mortem: a SIGKILL mid-claim likely killed the relay).
+Per-step timing: each step gets BOOT_GRACE_S to produce its FIRST
+output byte (a python child in this image takes ~5 s just to boot —
+sitecustomize imports jax — which used to eat short budgets before the
+step's first print; round-4 flaky-test finding), then its own timeout
+counts.  The bench step's outer timeout is derived from bench.py's own
+worst-case watchdog budget plus margin — the inner watchdog must lose
+to nothing, so its best-known record or structured failure line is
+always emitted and captured, even when an operator raises BENCH_GATE_S
+(round-4 advisor finding: the old hard-coded 16000 s silently inverted
+that ordering).  Timeouts SIGTERM with a grace window (never SIGKILL
+first — round-3 post-mortem: a SIGKILL mid-claim likely killed the
+relay).
+
+Exit-code contract (what tools/grant_watcher.py keys its re-arm
+policy on — VERDICT r4 item 9):
+  0  every step green: the capture is complete; the watcher's mission
+     is over and it should STOP.
+  1  every step ran to COMPLETION but at least one exited nonzero
+     (e.g. bench emitted its structured failure record, or a probe
+     script failed): the log and capture file still hold everything
+     produced.  The chip answered the trigger probe but did not
+     survive the full sequence — the watcher should RE-ARM at its
+     normal cadence, bounded by its capture budget.
+  2  at least one step WEDGED (hit its timeout and was TERMed): the
+     grant likely died mid-step.  The watcher should RE-ARM with a
+     LONGER back-off — rapid retries have been observed to re-wedge a
+     recovering grant.
 """
 
 import argparse
@@ -36,21 +57,44 @@ import sys
 import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+# Time allowed for a step's interpreter to boot and produce its first
+# output byte before the step's own timeout clock starts.
+BOOT_GRACE_S = 60.0
+# Margin on top of bench's self-computed worst case for subprocess
+# spawn/teardown and the salvage grace window.
+BENCH_TIMEOUT_MARGIN_S = 1800.0
+
+
+def _bench_timeout_s() -> float:
+    """Outer timeout for the bench step, derived from bench's own
+    worst-case watchdog budget (and BENCH_BUDGET_S, when an operator
+    pins one) so the outer clock always loses to the inner watchdog."""
+    import bench
+
+    budget = float(os.environ.get("BENCH_BUDGET_S",
+                                  bench.worst_case_budget_s()))
+    return budget + BENCH_TIMEOUT_MARGIN_S
+
 
 STEPS = [
     ("tpu_smoke", [sys.executable, os.path.join(HERE, "tools", "tpu_smoke.py")], 600),
     ("tpu_probes", [sys.executable, os.path.join(HERE, "tools", "tpu_probes.py")], 2400),
-    ("bench", [sys.executable, os.path.join(HERE, "bench.py")], 16000),
+    ("bench", [sys.executable, os.path.join(HERE, "bench.py")], _bench_timeout_s()),
 ]
 
 
-def run_step(name, cmd, timeout, logf):
+def run_step(name, cmd, timeout, logf, boot_grace=BOOT_GRACE_S):
     """Run one step with stdout+stderr appended to `logf` AS PRODUCED.
-    Returns (lines, rc, wall): lines is whatever the step wrote to
-    stdout-tail of the log — present even on nonzero rc or timeout
-    (partial probe output and bench's structured failure record must
-    survive; round-4 review finding)."""
-    print(f"chip_session: === {name} (timeout {timeout}s) ===", flush=True)
+    `timeout` counts from the step's first output byte (or from
+    boot-grace expiry, whichever comes first), so interpreter boot
+    under load cannot eat a short budget.  Returns (lines, rc, wall):
+    lines is whatever the step wrote to the stdout-tail of the log —
+    present even on nonzero rc or timeout (partial probe output and
+    bench's structured failure record must survive; round-4 review
+    finding).  rc is None when the step wedged (timed out)."""
+    print(f"chip_session: === {name} (timeout {timeout:.0f}s) ===", flush=True)
     logf.write(f"--- {name} @ {time.strftime('%F %T')} ---\n")
     logf.flush()
     start_pos = logf.tell()
@@ -58,6 +102,14 @@ def run_step(name, cmd, timeout, logf):
     proc = subprocess.Popen(cmd, stdout=logf, stderr=logf, text=True,
                             cwd=HERE)
     rc = None
+    # Boot phase: wait for the first output byte (the child writes to
+    # the log fd directly, so file growth == first output) or for the
+    # grace to expire, whichever is first.
+    boot_deadline = t0 + boot_grace
+    while proc.poll() is None and time.time() < boot_deadline:
+        if os.path.getsize(logf.name) > start_pos:
+            break
+        time.sleep(0.05)
     try:
         rc = proc.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -67,7 +119,8 @@ def run_step(name, cmd, timeout, logf):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()
-        print(f"chip_session: {name} TIMED OUT after {timeout}s", flush=True)
+        print(f"chip_session: {name} TIMED OUT after {timeout:.0f}s",
+              flush=True)
     wall = time.time() - t0
     logf.flush()
     with open(logf.name) as f:
@@ -80,15 +133,22 @@ def run_step(name, cmd, timeout, logf):
 
 
 def main() -> int:
+    # Default capture name derives from the round in progress (one past
+    # the newest BENCH_r*.json) — a hard-coded rNN literal would make a
+    # next-round manual run silently overwrite THIS round's capture.
+    import grant_watcher
+
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--out",
-        default=os.path.join(HERE, "docs", "bench_captures",
-                             "r04_session_capture.json"),
+        default=os.path.join(
+            HERE, "docs", "bench_captures",
+            f"{grant_watcher.current_round_tag()}_session_capture.json"),
     )
     args = ap.parse_args()
     log_path = args.out + ".log"
     green = 0
+    wedged = 0
     with open(log_path, "a+") as logf:
         logf.write(f"\n=== chip_session {time.strftime('%F %T')} ===\n")
         for name, cmd, timeout in STEPS:
@@ -96,6 +156,7 @@ def main() -> int:
             logf.write(f"[{name}] wall={wall:.0f}s rc={rc}\n")
             logf.flush()
             green += rc == 0
+            wedged += rc is None
             if name == "bench":
                 # The LAST parseable JSON line is the record — a
                 # success payload or the structured failure line
@@ -113,9 +174,11 @@ def main() -> int:
                             flush=True,
                         )
                         break
-    print(f"chip_session: {green}/{len(STEPS)} steps green; log: "
-          f"{log_path}", flush=True)
-    return 0 if green == len(STEPS) else 1
+    print(f"chip_session: {green}/{len(STEPS)} steps green "
+          f"({wedged} wedged); log: {log_path}", flush=True)
+    if green == len(STEPS):
+        return 0
+    return 2 if wedged else 1
 
 
 if __name__ == "__main__":
