@@ -450,6 +450,12 @@ def _write_flow_day(f, n_events, n_src=4000, n_dst=2000, seed=11,
     if ip_zipf_a is not None:
         src_cdf = _powerlaw_cdf(n_src, ip_zipf_a)
         dst_cdf = _powerlaw_cdf(n_dst, ip_zipf_a)
+    # The 2-octet encodings overflow (non-IP strings like 10.0.1367.44)
+    # past 65536 hosts, so the wide disjoint spaces engage for ANY mode
+    # whose population needs them — uniform draws with a large --n-src
+    # included, not just power-law mode (round-5 review finding).  The
+    # default populations keep the byte-identical round-1..4 stream.
+    if ip_zipf_a is not None or n_src > 65536 or n_dst > 65536:
 
         def fmt_src(v):
             return f"10.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"

@@ -461,29 +461,36 @@ def stage_score(ctx: RunContext) -> dict:
         blob = getattr(features, attr, None)
         if blob is None or not hasattr(blob, "path"):
             continue
+
+        def _check_size(path):
+            # Identity check before trusting ANY candidate — recorded
+            # or re-resolved: a spill of a DIFFERENT size than the one
+            # features.pkl was written against (stale leftover of an
+            # earlier run in a copied day dir, or a partial rewrite
+            # from an interrupted pre re-run at the recorded path)
+            # would be scored against mismatched row offsets — wrong
+            # lines, not an error (round-4 advisor finding; round-5
+            # review widened it to the recorded path).  Size at spill
+            # time rides in the pickle; pre-round-5 pickles lack it
+            # and keep the old adopt-by-name behavior.
+            want = getattr(blob, "size", None)
+            have = os.path.getsize(path)
+            if want is not None and have != want:
+                raise FileNotFoundError(
+                    f"features.pkl references spilled raw rows of "
+                    f"{want} bytes (size at pre time); {path} holds "
+                    f"{have} bytes — a stale or partial spill from a "
+                    "different run, refusing to score against "
+                    "mismatched offsets; re-run the pre stage "
+                    "(--stages pre --force)"
+                )
+
         if os.path.exists(blob.path):
+            _check_size(blob.path)
             continue  # recorded path valid: never silently substitute
         local = ctx.path(os.path.basename(blob.path))
         if os.path.exists(local):
-            # Identity check before adopting: a same-named spill of a
-            # DIFFERENT size (stale leftover of an earlier interrupted
-            # run in a copied day dir) would be scored against
-            # mismatched row offsets — wrong lines, not an error
-            # (round-4 advisor finding).  Size at spill time rides in
-            # the pickle; pre-round-5 pickles lack it and keep the
-            # old adopt-by-name behavior.
-            want = getattr(blob, "size", None)
-            have = os.path.getsize(local)
-            if want is not None and have != want:
-                raise FileNotFoundError(
-                    f"features.pkl references spilled raw rows at "
-                    f"{blob.path} ({want} bytes at pre time); this day "
-                    f"directory ({ctx.day_dir}) has a same-named "
-                    f"{os.path.basename(blob.path)} of {have} bytes — "
-                    "a stale spill from a different run, refusing to "
-                    "score against mismatched offsets; re-run the pre "
-                    "stage (--stages pre --force)"
-                )
+            _check_size(local)
             blob.path = local
         else:
             raise FileNotFoundError(

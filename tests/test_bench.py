@@ -118,6 +118,18 @@ def test_bench_flow_day_realistic_cardinality():
     assert all(ln.split(",")[8].startswith("10.0.") for ln in l2)
     assert all(ln.split(",")[9].startswith("10.1.") for ln in l2)
 
+    # Uniform mode with a >65536 population must use the wide encoding
+    # too — the 2-octet form would silently emit non-IP strings like
+    # 10.0.1367.44 (round-5 review finding).
+    buf3 = io.StringIO()
+    bench._write_flow_day(buf3, 2_000, n_src=200_000, n_dst=1_000,
+                          seed=5)
+    for ln in buf3.getvalue().strip().splitlines():
+        for col in (8, 9):
+            octets = ln.split(",")[col].split(".")
+            assert len(octets) == 4
+            assert all(0 <= int(o) <= 255 for o in octets)
+
 
 def test_bench_dns_scoring_smoke():
     import bench

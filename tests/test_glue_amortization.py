@@ -1,0 +1,34 @@
+"""tools/glue_amortization.py mechanics at toy shapes.
+
+The tool's value is the measured table in docs/architecture.md (full
+shapes, quiet machine); here we pin that the harness runs the
+production chunk runner over the sharded E-step on the virtual mesh
+and produces a well-formed record.
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools"),
+)
+
+import glue_amortization
+
+
+def test_measure_structure_at_toy_shapes():
+    rec = glue_amortization.measure(
+        k=4, v=256, b=32, l=16, n_batches=(1, 2), chunk=2,
+        var_max_iters=3, rounds=1,
+    )
+    assert rec["metric"] == "glue_amortization_cpu_mesh"
+    assert [r["n_batches"] for r in rec["rows"]] == [1, 2]
+    for r in rec["rows"]:
+        assert r["t_iter_ms"] > 0
+        assert math.isclose(r["t_iter_per_batch_ms"],
+                            r["t_iter_ms"] / r["n_batches"], rel_tol=0.02)
+    assert math.isfinite(rec["fit_glue_ms"])
+    assert math.isfinite(rec["fit_per_batch_ms"])

@@ -28,9 +28,12 @@ def test_next_action_contract():
     # Budget exhausted: stop even on red/wedged.
     assert grant_watcher.next_action(1, 3, 3)[0] == "stop"
     assert grant_watcher.next_action(2, 3, 3)[0] == "stop"
-    # A capture runner that itself died (rc None) still consumes budget
-    # and re-arms gently rather than crashing the policy.
-    assert grant_watcher.next_action(None, 1, 3)[0] == "rearm"
+    # A capture runner that itself died — signal-killed (negative rc,
+    # e.g. OOM's -9) or no code at all — re-arms at the GENTLE cadence:
+    # the grant is likely sick, and rapid retries re-wedge it.
+    assert grant_watcher.next_action(None, 1, 3) == ("rearm", 2.0)
+    assert grant_watcher.next_action(-9, 1, 3) == ("rearm", 2.0)
+    assert grant_watcher.next_action(-15, 2, 3) == ("rearm", 2.0)
 
 
 def test_capture_paths_unique_and_glob_compatible(tmp_path):

@@ -443,8 +443,29 @@ def test_moved_day_dir_stale_spill_refused(flow_day):
     spill.write_bytes(spill.read_bytes() + b"stale trailing garbage\n")
     (day / "flow_results.csv").unlink()
     cfg2 = dataclasses.replace(cfg, data_dir=str(new_root))
-    with pytest.raises(FileNotFoundError, match="stale spill"):
+    with pytest.raises(FileNotFoundError, match="stale or partial"):
         run_pipeline(cfg2, "20160122", "flow", stages=["score"])
+
+
+def test_partial_spill_at_recorded_path_refused(flow_day):
+    """The size identity check guards the RECORDED path too, not only
+    the post-move re-resolution: a pre re-run interrupted mid-ingest
+    leaves a partial raw_lines.bin at the recorded path while the
+    complete run's features.pkl survives — scoring would silently read
+    wrong lines (round-5 review finding)."""
+    from oni_ml_tpu.features import native_flow
+    from oni_ml_tpu.runner.ml_ops import run_pipeline
+
+    if not native_flow.available():
+        pytest.skip("native flow featurizer unavailable")
+    cfg, tmp_path = flow_day
+    run_pipeline(cfg, "20160122", "flow", force=True)
+    day = tmp_path / "20160122"
+    spill = day / "raw_lines.bin"
+    spill.write_bytes(spill.read_bytes()[: spill.stat().st_size // 2])
+    (day / "flow_results.csv").unlink()
+    with pytest.raises(FileNotFoundError, match="stale or partial"):
+        run_pipeline(cfg, "20160122", "flow", stages=["score"])
 
 
 def test_eval_holdout_true_held_out_split(flow_day):

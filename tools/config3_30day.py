@@ -86,6 +86,17 @@ def main() -> int:
     if args.workdir:
         args.keep = True
 
+    # CPU-only by design (see module docstring): pin the platform
+    # BEFORE any backend init.  The session env points JAX at the TPU
+    # tunnel — with the axon hook bypassed the plugin is unregistered
+    # and the train stage crashes on backend init; with it active, a
+    # dead relay hangs every JAX op.  Both knobs, like tests/conftest:
+    # the env var alone does not stick when the hook already ran.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
     import bench
     from oni_ml_tpu.config import (
         FeedbackConfig, LDAConfig, PipelineConfig, ScoringConfig,
@@ -170,6 +181,14 @@ def main() -> int:
                     ll_lines = f.read().strip().splitlines()
                 rec["likelihood_rows"] = len(ll_lines)
                 rec["likelihood_last"] = ll_lines[-1] if ll_lines else None
+                if args.out:
+                    # The trajectory file IS the training evidence —
+                    # keep it beside the record (the workdir is
+                    # deleted unless --keep).
+                    shutil.copyfile(
+                        ll_path,
+                        os.path.splitext(args.out)[0] + "_likelihood.dat",
+                    )
 
         # ru_maxrss is KiB on Linux: binary factor, not decimal
         # (round-4 review finding: /1e6 understated the GB by 2.4%).

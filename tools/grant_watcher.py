@@ -75,8 +75,12 @@ def next_action(rc: "int | None", captures_done: int,
     if captures_done >= max_captures:
         return ("stop",
                 f"capture budget exhausted ({captures_done} attempts)")
-    if rc == 2:
-        return ("rearm", 2.0)   # a step wedged: probe gentler
+    if rc is None or rc < 0 or rc == 2:
+        # rc 2 = a step wedged; negative = the runner itself was
+        # signal-killed (OOM, SIGKILL) mid-capture; None = it never
+        # returned a code.  All three say the grant is likely sick —
+        # probe gentler (rapid retries re-wedge a recovering grant).
+        return ("rearm", 2.0)
     return ("rearm", 1.0)       # completed but red: normal cadence
 
 
