@@ -79,7 +79,8 @@ def _sync(x):
 
 def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
               force_sparse=False, wmajor=True, warm_start=False,
-              precision="bf16", compact=False, word_law="uniform"):
+              precision="bf16", compact=False, word_law="uniform",
+              n_batches=1):
     """Shared corpus/dense-path/runner setup for the EM benches:
     returns (log_beta, groups, run_chunk, use_dense, used_wmajor,
     corpus_itemsize, gammas0, info).
@@ -91,29 +92,39 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
     routes such a batch through the compact-vocab dense engine
     (fused.compact_stack_batches semantics) when full-V dense is
     infeasible; `info` carries the compact width for the bench
-    record."""
+    record.
+
+    `n_batches` stacks that many B-doc batches resident (the day-scale
+    shape: the chunk runner scans the stack each EM iteration, so the
+    per-iteration fixed cost amortizes — tools/tpu_probes.py
+    batch_amort).  The default 1 draws the identical corpus as every
+    prior round, keeping phase numbers comparable."""
     import jax
     import jax.numpy as jnp
 
     from oni_ml_tpu.models import fused
     from oni_ml_tpu.ops import dense_estep
 
+    if compact and n_batches != 1:
+        raise ValueError("n_batches > 1 is not wired for the compact "
+                         "engine probe")
     rng = np.random.default_rng(0)
     noise = rng.uniform(size=(k, v)) + 1.0 / v
     log_beta = jnp.asarray(
         np.log(noise / noise.sum(-1, keepdims=True)), jnp.float32
     )
+    nb = n_batches
     if word_law == "loguniform":
         word_np = np.minimum(
-            v - 1, np.floor(v ** rng.uniform(size=(b, l)))
+            v - 1, np.floor(v ** rng.uniform(size=(nb, b, l)))
         ).astype(np.int32)
     else:
-        word_np = rng.integers(0, v, size=(b, l)).astype(np.int32)
+        word_np = rng.integers(0, v, size=(nb, b, l)).astype(np.int32)
     word_idx = jnp.asarray(word_np)
     counts = jnp.asarray(
-        rng.integers(1, 5, size=(b, l)).astype(np.float32)
+        rng.integers(1, 5, size=(nb, b, l)).astype(np.float32)
     )
-    doc_mask = jnp.ones((b,), jnp.float32)
+    doc_mask = jnp.ones((nb, b), jnp.float32)
 
     use_dense, use_wmajor, compiler_options = dense_estep.plan(
         b, v, k, precision, wmajor=wmajor
@@ -126,14 +137,16 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
     # Gate bf16 storage on the DENSIFIED cells (duplicate words in a
     # doc sum), exactly like the trainer.
     store = dense_estep.corpus_dtype(
-        dense_estep.max_dense_cell(word_idx, counts), precision
+        dense_estep.max_dense_cell(word_idx.reshape(-1, l),
+                                   counts.reshape(-1, l)), precision
     )
     plan = None
     if compact and not use_dense and not force_sparse:
         from oni_ml_tpu.io import Batch
 
-        batch0 = Batch(word_idx=word_np, counts=np.asarray(counts),
-                       doc_mask=np.asarray(doc_mask),
+        batch0 = Batch(word_idx=word_np[0],
+                       counts=np.asarray(counts)[0],
+                       doc_mask=np.asarray(doc_mask)[0],
                        doc_index=np.arange(b))
         plan = fused.plan_compact(
             [batch0], k, precision, wmajor=want_wmajor,
@@ -141,12 +154,12 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
         )
     if use_dense:
         corpus_itemsize = jnp.dtype(store).itemsize
-        dense = jax.jit(
+        dense = jax.jit(jax.vmap(
             lambda w, c: dense_estep.densify(w, c, v, dtype=store)
-        )(word_idx, counts)
+        ))(word_idx, counts)
         if wmajor:
-            dense = jnp.transpose(dense)
-        groups = ((dense[None], doc_mask[None]),)
+            dense = jnp.transpose(dense, (0, 2, 1))
+        groups = ((dense, doc_mask),)
     elif plan is not None:
         # Compact-vocab dense engine: the batch's own Wc-wide slice of
         # the vocabulary through the same MXU kernel, suff-stats
@@ -170,10 +183,10 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
                 "engine_variant": "compact"}
     else:
         compiler_options = None
-        groups = ((word_idx[None], counts[None], doc_mask[None]),)
+        groups = ((word_idx, counts, doc_mask),)
 
     run_chunk = fused.make_chunk_runner(
-        num_docs=b, num_topics=k, num_terms=v, chunk=chunk,
+        num_docs=nb * b, num_topics=k, num_terms=v, chunk=chunk,
         var_max_iters=var_max_iters, var_tol=1e-6, em_tol=em_tol,
         estimate_alpha=True, compiler_options=compiler_options,
         dense_wmajor=wmajor, warm_start=warm_start,
@@ -187,7 +200,8 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
 
 def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
              force_sparse=False, wmajor=True, warm_start=False,
-             precision="bf16", compact=False, word_law="uniform"):
+             precision="bf16", compact=False, word_law="uniform",
+             n_batches=1):
     """Production fused-EM throughput at (K, V, B, L); returns a dict:
     docs_per_sec, t_iter (seconds per EM iteration), use_dense, wmajor,
     corpus_itemsize, and mean_vi (mean inner fixed-point iterations per
@@ -210,7 +224,7 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         k, v, b, l, chunk=chunk, var_max_iters=var_max_iters,
         em_tol=0.0, force_sparse=force_sparse, wmajor=wmajor,
         warm_start=warm_start, precision=precision, compact=compact,
-        word_law=word_law,
+        word_law=word_law, n_batches=n_batches,
     )
     alpha = jnp.float32(2.5)
     have = jnp.asarray(False)
@@ -237,7 +251,7 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
         vi.append(float(np.asarray(res.vi_iters, np.float64).mean()))
     assert np.isfinite(ll)
     return {
-        "docs_per_sec": b / best,
+        "docs_per_sec": n_batches * b / best,
         "t_iter": best,
         "use_dense": use_dense,
         "wmajor": wmajor,
@@ -455,6 +469,14 @@ def _write_flow_day(f, n_events, n_src=4000, n_dst=2000, seed=11,
     # whose population needs them — uniform draws with a large --n-src
     # included, not just power-law mode (round-5 review finding).  The
     # default populations keep the byte-identical round-1..4 stream.
+    # Past 2^24 even three octets alias (rank v and v-2^24 collide),
+    # which would silently cap realized cardinality — refuse instead.
+    if n_src > (1 << 24) or n_dst > (1 << 24):
+        raise ValueError(
+            f"IP populations cap at 2^24 per side (got n_src={n_src}, "
+            f"n_dst={n_dst}): the 3-octet encodings alias beyond that, "
+            "silently deflating realized doc cardinality"
+        )
     if ip_zipf_a is not None or n_src > 65536 or n_dst > 65536:
 
         def fmt_src(v):

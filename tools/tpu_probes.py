@@ -34,6 +34,17 @@ chunk_sweep — host-dispatch amortization.  Round-2 data said 8->32
   chunk doubled throughput and 32->64 was flat; re-check at the
   current (much faster) iteration time, where the same absolute
   dispatch overhead is a LARGER fraction of each iteration.
+
+batch_amort — day-scale glue amortization ON CHIP: per-EM-iteration
+  wall and docs/s vs resident batch count (1..16 stacked B=4096
+  batches through the production chunk runner's scan).  The CPU-mesh
+  twin (tools/glue_amortization.py; table in docs/architecture.md)
+  shows the structural split 14.0 ms fixed + 10.6 ms/batch; this
+  cashes the absolute single-chip numbers the 2.6x-ceiling paragraph
+  and the "multi-chip pays at day scale" claim rest on.  Note the
+  round-4 exp-space fast path only engages at n_batches=1 — the
+  stacked runs measure the generic impl, so comparing n=1 against
+  n>1 also bounds what the fast path would buy at day scale.
 """
 
 import json
@@ -129,6 +140,20 @@ def chunk_sweep():
         }), flush=True)
 
 
+def batch_amort():
+    import bench
+
+    for nb in (1, 2, 4, 8, 16):
+        em = bench.bench_em(K, V, B, L, rounds=3, warm_start=True,
+                            precision="bf16", n_batches=nb)
+        print(json.dumps({
+            "probe": "batch_amort", "n_batches": nb,
+            "t_iter_ms": round(em["t_iter"] * 1e3, 3),
+            "t_iter_per_batch_ms": round(em["t_iter"] * 1e3 / nb, 3),
+            "docs_per_sec": round(em["docs_per_sec"]),
+        }), flush=True)
+
+
 def main() -> int:
     import jax
 
@@ -137,7 +162,7 @@ def main() -> int:
               "device behavior; run on the chip host", file=sys.stderr)
         return 2
     which = sys.argv[1:] or ["cap_sweep", "alpha_ab", "fastpath_ab",
-                             "chunk_sweep"]
+                             "chunk_sweep", "batch_amort"]
     for name in which:
         fn = globals().get(name)
         if fn is None:
