@@ -21,7 +21,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ..native_build import NativeLib, narrow_counts_i32
+from ..native_build import NativeLib, bytes_at, narrow_counts_i32
 from .dns import DnsFeatures, featurize_dns
 from .quantiles import DECILES, QUINTILES, ecdf_cuts
 
@@ -117,7 +117,7 @@ _narrow_i32 = narrow_counts_i32   # shared guard (native_build)
 
 def _table(lib, h, which: int) -> list[str]:
     cnt = lib.dfz_table_count(h, which)
-    blob = ctypes.string_at(
+    blob = bytes_at(
         lib.dfz_table_blob(h, which), lib.dfz_table_blob_len(h, which)
     )
     off = _copy(lib.dfz_table_offsets(h, which), cnt + 1, np.int64)
@@ -364,7 +364,7 @@ def _featurize_native(
                 )
             rows_blob = MmapBlob(spill_path)
         else:
-            rows_blob = ctypes.string_at(
+            rows_blob = bytes_at(
                 lib.dfz_rows_blob(h), lib.dfz_rows_blob_len(h)
             )
         return NativeDnsFeatures(

@@ -21,7 +21,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ..native_build import NativeLib, narrow_counts_i32
+from ..native_build import NativeLib, bytes_at, narrow_counts_i32
 from .flow import FLOW_COLUMNS, FlowFeatures, _jvm_double, featurize_flow
 from .quantiles import DECILES, QUINTILES, ecdf_cuts
 
@@ -115,7 +115,7 @@ _narrow_i32 = narrow_counts_i32   # shared guard (native_build)
 def _table(lib, h, which: int) -> list[str]:
     cnt = lib.ffz_table_count(h, which)
     blob_len = lib.ffz_table_blob_len(h, which)
-    blob = ctypes.string_at(lib.ffz_table_blob(h, which), blob_len)
+    blob = bytes_at(lib.ffz_table_blob(h, which), blob_len)
     off = _copy(lib.ffz_table_offsets(h, which), cnt + 1, np.int64)
     return [
         blob[off[i]:off[i + 1]].decode("utf-8", "surrogateescape")
@@ -363,7 +363,7 @@ def _featurize_native(
                 )
             lines = MmapBlob(spill_path)
         else:
-            lines = ctypes.string_at(
+            lines = bytes_at(
                 lib.ffz_lines_blob(h), lib.ffz_lines_blob_len(h)
             )
         return NativeFlowFeatures(

@@ -19,6 +19,31 @@ from typing import Callable
 import numpy as np
 
 
+# PyBytes_FromStringAndSize with a true Py_ssize_t size.  CPython's
+# ctypes.string_at truncates its size argument to a C `int`, so any
+# native buffer >= 2 GiB arrives as a negative size and raises
+# SystemError — first hit by the realistic-cardinality 30-day
+# word_counts emit (round 5: ~100M rows ≈ 3 GB in one blob).
+# Private prototype (PYFUNCTYPE holds the GIL): assigning restype/
+# argtypes on ctypes.pythonapi.<symbol> would mutate the process-global
+# shared function object, racing any other library that prototypes the
+# same symbol differently (round-5 review finding).
+_PyBytes_FromStringAndSize = ctypes.PYFUNCTYPE(
+    ctypes.py_object, ctypes.c_void_p, ctypes.c_ssize_t
+)(("PyBytes_FromStringAndSize", ctypes.pythonapi))
+
+
+def bytes_at(ptr, size: int) -> bytes:
+    """64-bit-safe replacement for ctypes.string_at(ptr, size): copies
+    `size` bytes from the native pointer into a bytes object.  Shared
+    by native_emit.py and the feature containers."""
+    if not size:
+        return b""
+    if not ptr:
+        raise MemoryError("native buffer pointer is NULL")
+    return _PyBytes_FromStringAndSize(ptr, size)
+
+
 def narrow_counts_i32(counts: "np.ndarray") -> "np.ndarray":
     """int64 C-side counts -> int32 storage, guarded: astype wraps
     silently on overflow, which would corrupt corpus counts on an

@@ -21,7 +21,7 @@ import os
 
 import numpy as np
 
-from .native_build import NativeLib
+from .native_build import NativeLib, bytes_at
 
 _I32P = ctypes.POINTER(ctypes.c_int32)
 _I64P = ctypes.POINTER(ctypes.c_int64)
@@ -103,8 +103,11 @@ def _f64p(a: np.ndarray):
 
 
 def _collect(lib, ptr, out_len) -> bytes:
+    # bytes_at, not ctypes.string_at: the latter truncates its size to
+    # a C int, so a >= 2 GiB emit (realistic 30-day word_counts)
+    # crashed with "Negative size" (round-5 config-3 run).
     try:
-        return ctypes.string_at(ptr, out_len.value)
+        return bytes_at(ptr, out_len.value)
     finally:
         lib.emit_free(ptr)
 

@@ -1,0 +1,36 @@
+"""native_build shared helpers (no compiled library needed).
+
+bytes_at exists because CPython's ctypes.string_at truncates its size
+argument to a C int: the realistic-cardinality 30-day word_counts emit
+(~3 GB in one buffer) crashed with "Negative size passed to
+PyBytes_FromStringAndSize" mid-run (round 5).  The >2 GiB case is
+pinned directly — it costs ~4 GB of transient RAM, which this
+build host has.
+"""
+
+import ctypes
+
+import pytest
+
+from oni_ml_tpu.native_build import bytes_at
+
+
+def test_bytes_at_small_and_empty():
+    buf = ctypes.create_string_buffer(b"abcdef")
+    assert bytes_at(ctypes.addressof(buf), 6) == b"abcdef"
+    assert bytes_at(ctypes.addressof(buf), 0) == b""
+    assert bytes_at(None, 0) == b""
+    with pytest.raises(MemoryError):
+        bytes_at(None, 4)
+
+
+def test_bytes_at_over_2gib():
+    size = (1 << 31) + 16
+    buf = ctypes.create_string_buffer(size)
+    buf[size - 1] = b"\x7f"
+    out = bytes_at(ctypes.addressof(buf), size)
+    assert len(out) == size
+    assert out[-1] == 0x7F
+    del out, buf
+    # ctypes.string_at at this size is exactly the crash this helper
+    # replaces; no need to demonstrate it here.
