@@ -457,8 +457,15 @@ def _write_flow_day(f, n_events, n_src=4000, n_dst=2000, seed=11,
     svc = np.asarray([80, 443, 22, 53, 8080, 25])
     svc_cdf = None
     if n_svc_ports is not None:
-        svc = np.sort(rng.choice(np.arange(1, 1025), size=n_svc_ports,
-                                 replace=False))
+        # One FIXED service mix regardless of the per-day seed: real
+        # traffic keeps the same services day over day.  Drawing the
+        # subset from the per-day rng gave every day file a fresh
+        # 48-port sample, and a 30-day corpus realized ~770 distinct
+        # ports — a 16x vocabulary inflation artifact (786k words
+        # instead of the ~50k the binned word space yields).
+        svc_rng = np.random.default_rng(1011)
+        svc = np.sort(svc_rng.choice(np.arange(1, 1025),
+                                     size=n_svc_ports, replace=False))
         svc_cdf = _powerlaw_cdf(n_svc_ports, 1.05)
     src_cdf = dst_cdf = None
     if ip_zipf_a is not None:

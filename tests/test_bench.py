@@ -118,6 +118,21 @@ def test_bench_flow_day_realistic_cardinality():
     assert all(ln.split(",")[8].startswith("10.0.") for ln in l2)
     assert all(ln.split(",")[9].startswith("10.1.") for ln in l2)
 
+    # The service mix is FIXED across day seeds (real traffic keeps the
+    # same services day over day): drawing it from the per-day rng gave
+    # every day a fresh port subset, and a 30-day corpus realized ~770
+    # distinct ports — a 16x vocabulary inflation artifact.
+    bufA, bufB = io.StringIO(), io.StringIO()
+    bench._write_flow_day(bufA, 500, seed=7, ip_zipf_a=1.2,
+                          n_svc_ports=12)
+    bench._write_flow_day(bufB, 500, seed=8, ip_zipf_a=1.2,
+                          n_svc_ports=12)
+    pa = {int(ln.split(",")[11])
+          for ln in bufA.getvalue().strip().splitlines()}
+    pb = {int(ln.split(",")[11])
+          for ln in bufB.getvalue().strip().splitlines()}
+    assert len(pa | pb) <= 12
+
     # Uniform mode with a >65536 population must use the wide encoding
     # too — the 2-octet form would silently emit non-IP strings like
     # 10.0.1367.44 (round-5 review finding).

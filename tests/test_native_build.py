@@ -24,7 +24,22 @@ def test_bytes_at_small_and_empty():
         bytes_at(None, 4)
 
 
+def _mem_available_gb() -> float:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) / 1e6
+    except OSError:
+        pass
+    return 0.0
+
+
 def test_bytes_at_over_2gib():
+    # ~4.3 GiB transient (buffer + copy): skip cleanly on small hosts
+    # instead of inviting the OOM killer to SIGKILL the whole worker.
+    if _mem_available_gb() < 6.0:
+        pytest.skip("needs ~5 GB free RAM for the >2 GiB pin")
     size = (1 << 31) + 16
     buf = ctypes.create_string_buffer(size)
     buf[size - 1] = b"\x7f"
