@@ -198,7 +198,7 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
             corpus_itemsize, gammas0, info)
 
 
-def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
+def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
              force_sparse=False, wmajor=True, warm_start=False,
              precision="bf16", compact=False, word_law="uniform",
              n_batches=1):
@@ -208,10 +208,15 @@ def bench_em(k, v, b, l, chunk=32, rounds=5, var_max_iters=20,
     EM step in the timed rounds — shows the var_tol early exit and warm
     start collapsing the inner loop as beta stabilizes).
 
-    chunk EM iterations run device-resident per host call; chunk=32
-    amortizes the host<->device round-trip (which dominates at chunk=8
-    under the tunneled PJRT backend: measured 331k -> 744k docs/s going
-    8 -> 32 on the headline config, flat 32 -> 64).
+    chunk EM iterations run device-resident per host call; the default
+    amortizes the host<->device round-trip, which DOMINATES under the
+    tunneled PJRT backend.  r05 on-chip sweep at the headline shape
+    (docs/bench_captures/r05_session_capture.json.log): chunk 16 ->
+    821k, 32 -> 1.381M, 64 -> 2.055M, 128 -> 2.898M docs/s; the fit is
+    t_iter ~= 0.83 ms device work + ~74 ms per-dispatch tunnel glue /
+    chunk, so chunk=128 cuts glue to ~0.6 ms/iter.  (Round-3's 32 -> 64
+    "flat" reading was taken during a degrading grant and is superseded
+    by this sweep.)
 
     precision="bf16" stores the dense kernel's matmul operands
     half-width.  On TPU this is bit-identical to f32 (XLA DEFAULT

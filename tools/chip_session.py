@@ -12,13 +12,17 @@ already measured:
 
 Sequence (each step its own subprocess; a wedge costs one step):
   1. tools/tpu_smoke.py        — shard_map+Pallas Mosaic sanity (fast)
-  2. tools/tpu_probes.py       — cap_sweep / alpha_ab / fastpath_ab /
-                                 chunk_sweep (the decomposition that
-                                 says where the next factor comes from)
-  3. bench.py                  — the full phase record; its last JSON
+  2. bench.py                  — the full phase record; its last JSON
                                  line (success OR the structured
                                  failure record) is saved as the
-                                 session capture
+                                 session capture.  Runs BEFORE the
+                                 probes since r05: the grant died
+                                 mid-probes and the round lost the
+                                 whole phase record.
+  3. tools/tpu_probes.py       — cap_sweep / alpha_ab / fastpath_ab /
+                                 chunk_sweep / batch_amort (the
+                                 decomposition that says where the
+                                 next factor comes from)
 
 Per-step timing: each step gets BOOT_GRACE_S to produce its FIRST
 output byte (a python child in this image takes ~5 s just to boot —
@@ -78,10 +82,15 @@ def _bench_timeout_s() -> float:
     return budget + BENCH_TIMEOUT_MARGIN_S
 
 
+# bench runs SECOND, right after the fast sanity check: it is the
+# highest-value artifact and the grant has died mid-session in every
+# round so far — r05 lost the whole phase record because the grant
+# expired during the probe step that used to run before it.  The
+# probes are decomposition detail and take the tail position.
 STEPS = [
     ("tpu_smoke", [sys.executable, os.path.join(HERE, "tools", "tpu_smoke.py")], 600),
-    ("tpu_probes", [sys.executable, os.path.join(HERE, "tools", "tpu_probes.py")], 2400),
     ("bench", [sys.executable, os.path.join(HERE, "bench.py")], _bench_timeout_s()),
+    ("tpu_probes", [sys.executable, os.path.join(HERE, "tools", "tpu_probes.py")], 2400),
 ]
 
 

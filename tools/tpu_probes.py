@@ -36,8 +36,9 @@ chunk_sweep — host-dispatch amortization.  Round-2 data said 8->32
   dispatch overhead is a LARGER fraction of each iteration.
 
 batch_amort — day-scale glue amortization ON CHIP: per-EM-iteration
-  wall and docs/s vs resident batch count (1..16 stacked B=4096
-  batches through the production chunk runner's scan).  The CPU-mesh
+  wall and docs/s vs resident batch count (1/2/4 stacked B=4096
+  batches through the production chunk runner's scan; capped at 4
+  since r05, where the grant died in the long n=8 setup window).  The CPU-mesh
   twin (tools/glue_amortization.py; table in docs/architecture.md)
   shows the structural split 14.0 ms fixed + 10.6 ms/batch; this
   cashes the absolute single-chip numbers the 2.6x-ceiling paragraph
@@ -130,7 +131,11 @@ def fastpath_ab():
 def chunk_sweep():
     import bench
 
-    for chunk in (16, 32, 64, 128):
+    # 16 measured 821k in r05 (known-bad, dropped to save grant time);
+    # the r05 curve was still improving at 128 (2.898M) with a fitted
+    # ~74 ms per-dispatch glue, so the open question is where 256/512
+    # flatten onto the ~0.83 ms/iter device floor.
+    for chunk in (32, 64, 128, 256, 512):
         em = bench.bench_em(K, V, B, L, chunk=chunk, rounds=3,
                             warm_start=True, precision="bf16")
         print(json.dumps({
@@ -143,7 +148,11 @@ def chunk_sweep():
 def batch_amort():
     import bench
 
-    for nb in (1, 2, 4, 8, 16):
+    # Capped at 4: n=1/2/4 (r05: 1.354M / 2.134M / 3.193M docs/s)
+    # already demonstrate the fixed-glue amortization curve, and the
+    # r05 grant died in the long n=8 setup window before bench ever
+    # ran — the marginal data point is not worth holding the grant.
+    for nb in (1, 2, 4):
         em = bench.bench_em(K, V, B, L, rounds=3, warm_start=True,
                             precision="bf16", n_batches=nb)
         print(json.dumps({
