@@ -212,11 +212,11 @@ def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
     amortizes the host<->device round-trip, which DOMINATES under the
     tunneled PJRT backend.  r05 on-chip sweep at the headline shape
     (docs/bench_captures/r05_session_capture.json.log): chunk 16 ->
-    821k, 32 -> 1.381M, 64 -> 2.055M, 128 -> 2.898M docs/s; the fit is
-    t_iter ~= 0.83 ms device work + ~74 ms per-dispatch tunnel glue /
-    chunk, so chunk=128 cuts glue to ~0.6 ms/iter.  (Round-3's 32 -> 64
-    "flat" reading was taken during a degrading grant and is superseded
-    by this sweep.)
+    821k, 32 -> 1.381M, 64 -> 2.055M, 128 -> 2.898M docs/s; least
+    squares over those four points fits t_iter ~= 0.94 ms device work
+    + ~65 ms per-dispatch tunnel glue / chunk, so chunk=128 cuts glue
+    to ~0.5 ms/iter.  (Round-3's 32 -> 64 "flat" reading was taken
+    during a degrading grant and is superseded by this sweep.)
 
     precision="bf16" stores the dense kernel's matmul operands
     half-width.  On TPU this is bit-identical to f32 (XLA DEFAULT
@@ -1141,15 +1141,19 @@ def phase_pipeline_e2e_dns():
 # scan compiles, the slowest phase end-to-end even when healthy.
 # touches_device=False phases (host-side scoring) stay runnable while
 # the chip grant is wedged.
+# Device-phase timeouts were sized when bench_em dispatched 32-iter
+# chunks; the chunk=128 default runs 4x the EM iterations per timed
+# round, so the EM phases carry proportionally more headroom for a
+# degraded grant where one V=512k/K=50 iteration runs seconds.
 PHASES = [
-    ("headline", phase_headline, 480.0, True),
+    ("headline", phase_headline, 600.0, True),
     ("mosaic_smoke", phase_mosaic_smoke, 300.0, True),
-    ("lda_em_throughput_fresh_start", phase_fresh_start, 360.0, True),
+    ("lda_em_throughput_fresh_start", phase_fresh_start, 480.0, True),
     ("lda_em_convergence", phase_convergence, 300.0, True),
     ("dns_scoring", phase_dns_scoring, 360.0, False),
     ("flow_scoring", phase_flow_scoring, 420.0, False),
-    ("lda_em_throughput_k50_v50k", phase_k50_v50k, 480.0, True),
-    ("lda_em_throughput_config4_v512k", phase_config4, 480.0, True),
+    ("lda_em_throughput_k50_v50k", phase_k50_v50k, 720.0, True),
+    ("lda_em_throughput_config4_v512k", phase_config4, 720.0, True),
     ("pipeline_e2e", phase_pipeline_e2e, 900.0, True),
     ("pipeline_e2e_dns", phase_pipeline_e2e_dns, 720.0, True),
     ("lda_online_svi", phase_online_svi, 900.0, True),

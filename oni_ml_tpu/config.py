@@ -65,7 +65,13 @@ class LDAConfig:
     # Run up to this many EM iterations per device program (models/fused.py):
     # the convergence check happens on device and the host syncs only at
     # chunk boundaries.  0 or 1 falls back to one dispatch per iteration.
-    fused_em_chunk: int = 8
+    # Default raised 8 -> 128 after the r05 on-chip sweep: per-dispatch
+    # glue under the tunneled backend is ~65 ms (least-squares fit over
+    # the r05 chunk sweep), so chunk=8 spent ~8 ms of glue per EM
+    # iteration where chunk=128 spends ~0.5 ms — and the device
+    # while_loop exits the moment |dll/ll| < em_tol, so a chunk larger
+    # than the iterations-to-convergence costs nothing.
+    fused_em_chunk: int = 128
     # Dense-corpus E-step (ops/dense_estep.py): "auto" densifies the corpus
     # once and runs the gather/scatter-free MXU kernel when the device is a
     # TPU, the doc blocks fit VMEM, and the dense corpus fits the HBM

@@ -61,7 +61,7 @@ def cap_sweep():
     import bench
 
     for cap in (1, 3, 6, 12, 20):
-        em = bench.bench_em(K, V, B, L, rounds=3, var_max_iters=cap,
+        em = bench.bench_em(K, V, B, L, chunk=32, rounds=3, var_max_iters=cap,
                             warm_start=False, precision="bf16")
         print(json.dumps({
             "probe": "cap_sweep", "cap": cap,
@@ -84,7 +84,8 @@ def alpha_ab():
     try:
         for label, maker in (("newton", orig), ("fixed", no_alpha)):
             fused.make_chunk_runner = maker
-            em = bench.bench_em(K, V, B, L, rounds=3, warm_start=True,
+            em = bench.bench_em(K, V, B, L, chunk=32, rounds=3,
+                                warm_start=True,
                                 precision="bf16")
             print(json.dumps({
                 "probe": "alpha_ab", "alpha": label,
@@ -116,7 +117,8 @@ def fastpath_ab():
     try:
         for label, maker in (("fast", orig), ("stock", stock)):
             fused.make_chunk_runner = maker
-            em = bench.bench_em(K, V, B, L, rounds=3, warm_start=True,
+            em = bench.bench_em(K, V, B, L, chunk=32, rounds=3,
+                                warm_start=True,
                                 precision="bf16")
             print(json.dumps({
                 "probe": "fastpath_ab", "path": label,
@@ -132,9 +134,10 @@ def chunk_sweep():
     import bench
 
     # 16 measured 821k in r05 (known-bad, dropped to save grant time);
-    # the r05 curve was still improving at 128 (2.898M) with a fitted
-    # ~74 ms per-dispatch glue, so the open question is where 256/512
-    # flatten onto the ~0.83 ms/iter device floor.
+    # the r05 curve was still improving at 128 (2.898M).  Least squares
+    # over the four r05 points gives t_iter ~= 0.94 ms device floor +
+    # ~65 ms per-dispatch glue / chunk, so the open question is where
+    # 256/512 (predicted ~1.19 / ~1.07 ms) flatten onto that floor.
     for chunk in (32, 64, 128, 256, 512):
         em = bench.bench_em(K, V, B, L, chunk=chunk, rounds=3,
                             warm_start=True, precision="bf16")
@@ -153,8 +156,9 @@ def batch_amort():
     # r05 grant died in the long n=8 setup window before bench ever
     # ran — the marginal data point is not worth holding the grant.
     for nb in (1, 2, 4):
-        em = bench.bench_em(K, V, B, L, rounds=3, warm_start=True,
-                            precision="bf16", n_batches=nb)
+        em = bench.bench_em(K, V, B, L, chunk=32, rounds=3,
+                            warm_start=True, precision="bf16",
+                            n_batches=nb)
         print(json.dumps({
             "probe": "batch_amort", "n_batches": nb,
             "t_iter_ms": round(em["t_iter"] * 1e3, 3),
