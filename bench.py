@@ -796,10 +796,15 @@ def _driver_verified_record() -> "dict | None":
     return prev
 
 
-def _failure_payload(error: str) -> dict:
+def _failure_payload(error: str, host_phases: "dict | None" = None) -> dict:
     """The structured failure record shared by every no-measurement
-    exit path (gate failure, watchdog, SIGTERM salvage)."""
-    return {
+    exit path (gate failure, watchdog, SIGTERM salvage).
+
+    `host_phases` carries phases measured fresh THIS run on the host
+    while the device backend was unavailable (the scoring stages need
+    no chip) — first-class current measurements, kept separate from
+    the provenance-marked `last_good` history."""
+    payload = {
         "metric": "lda_em_throughput",
         "value": None,
         "unit": "docs/sec",
@@ -807,15 +812,36 @@ def _failure_payload(error: str) -> dict:
         "last_good": _last_good_record(),
         "last_driver_verified": _driver_verified_record(),
     }
+    if host_phases:
+        payload["host_only_phases"] = host_phases
+    return payload
 
 
-def _emit_failure(error: str) -> None:
+def _emit_failure(error: str, host_phases: "dict | None" = None) -> None:
     """Final parseable stdout line for a run that produced no fresh
     measurement: rc=1 WITH structure instead of rc=124 with nothing
     (rounds 2 and 3 each lost their whole record to that shape).  The
     driver parses the last line, so value=null + error + last_good is
     what BENCH_r*.json carries for a dead-backend round."""
-    print(json.dumps(_failure_payload(error)), flush=True)
+    print(json.dumps(_failure_payload(error, host_phases)), flush=True)
+
+
+def _run_host_only_phases(inproc: bool) -> dict:
+    """The scoring stages measure host code (numpy/native featurize +
+    score) and run fine against a wedged grant — a dead-backend round
+    should still carry THIS round's host numbers instead of losing
+    the dns/flow scoring measurement with the chip (r04 shipped the
+    round-4 DNS dict-path fix unmeasured for exactly this reason)."""
+    results = {}
+    for name, fn, timeout, touches_device in PHASES:
+        if touches_device:
+            continue
+        payload, err, wall = _run_phase(name, fn, timeout, inproc)
+        results[name] = (
+            payload if payload is not None
+            else {"error": err, "phase_wall_s": wall}
+        )
+    return results
 
 
 class _Record:
@@ -1280,13 +1306,16 @@ def main() -> int:
     if not _backend_responsive():
         print(
             "bench: device backend unresponsive after retries (wedged "
-            "chip grant?) — aborting instead of hanging",
+            "chip grant?) — running host-only phases, then aborting "
+            "instead of hanging",
             file=sys.stderr,
         )
+        host = _run_host_only_phases(os.environ.get("BENCH_INPROC") == "1")
         _emit_failure(
             "backend unavailable: device init unresponsive through the "
             f"{float(os.environ.get('BENCH_GATE_S', GATE_BUDGET_S)):.0f}s "
-            "probe gate"
+            "probe gate",
+            host_phases=host,
         )
         return 1
 
@@ -1313,12 +1342,15 @@ def main() -> int:
         ):
             time.sleep(RECOVERY_WAIT)  # gentle: rapid retries re-wedge
     if payload is None:
-        print("bench: headline unrecoverable — no record", file=sys.stderr)
+        print("bench: headline unrecoverable — running host-only "
+              "phases, then emitting the failure record", file=sys.stderr)
+        host = _run_host_only_phases(inproc)
         if _RUN_E2E_DIR:
             import shutil
 
             shutil.rmtree(_RUN_E2E_DIR, ignore_errors=True)
-        _emit_failure(f"headline unrecoverable after 3 attempts: {err}")
+        _emit_failure(f"headline unrecoverable after 3 attempts: {err}",
+                      host_phases=host)
         return 1
     record.set_headline(
         metric="lda_em_throughput",

@@ -361,6 +361,13 @@ def test_bench_main_emits_structured_failure_when_backend_wedged(
     import bench
 
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: False)
+    # The real host phases take minutes; what the test pins is that
+    # their results ride the failure record as fresh measurements.
+    monkeypatch.setattr(
+        bench, "_run_host_only_phases",
+        lambda inproc: {"dns_scoring": {"value": 12345.6,
+                                        "unit": "events/sec"}},
+    )
     assert bench.main() == 1
     last = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(last)
@@ -375,6 +382,54 @@ def test_bench_main_emits_structured_failure_when_backend_wedged(
     ldv = rec["last_driver_verified"]
     assert ldv is not None and ldv["value"] > 0
     assert "driver-captured" in ldv["provenance"]
+    # A dead-backend round still carries THIS round's host-only phase
+    # measurements (r05: the scoring stages need no chip).
+    assert rec["host_only_phases"]["dns_scoring"]["value"] == 12345.6
+
+
+def test_bench_headline_unrecoverable_still_carries_host_phases(
+    capsys, monkeypatch
+):
+    """Backend passes the gate but dies during the headline: the
+    failure record must still carry fresh host-only phase results
+    (review finding on the r05 gate-path feature — the loss recurs on
+    this exit path otherwise)."""
+    import bench
+
+    monkeypatch.setattr(bench, "_backend_responsive",
+                        lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_run_phase",
+                        lambda n, f, t, i: (None, "rc=1: dead", 1.0))
+    monkeypatch.setattr(
+        bench, "_run_host_only_phases",
+        lambda inproc: {"flow_scoring": {"value": 7.0}},
+    )
+    assert bench.main() == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert "headline unrecoverable" in rec["error"]
+    assert rec["host_only_phases"]["flow_scoring"]["value"] == 7.0
+
+
+def test_bench_run_host_only_phases_selects_host_phases(monkeypatch):
+    """_run_host_only_phases runs exactly the touches_device=False
+    phases and keeps per-phase failures recoverable."""
+    import bench
+
+    ran = []
+
+    def fake_run_phase(name, fn, timeout, inproc):
+        ran.append(name)
+        if name == "flow_scoring":
+            return None, "rc=1: boom", 1.0
+        return {"value": 1.0}, None, 1.0
+
+    monkeypatch.setattr(bench, "_run_phase", fake_run_phase)
+    out = bench._run_host_only_phases(False)
+    expected = [n for n, _, _, dev in bench.PHASES if not dev]
+    assert ran == expected and set(out) == set(expected)
+    assert out["dns_scoring"] == {"value": 1.0}
+    assert out["flow_scoring"]["error"] == "rc=1: boom"
 
 
 def test_bench_gate_schedule_bounded(monkeypatch):
