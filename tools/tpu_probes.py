@@ -72,17 +72,34 @@ def cap_sweep():
 
 
 def alpha_ab():
+    # Three-way since r05: newton100 forces the dynamic while_loop
+    # lowering (the pre-r05 production shape), newton8 is the capped
+    # UNROLLED lowering bench now uses (r05 charged ~0.5 ms/EM-iter to
+    # the estimate at chunk=32 — this row says how much the unroll
+    # recovers), fixed is the no-estimate floor.
     import bench
     from oni_ml_tpu.models import fused
 
     orig = fused.make_chunk_runner
+
+    def newton100(**kw):
+        kw["alpha_max_iters"] = 100
+        return orig(**kw)
+
+    def newton8(**kw):
+        # Pinned here, not inherited from bench's current tuning, so
+        # the emitted label stays true if bench's cap ever moves.
+        kw["alpha_max_iters"] = 8
+        return orig(**kw)
 
     def no_alpha(**kw):
         kw["estimate_alpha"] = False
         return orig(**kw)
 
     try:
-        for label, maker in (("newton", orig), ("fixed", no_alpha)):
+        for label, maker in (("newton100", newton100),
+                             ("newton8", newton8),
+                             ("fixed", no_alpha)):
             fused.make_chunk_runner = maker
             em = bench.bench_em(K, V, B, L, chunk=32, rounds=3,
                                 warm_start=True,
