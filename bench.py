@@ -348,15 +348,22 @@ def em_utilization(k, v, b, t_iter, var_max_iters=20, wmajor=True,
     }
 
 
-def bench_online_svi(k=20, v=8192, b=4096, l=128, steps=24, chunk=12):
+def bench_online_svi(k=20, v=8192, b=4096, l=128, steps=64, chunk=64):
     """Steady-state streaming SVI throughput (BASELINE.json config 5):
     docs/sec through OnlineLDATrainer.step_many at the headline
     micro-batch shape — the chunked device-resident scan path
-    production streams use (one dispatch per `chunk` natural-gradient
-    steps; per-step dispatch through the tunneled PJRT backend measures
-    the relay's round-trip, not the update).  One warm chunk absorbs
-    compile + densify warmup; dense_em='auto' picks the dense MXU
-    E-step on TPU."""
+    production streams use for replay/catch-up.  steps/chunk moved
+    24/12 -> 64/64 after the r05 dispatch decomposition (~65 ms glue
+    per dispatch): at chunk=12 the phase read ~5.4 ms of tunnel glue
+    per ~1 ms natural-gradient step, i.e. the relay, not the SVI
+    machinery.  64 is chosen because step_many lowers scans at the
+    largest power of two <= chunk (online_lda.py splits 48 into
+    scan32+scan16 — TWO dispatches), so 64/64 is the smallest shape
+    above 48 that truly runs the timed pass as ONE dispatch (~1 ms
+    glue per step).  The stream's host->device transfer stays in the
+    timed region — arriving micro-batch data is real steady-state
+    cost.  One warm chunk absorbs compile + densify warmup;
+    dense_em='auto' picks the dense MXU E-step on TPU."""
     from oni_ml_tpu.config import OnlineLDAConfig
     from oni_ml_tpu.io import Batch
     from oni_ml_tpu.models import OnlineLDATrainer
