@@ -49,6 +49,22 @@ def test_capture_paths_unique_and_glob_compatible(tmp_path):
     assert os.path.basename(p2) == "r05a2_session_capture.json"
 
 
+def test_capture_path_never_reuses_existing_files(tmp_path):
+    """The no-overwrite invariant is on the FILES, not the watcher's
+    own attempt counter: a manual chip_session run may already hold
+    this round's canonical name (r05 did — the structured-failure
+    capture), and the watcher's first firing must not clobber it."""
+    cap_dir = tmp_path / "docs" / "bench_captures"
+    cap_dir.mkdir(parents=True)
+    (cap_dir / "r05_session_capture.json").write_text("{}")
+    p = grant_watcher.capture_out_path("r05", 1, str(tmp_path))
+    assert os.path.basename(p) == "r05a2_session_capture.json"
+    # And with a2 also taken, attempt 1 walks to a3.
+    (cap_dir / "r05a2_session_capture.json").write_text("{}")
+    p = grant_watcher.capture_out_path("r05", 1, str(tmp_path))
+    assert os.path.basename(p) == "r05a3_session_capture.json"
+
+
 def test_round_tag_derived_from_bench_records(tmp_path):
     assert grant_watcher.current_round_tag(str(tmp_path)) == "r01"
     (tmp_path / "BENCH_r04.json").write_text("{}")
@@ -79,28 +95,33 @@ def _run_watch(probe_results, capture_rcs, **kw):
     return rc, events
 
 
-def test_watch_fires_on_first_alive_probe_and_stops_on_green():
-    rc, ev = _run_watch([None, None, 1], [0])
+def test_watch_fires_on_first_alive_probe_and_stops_on_green(tmp_path):
+    rc, ev = _run_watch([None, None, 1], [0], base_dir=str(tmp_path))
     assert rc == 0
     assert ev["captures"] == ["r05_session_capture.json"]
     # Two dead probes slept at the base interval before the grant came.
     assert ev["sleeps"] == [100.0, 100.0]
 
 
-def test_watch_rearms_after_wedge_with_longer_backoff():
+def test_watch_rearms_after_wedge_with_longer_backoff(tmp_path):
     # Wedged capture (rc 2) -> doubled interval; next alive probe fires
     # attempt 2 under its own name; green stops the loop.
-    rc, ev = _run_watch([1, 1], [2, 0])
+    rc, ev = _run_watch([1, 1], [2, 0], base_dir=str(tmp_path))
     assert rc == 0
     assert ev["captures"] == ["r05_session_capture.json",
                               "r05a2_session_capture.json"]
     assert ev["sleeps"] == [200.0]      # the post-wedge sleep doubled
 
 
-def test_watch_budget_exhaustion_returns_nonzero():
-    rc, ev = _run_watch([1, 1, 1], [1, 1, 1])
+def test_watch_budget_exhaustion_returns_nonzero(tmp_path):
+    rc, ev = _run_watch([1, 1, 1], [1, 1, 1], base_dir=str(tmp_path))
     assert rc == 1
     assert len(ev["captures"]) == 3     # budget respected, then stop
+    # The injected capture writes no files, so each attempt claims its
+    # own counter-derived name in the clean base_dir.
+    assert ev["captures"] == ["r05_session_capture.json",
+                              "r05a2_session_capture.json",
+                              "r05a3_session_capture.json"]
 
 
 def test_watch_once_mode_single_decision():

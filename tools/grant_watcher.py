@@ -18,11 +18,13 @@ Re-arm policy (keyed on chip_session's documented exit-code contract):
                                 with a doubled probe interval (gentler
                                 still), bounded by --max-captures.
 
-Each capture attempt writes its own file (attempt 1 claims the
-canonical rNN_session_capture.json; attempt k>1 gets
-rNNa{k}_session_capture.json) so a later, worse capture can never
-overwrite an earlier, better one.  Both shapes match the
-r*_session_capture.json glob bench._last_good_record() reads.
+Each capture attempt writes its own file (the canonical
+rNN_session_capture.json if free, else the first unclaimed
+rNNa{k}_session_capture.json — EXISTING files are never reused, even
+ones written by a manual chip_session run before this watcher
+started) so a later, worse capture can never overwrite an earlier,
+better one.  Both shapes match the r*_session_capture.json glob
+bench._last_good_record() reads.
 
 Usage (start-of-session, background):
 
@@ -61,9 +63,20 @@ def current_round_tag(base_dir: str = HERE) -> str:
 
 def capture_out_path(round_tag: str, attempt: int,
                      base_dir: str = HERE) -> str:
-    tag = round_tag if attempt == 1 else f"{round_tag}a{attempt}"
-    return os.path.join(base_dir, "docs", "bench_captures",
-                        f"{tag}_session_capture.json")
+    """Never reuse an EXISTING capture file: the watcher counts its
+    own firings, but a manual chip_session run (or a previous watcher
+    process) may already have claimed this round's canonical name —
+    the no-overwrite invariant is on the FILES, not on this process's
+    attempt counter, so walk forward to the first free name."""
+    def path_for(n: int) -> str:
+        tag = round_tag if n == 1 else f"{round_tag}a{n}"
+        return os.path.join(base_dir, "docs", "bench_captures",
+                            f"{tag}_session_capture.json")
+
+    n = attempt
+    while os.path.exists(path_for(n)):
+        n += 1
+    return path_for(n)
 
 
 def next_action(rc: "int | None", captures_done: int,
@@ -106,7 +119,7 @@ def watch(*, interval_s: float = DEFAULT_INTERVAL_S,
           round_tag: "str | None" = None,
           once: bool = False,
           probe=probe_once, capture=run_capture, sleep=time.sleep,
-          log=None) -> int:
+          log=None, base_dir: str = HERE) -> int:
     """The watch loop.  probe/capture/sleep are injectable so the
     trigger logic is testable without a backend or real time.
     Returns 0 when a fully-green capture landed, 1 otherwise (budget
@@ -116,7 +129,7 @@ def watch(*, interval_s: float = DEFAULT_INTERVAL_S,
             print(f"grant_watcher[{time.strftime('%F %T')}]: {msg}",
                   flush=True)
     if round_tag is None:
-        round_tag = current_round_tag()
+        round_tag = current_round_tag(base_dir)
     log(f"watching for a grant: interval {interval_s:.0f}s, probe "
         f"timeout {probe_timeout_s:.0f}s, capture budget {max_captures}, "
         f"round tag {round_tag}")
@@ -128,7 +141,7 @@ def watch(*, interval_s: float = DEFAULT_INTERVAL_S,
         probes += 1
         if n:
             captures += 1
-            out = capture_out_path(round_tag, captures)
+            out = capture_out_path(round_tag, captures, base_dir)
             log(f"probe {probes}: backend ALIVE ({n} device(s)) — "
                 f"firing chip_session (attempt {captures}) -> {out}")
             rc = capture(out)
