@@ -77,6 +77,12 @@ def _sync(x):
     return float(jax.tree_util.tree_leaves(x)[0].ravel()[0])
 
 
+# Alpha-Newton cap for the throughput benches: <= 16 takes
+# update_alpha's unrolled lowering (models/lda.py); the production
+# config default and the lda-c drop-in CLI keep the reference's 100.
+ALPHA_MAX_ITERS = 8
+
+
 def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
               force_sparse=False, wmajor=True, warm_start=False,
               precision="bf16", compact=False, word_law="uniform",
@@ -191,12 +197,12 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
         estimate_alpha=True, compiler_options=compiler_options,
         dense_wmajor=wmajor, warm_start=warm_start,
         dense_precision=precision if use_dense else "f32",
-        # cap=8 takes update_alpha's unrolled lowering (one fused
-        # scalar chain instead of a dynamic-trip while_loop — the r05
-        # alpha_ab probe charged ~0.5 ms/EM-iter to the estimate);
-        # warm mid-run Newton converges in <8 trips so the same exit
-        # fires (equivalence pinned in tests/test_lda.py).
-        alpha_max_iters=8,
+        # cap ALPHA_MAX_ITERS takes update_alpha's unrolled lowering
+        # (one fused scalar chain instead of a dynamic-trip while_loop
+        # — the r05 alpha_ab probe charged ~0.5 ms/EM-iter to the
+        # estimate); warm mid-run Newton converges in <8 trips so the
+        # same exit fires (equivalence pinned in tests/test_lda.py).
+        alpha_max_iters=ALPHA_MAX_ITERS,
     )
     gammas0 = fused.initial_gammas(groups, k, jnp.float32,
                                    dense_wmajor=wmajor)
@@ -268,6 +274,11 @@ def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
         "wmajor": wmajor,
         "corpus_itemsize": corpus_itemsize,
         "mean_vi": float(np.mean(vi)),
+        # Dispatch settings ride along so phase records stay
+        # self-describing across rounds (r03's 1.31M was chunk=32 +
+        # while-loop alpha; r05 runs chunk=128 + unrolled cap-8).
+        "chunk": chunk,
+        "alpha_max_iters": ALPHA_MAX_ITERS,
         **info,
     }
 
@@ -1048,7 +1059,9 @@ def phase_headline():
     engine = _engine_label(em["use_dense"], warm=True)
     return {"value": round(em["docs_per_sec"], 1), "unit": "docs/sec",
             "engine": engine, "utilization": util,
-            "mean_vi_iters": round(em["mean_vi"], 2)}
+            "mean_vi_iters": round(em["mean_vi"], 2),
+            "chunk": em["chunk"],
+            "alpha_max_iters": em["alpha_max_iters"]}
 
 
 def phase_mosaic_smoke():

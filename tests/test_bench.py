@@ -216,7 +216,9 @@ def _patch_phases(bench, monkeypatch):
         bench, "bench_em",
         lambda *a, **k: {"docs_per_sec": 1000.0, "t_iter": 0.004,
                          "use_dense": False, "wmajor": False,
-                         "corpus_itemsize": 4, "mean_vi": 5.0},
+                         "corpus_itemsize": 4, "mean_vi": 5.0,
+                         "chunk": k.get("chunk", 128),
+                         "alpha_max_iters": 8},
     )
     monkeypatch.setattr(
         bench, "bench_dns_scoring", lambda *a, **k: (5000.0, 0.08)
