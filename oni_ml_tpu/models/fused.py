@@ -520,8 +520,13 @@ def make_chunk_runner(
     # each iteration is kernel -> elementwise exp-space M-step
     # (ss / total), eliminating the per-iteration exp(log_beta) pass,
     # the log() in m_step, the [V, K] transposes, and the EStepResult
-    # assembly — all XLA glue the perf decomposition charges to the
-    # ~0.9 ms/EM-iteration fixed cost.  Log-space beta is reconstructed
+    # assembly.  (The r05 on-chip A/B measured this a WASH at the
+    # headline shape — the "~0.9 ms glue" the round-4 decomposition
+    # charged here turned out to be per-DISPATCH tunnel round-trip,
+    # amortized by the chunk length instead; see docs/performance.md
+    # round-5 section.  The path is kept: it is equivalence-pinned,
+    # never slower, and XLA fuses either form.)  Log-space beta is
+    # reconstructed
     # ONCE at the chunk boundary; log(ss / total) differs from m_step's
     # log(ss) - log(total) by at most 1 ulp for quotients down to
     # exp(-100); BELOW that window (ss/total < ~3.8e-44, where m_step
