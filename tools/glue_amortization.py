@@ -2,8 +2,10 @@
 
 docs/architecture.md's collective-volume model concedes the flagship
 single-batch config tops out at ≈2.6× on 8 chips — Amdahl on the
-~0.9 ms of per-EM-iteration fixed cost (M-step, alpha Newton, scan
-glue) that does not shrink with the document split — and claims
+per-EM-iteration fixed cost that does not shrink with the document
+split (r05 decomposed the single-chip term into ~65 ms/dispatch
+tunnel glue amortized by chunk + device-side fixed work like the
+alpha update; docs/performance.md round-5 section) — and claims
 multi-chip pays at day-scale corpora because many resident batches
 amortize that fixed cost.  This tool MEASURES the amortization
 structure on the 8-device virtual CPU mesh (relative shape, not TPU
