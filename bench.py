@@ -204,6 +204,14 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
         # same exit fires (equivalence pinned in tests/test_lda.py).
         alpha_max_iters=ALPHA_MAX_ITERS,
     )
+    # Report the cap the runner was ACTUALLY built with, threaded back
+    # from make_chunk_runner itself: tools/tpu_probes.py's alpha_ab
+    # monkeypatches the maker to override alpha_max_iters inside its
+    # wrapper, and re-reading the module constant here would record 8
+    # for a newton100 run.
+    info["alpha_max_iters"] = getattr(
+        run_chunk, "alpha_max_iters", ALPHA_MAX_ITERS
+    )
     gammas0 = fused.initial_gammas(groups, k, jnp.float32,
                                    dense_wmajor=wmajor)
     return (log_beta, groups, run_chunk, use_dense, wmajor,
@@ -277,8 +285,10 @@ def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
         # Dispatch settings ride along so phase records stay
         # self-describing across rounds (r03's 1.31M was chunk=32 +
         # while-loop alpha; r05 runs chunk=128 + unrolled cap-8).
+        # alpha_max_iters arrives via `info` — the EFFECTIVE value the
+        # chunk runner was built with (_setup_em), not the module
+        # constant a probe may have overridden.
         "chunk": chunk,
-        "alpha_max_iters": ALPHA_MAX_ITERS,
         **info,
     }
 

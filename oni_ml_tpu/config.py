@@ -70,8 +70,25 @@ class LDAConfig:
     # the r05 chunk sweep), so chunk=8 spent ~8 ms of glue per EM
     # iteration where chunk=128 spends ~0.5 ms — and the device
     # while_loop exits the moment |dll/ll| < em_tol, so a chunk larger
-    # than the iterations-to-convergence costs nothing.
+    # than the iterations-to-convergence costs THROUGHPUT nothing.  What
+    # it does cost is crash-safety granularity: likelihood.dat
+    # streaming, progress callbacks, and the authoritative float64
+    # convergence check all live at chunk boundaries, so with
+    # checkpoint_every=0 a whole fit can be ONE dispatch and a crash
+    # loses every likelihood line.  host_sync_every below bounds that
+    # interval independently of the chunk size.
     fused_em_chunk: int = 128
+    # Upper bound on EM iterations between HOST syncs in the fused
+    # driver, independent of fused_em_chunk: each dispatch runs at most
+    # min(fused_em_chunk, host_sync_every) iterations, so likelihood.dat
+    # lines stream (and progress fires) at least that often even when
+    # checkpointing is off.  The chunk program is compiled once at
+    # fused_em_chunk and driven with a dynamic step count, so tightening
+    # this costs only the extra dispatch glue (~65 ms/dispatch under the
+    # tunneled backend, ~none locally), no recompiles.  0 = sync every
+    # fused_em_chunk iterations (maximum throughput, coarsest
+    # observability).
+    host_sync_every: int = 0
     # Dense-corpus E-step (ops/dense_estep.py): "auto" densifies the corpus
     # once and runs the gather/scatter-free MXU kernel when the device is a
     # TPU, the doc blocks fit VMEM, and the dense corpus fits the HBM
@@ -180,6 +197,49 @@ class ScoringConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Streaming scoring service (oni_ml_tpu/serving/): micro-batch
+    accumulation, host/device scorer dispatch, and the online-LDA
+    refresh cadence.  The batch pipeline's once-a-day artifacts load
+    into a ModelRegistry and a BatchScorer serves arriving events
+    continuously; none of these knobs affect the batch stages."""
+
+    # Flush an accumulating micro-batch when it reaches this many events...
+    max_batch: int = 4096
+    # ...or when its oldest event has waited this long, whichever first.
+    max_wait_ms: float = 50.0
+    # Batches at/above this size score through the jit-compiled device
+    # scorer (scoring.device_scores); smaller ones stay on the host f64
+    # path (scoring._batched_scores), whose per-call overhead is lower.
+    # At K=20 the dot is memory-bound bookkeeping, so the device only
+    # wins once the batch amortizes transfer + dispatch.  Flushes are
+    # capped at max_batch, so this must stay <= max_batch for the
+    # device path to be reachable at all — the default equals max_batch
+    # (full flushes go to the device, latency-triggered partials stay
+    # host); set it past max_batch to pin the host path everywhere.
+    device_score_min: int = 4096
+    # Backpressure bound on the pending-event queue: submit() BLOCKS
+    # once this many events are queued, so an ingest stream that
+    # outruns scoring throttles at the source instead of growing the
+    # queue (one future per event) until OOM.
+    queue_max: int = 1 << 16
+    # Fold the last N scored micro-batches into one online-LDA
+    # natural-gradient step and republish theta/p to the registry every
+    # N batches (serving/refresh.py); 0 disables refresh.
+    refresh_every: int = 0
+    # Population size D for the refresh trainer's suff-stats scaling
+    # (OnlineLDATrainer total_docs); 0 = the loaded model's IP count.
+    refresh_total_docs: int = 0
+    # Events scoring under this threshold are emitted as suspicious
+    # (the serving analogue of ScoringConfig.threshold).
+    threshold: float = 1e-20
+    # Per-batch latency/throughput/queue-depth JSON lines also append
+    # here ("" = stdout only) — the metrics.json convention of
+    # runner/ml_ops.py, one line per micro-batch.
+    metrics_path: str = ""
+
+
+@dataclass(frozen=True)
 class PipelineConfig:
     """End-to-end run configuration (replaces /etc/duxbay.conf + env vars)."""
 
@@ -194,6 +254,7 @@ class PipelineConfig:
     online_lda: OnlineLDAConfig = field(default_factory=OnlineLDAConfig)
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     # Mesh shape: (data, model). data shards documents, model shards the
     # vocabulary axis of beta.  (1, 1) = single device.
     mesh_shape: tuple = (1, 1)

@@ -194,10 +194,19 @@ def featurize_dns(
     rows_in: Iterable[Sequence[str]],
     top_domains: frozenset[str] = frozenset(),
     feedback_rows: Sequence[Sequence[str]] = (),
+    precomputed_cuts: "tuple | None" = None,
 ) -> DnsFeatures:
     """Full DNS featurization pass over 8-column rows (already projected
     from CSV/parquet by the caller; io side is runner's job).
-    `feedback_rows` are pre-duplicated 8-column rows from feedback.py."""
+    `feedback_rows` are pre-duplicated 8-column rows from feedback.py.
+
+    `precomputed_cuts` = (time_cuts, frame_length_cuts,
+    subdomain_length_cuts, entropy_cuts, numperiods_cuts) skips the
+    in-pass ECDF — the DNS analogue of flow's qtiles path
+    (features/qtiles.py, SURVEY §2.7).  The serving path
+    (oni_ml_tpu/serving) depends on it: a streamed micro-batch's own
+    ECDF would assign different bins than the trained day's, silently
+    unmapping every word from the model vocabulary."""
     rows = [list(r) for r in rows_in if len(r) == NUM_DNS_COLUMNS]
     num_raw_events = len(rows)
     rows += [list(r) for r in feedback_rows if len(r) == NUM_DNS_COLUMNS]
@@ -227,13 +236,19 @@ def featurize_dns(
         [_to_double(r[c["frame_len"]]) for r in rows], dtype=np.float64
     ) if rows else np.zeros(0)
 
-    time_cuts = ecdf_cuts(tstamp, DECILES)
-    frame_length_cuts = ecdf_cuts(frame_len, DECILES)
-    # Quintile cuts over the strictly-positive subset
-    # (dns_pre_lda.scala:298-305).
-    subdomain_length_cuts = ecdf_cuts(sub_len[sub_len > 0], QUINTILES)
-    entropy_cuts = ecdf_cuts(entropy[entropy > 0], QUINTILES)
-    numperiods_cuts = ecdf_cuts(n_parts[n_parts > 0], QUINTILES)
+    if precomputed_cuts is not None:
+        (time_cuts, frame_length_cuts, subdomain_length_cuts,
+         entropy_cuts, numperiods_cuts) = (
+            np.asarray(x, dtype=np.float64) for x in precomputed_cuts
+        )
+    else:
+        time_cuts = ecdf_cuts(tstamp, DECILES)
+        frame_length_cuts = ecdf_cuts(frame_len, DECILES)
+        # Quintile cuts over the strictly-positive subset
+        # (dns_pre_lda.scala:298-305).
+        subdomain_length_cuts = ecdf_cuts(sub_len[sub_len > 0], QUINTILES)
+        entropy_cuts = ecdf_cuts(entropy[entropy > 0], QUINTILES)
+        numperiods_cuts = ecdf_cuts(n_parts[n_parts > 0], QUINTILES)
 
     top = np.zeros(len(rows), dtype=np.int64)
     for i, d in enumerate(domain):

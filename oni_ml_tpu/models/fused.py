@@ -620,4 +620,15 @@ def make_chunk_runner(
             gammas_in=gammas_in, have_prev=have_prev,
         )
 
-    return jax.jit(run_chunk_dispatch, compiler_options=compiler_options)
+    runner = jax.jit(run_chunk_dispatch, compiler_options=compiler_options)
+    # The EFFECTIVE dispatch settings ride on the runner so callers that
+    # report them (bench.py's phase records) read what this runner was
+    # actually built with — a monkeypatched maker (tools/tpu_probes.py
+    # alpha_ab overrides alpha_max_iters inside its wrapper) would
+    # otherwise desync the payload from the measurement.
+    try:
+        runner.alpha_max_iters = alpha_max_iters
+        runner.chunk = chunk
+    except AttributeError:  # a jit wrapper that rejects attributes
+        pass
+    return runner

@@ -48,7 +48,13 @@ def _alpha_objective_grads(log_a: jnp.ndarray, ss: jnp.ndarray, d: int, k: int):
     return a, df, d2f
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+# static_argnames spelled explicitly for max_iters: every caller passes
+# it by KEYWORD, and argnums-only treatment of a keyword arg leans on
+# JAX's signature inference (argnum -> name resolution), which is
+# version-dependent behavior — a JAX where it no longer applies would
+# trace max_iters as dynamic and fail on the Python `if max_iters <= 16`
+# below.  d/k stay positional at every call site, so argnums covers them.
+@partial(jax.jit, static_argnums=(2, 3), static_argnames=("max_iters",))
 def update_alpha(alpha_ss: jnp.ndarray, alpha_init: jnp.ndarray, d: int, k: int,
                  max_iters: int = 100):
     """Maximize L(a) = D(lgam(Ka) - K lgam(a)) + a * ss over the symmetric
@@ -904,8 +910,25 @@ class LDATrainer:
             )
         )
         have_prev = jnp.asarray(False)
+        # Host-sync cadence: host_sync_every bounds the iterations per
+        # dispatch independently of the compiled chunk size, so
+        # likelihood.dat streams (and progress fires) at least that
+        # often — with chunk=128 and checkpointing off a whole fit is
+        # otherwise ONE dispatch and a crash loses every likelihood
+        # line.  The chunk program takes its step count dynamically
+        # (like the checkpoint cap below), so no recompile.
+        if cfg.host_sync_every < 0:
+            # min(chunk, negative) would request negative steps every
+            # dispatch — a silent zero-iteration "fit" writing out the
+            # random init as if trained.
+            raise ValueError(
+                f"host_sync_every must be >= 0, got {cfg.host_sync_every}"
+            )
+        sync_chunk = cfg.fused_em_chunk
+        if cfg.host_sync_every:
+            sync_chunk = min(sync_chunk, cfg.host_sync_every)
         while it < cfg.em_max_iters:
-            stop = min(it + cfg.fused_em_chunk, cfg.em_max_iters)
+            stop = min(it + sync_chunk, cfg.em_max_iters)
             if checkpoint_path and cfg.checkpoint_every:
                 next_ckpt = (
                     it // cfg.checkpoint_every + 1

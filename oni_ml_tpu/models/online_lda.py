@@ -340,6 +340,51 @@ class OnlineLDATrainer:
                          compiler_options=compiler_options)
         )
 
+    @classmethod
+    def from_topic_probs(
+        cls,
+        config: OnlineLDAConfig,
+        topic_probs: np.ndarray,
+        total_docs: int,
+        pseudo_tokens: float = 1e4,
+        **kwargs,
+    ) -> "OnlineLDATrainer":
+        """Seed the stream from an EXISTING model instead of Hoffman's
+        random init: `topic_probs` is the [V, K] p(word|topic) matrix
+        the batch pipeline publishes (word_results.csv columns, each
+        topic summing to 1 over words).  lambda[k, v] = eta +
+        pseudo_tokens * p[v, k], so E_q[beta] ≈ p for pseudo_tokens >>
+        eta*V and the first natural-gradient steps REFINE the batch
+        topics rather than washing them out (rho at t=0 is already
+        < tau0^-kappa).  This is the serving refresh loop's entry point
+        (oni_ml_tpu/serving/refresh.py): day artifacts -> streaming
+        updates without a retrain."""
+        p = np.asarray(topic_probs, np.float64)
+        if p.ndim != 2 or p.shape[1] != config.num_topics:
+            raise ValueError(
+                f"topic_probs must be [V, {config.num_topics}], got "
+                f"{p.shape}"
+            )
+        if not np.isfinite(p).all() or (p < 0).any():
+            raise ValueError("topic_probs must be finite and nonnegative")
+        trainer = cls(config, num_terms=p.shape[0],
+                      total_docs=total_docs, **kwargs)
+        if trainer.step_count > 0:
+            # A checkpoint_path kwarg restored an in-progress stream:
+            # the RESUME wins — overwriting lambda with the seed while
+            # keeping the checkpoint's step_count would put the rho
+            # schedule at step N over reset topics, a silently
+            # inconsistent state.
+            return trainer
+        dtype = jnp.dtype(config.compute_dtype)
+        lam = jnp.asarray(config.eta + pseudo_tokens * p.T, dtype)
+        if trainer.mesh is not None:
+            from ..parallel.mesh import replicated
+
+            lam = jax.device_put(lam, replicated(trainer.mesh))
+        trainer._lam = lam
+        return trainer
+
     @property
     def lam(self) -> jnp.ndarray:
         return self._lam
@@ -555,7 +600,13 @@ class OnlineLDATrainer:
         scoring stage just like the batch trainer's last E-step.  Runs
         through the same (possibly shard_map'd) E-step as training."""
         cfg = self.config
-        e_fn = jax.jit(self._e_fn)
+        # One jitted wrapper for the trainer's lifetime: the serving
+        # refresh loop calls infer_gamma every few batches, and a fresh
+        # jax.jit per call would pay wrapper-cache misses on the scoring
+        # worker thread instead of hitting the (B, L)-shape cache.
+        e_fn = getattr(self, "_infer_e_fn", None)
+        if e_fn is None:
+            e_fn = self._infer_e_fn = jax.jit(self._e_fn)
         log_b = expected_log_beta(self._lam)
         gamma_out = np.zeros((num_docs, cfg.num_topics), np.float64)
         for b in batches:

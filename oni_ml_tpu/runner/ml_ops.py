@@ -724,7 +724,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ml_ops",
         description="oni_ml_tpu suspicious-connects pipeline "
-        "(replaces ml_ops.sh YYYYMMDD {flow|dns} [TOL])",
+        "(replaces ml_ops.sh YYYYMMDD {flow|dns} [TOL]); "
+        "`ml_ops serve --help` for the streaming scoring service",
     )
     p.add_argument("fdate", help="day to analyze, YYYYMMDD")
     p.add_argument("dsource", choices=["flow", "dns"])
@@ -847,6 +848,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import sys
+
+    if argv is None:
+        argv = sys.argv[1:]
+    # `ml_ops serve ...` is the streaming scoring service (runner/serve.py)
+    # — a long-running process over a COMPLETED day's artifacts, not a
+    # fifth batch stage, so it routes before the YYYYMMDD parser.
+    if argv and argv[0] == "serve":
+        from . import serve
+
+        return serve.main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if len(args.fdate) != 8 or not args.fdate.isdigit():

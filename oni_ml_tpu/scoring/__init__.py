@@ -3,6 +3,8 @@ dns_post_lda.scala."""
 
 from .score import (
     ScoringModel,
+    batched_scores,
+    device_scores,
     score_dns,
     score_dns_csv,
     score_flow,
@@ -11,6 +13,8 @@ from .score import (
 
 __all__ = [
     "ScoringModel",
+    "batched_scores",
+    "device_scores",
     "score_flow",
     "score_flow_csv",
     "score_dns",

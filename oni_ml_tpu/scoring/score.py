@@ -136,14 +136,8 @@ def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20)
         return got
     # Same range check the native path applies (native_emit.score_dot):
     # numpy would silently WRAP negative ids — usually into the
-    # fallback row, masking a caller bug — so both engines raise.
-    ip_arr = np.asarray(ip_idx)
-    w_arr = np.asarray(word_idx)
-    if n and (
-        int(ip_arr.min()) < 0 or int(ip_arr.max()) >= theta.shape[0]
-        or int(w_arr.min()) < 0 or int(w_arr.max()) >= p.shape[0]
-    ):
-        raise IndexError("model-row index out of range")
+    # fallback row, masking a caller bug — so every engine raises.
+    _check_index_range(model, ip_idx, word_idx)
     out = np.empty(n, dtype=np.float64)
     k = theta.shape[1]
     for lo in range(0, n, batch):
@@ -160,6 +154,99 @@ def _batched_scores(model: ScoringModel, ip_idx, word_idx, batch: int = 1 << 20)
             acc = acc + a[:, j] * b[:, j]
         out[lo:hi] = acc
     return out
+
+
+def _check_index_range(model: ScoringModel, ip_idx, word_idx) -> None:
+    """The shared out-of-range guard (see _batched_scores): numpy wraps
+    negative ids and jnp.take CLIPS out-of-range ones — either way a
+    caller bug would silently score against the wrong (usually fallback)
+    row, so every engine raises instead."""
+    ip_arr = np.asarray(ip_idx)
+    w_arr = np.asarray(word_idx)
+    if len(ip_arr) and (
+        int(ip_arr.min()) < 0 or int(ip_arr.max()) >= model.theta.shape[0]
+        or int(w_arr.min()) < 0 or int(w_arr.max()) >= model.p.shape[0]
+    ):
+        raise IndexError("model-row index out of range")
+
+
+# One compiled program per padded batch size (power-of-two, see
+# device_scores); keyed per call on nothing else — theta/p ride as
+# traced operands so a hot-swapped model reuses the same executable.
+_DEVICE_SCORE_FN = None
+
+
+def _device_score_fn():
+    global _DEVICE_SCORE_FN
+    if _DEVICE_SCORE_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        def score(theta, p, ip_idx, word_idx):
+            a = jnp.take(theta, ip_idx, axis=0)
+            b = jnp.take(p, word_idx, axis=0)
+            return jnp.sum(a * b, axis=-1)
+
+        _DEVICE_SCORE_FN = jax.jit(score)
+    return _DEVICE_SCORE_FN
+
+
+def _device_model(model: ScoringModel):
+    """Device copies of theta/p, cached on the model instance so a
+    long-running scorer transfers each published model once, not once
+    per micro-batch.  f32: the serving path trades the batch pipeline's
+    pinned-float64 bytes for vectorized device throughput (the golden
+    CSV contract never routes through here)."""
+    cached = getattr(model, "_device_cache", None)
+    if cached is None:
+        import jax.numpy as jnp
+
+        cached = (
+            jnp.asarray(model.theta, jnp.float32),
+            jnp.asarray(model.p, jnp.float32),
+        )
+        model._device_cache = cached
+    return cached
+
+
+def device_scores(model: ScoringModel, ip_idx, word_idx) -> np.ndarray:
+    """score[i] = <theta[ip_idx[i]], p[word_idx[i]]> as ONE jit-compiled
+    device call — the large-batch serving scorer.  Index arrays pad to
+    the next power of two so a stream of ragged micro-batch sizes
+    compiles O(log max_batch) programs, not one per size; results come
+    back float64 for drop-in use where _batched_scores is used.
+
+    Accuracy: f32 gather + f32 accumulate over K terms — agrees with the
+    host float64 path to ~1e-6 relative at K=20
+    (tests/test_serving.py pins the tolerance), which is far inside the
+    orders-of-magnitude spread suspicion thresholds cut at.  Anything
+    needing the reference's exact double-precision bytes (the batch
+    score stage) stays on _batched_scores."""
+    _check_index_range(model, ip_idx, word_idx)
+    n = len(ip_idx)
+    if n == 0:
+        return np.zeros(0, np.float64)
+    theta, p = _device_model(model)
+    m = 1 << (n - 1).bit_length()
+    ip_pad = np.zeros(m, np.int32)
+    w_pad = np.zeros(m, np.int32)
+    ip_pad[:n] = np.asarray(ip_idx, np.int32)
+    w_pad[:n] = np.asarray(word_idx, np.int32)
+    out = _device_score_fn()(theta, p, ip_pad, w_pad)
+    return np.asarray(out[:n], np.float64)
+
+
+def batched_scores(
+    model: ScoringModel, ip_idx, word_idx, device_min: int | None = None
+) -> np.ndarray:
+    """Size-dispatched scorer for the serving path: batches of
+    >= device_min events take the jit device scorer (one vectorized
+    call; wins once the batch amortizes transfer + dispatch), smaller
+    ones the host float64 path.  device_min=None pins the host path —
+    the batch pipeline's behavior."""
+    if device_min is not None and len(ip_idx) >= device_min:
+        return device_scores(model, ip_idx, word_idx)
+    return _batched_scores(model, ip_idx, word_idx)
 
 
 def _keep_order(scores: np.ndarray, threshold: float) -> np.ndarray:

@@ -1,0 +1,43 @@
+"""Streaming scoring service: the batch pipeline's once-a-day artifacts
+served as a long-running, continuously-refreshed scorer.
+
+    day artifacts (doc_results.csv / word_results.csv)
+        -> ModelRegistry      validated snapshots, atomic hot-swap
+        -> BatchScorer        micro-batch queue (max_batch / max_wait_ms),
+                              featurize via features/, host-or-device
+                              scoring by batch size, JSON-line metrics
+        -> RefreshLoop        scored batches fold into online-LDA
+                              natural-gradient steps; updated theta/p
+                              republish through the registry
+
+`python -m oni_ml_tpu.runner.ml_ops serve` is the CLI front end
+(runner/serve.py); ServingConfig (config.py) holds the knobs.
+"""
+
+from .batcher import BatchScorer, ScoreFuture
+from .events import (
+    DnsEventFeaturizer,
+    FlowEventFeaturizer,
+    event_documents,
+    featurizer_from_features,
+    score_features,
+)
+from .metrics import MetricsEmitter
+from .refresh import RefreshLoop, topic_probs_from_log_beta
+from .registry import ModelRegistry, ModelSnapshot, validate_model
+
+__all__ = [
+    "BatchScorer",
+    "ScoreFuture",
+    "DnsEventFeaturizer",
+    "FlowEventFeaturizer",
+    "event_documents",
+    "featurizer_from_features",
+    "score_features",
+    "MetricsEmitter",
+    "RefreshLoop",
+    "topic_probs_from_log_beta",
+    "ModelRegistry",
+    "ModelSnapshot",
+    "validate_model",
+]

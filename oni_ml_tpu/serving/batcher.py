@@ -1,0 +1,339 @@
+"""BatchScorer — micro-batch accumulation and exactly-once scoring.
+
+Arriving events enqueue with a per-event future; a single worker thread
+flushes the queue into featurize+score calls whenever EITHER trigger
+fires:
+
+    max_batch    the queue holds a full batch (throughput trigger), or
+    max_wait_ms  the oldest queued event has waited long enough
+                 (latency trigger).
+
+Each flush takes ONE registry snapshot, so a hot-swap that lands
+mid-batch is invisible to that batch (it finishes on the model it
+started with) and the very next batch scores on the new model — the
+double-buffered contract from serving/registry.py, observed end to end.
+
+Exactly-once: events are validated at submit (malformed events raise to
+the CALLER and never enter the queue — the featurizers drop malformed
+rows silently, which would desync scores from futures), each dequeued
+event's future is resolved exactly once, and close() drains the queue
+before stopping the worker, so no event is dropped or double-scored
+across any interleaving of submits, flushes, swaps, and shutdown.
+Backpressure: submit() blocks once queue_max events are pending, so an
+ingest stream that outruns scoring throttles at the source instead of
+accumulating futures until OOM.
+
+Per-batch latency/throughput/queue-depth counters emit as JSON lines
+(serving/metrics.py), one record per flush.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..config import ServingConfig
+from .events import event_documents, score_features
+from .metrics import MetricsEmitter
+from .registry import ModelRegistry
+
+
+class ScoreFuture:
+    """Single-event result handle: result() blocks until the event's
+    micro-batch flushed (or the scorer failed it)."""
+
+    __slots__ = ("_event", "_score", "_version", "_error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._score = None
+        self._version = None
+        self._error = None
+
+    def _resolve(self, score: float, version: int) -> None:
+        if self._event.is_set():
+            return  # exactly-once: first resolution wins
+        self._score = score
+        self._version = version
+        self._event.set()
+
+    def _fail(self, error: Exception) -> None:
+        if self._event.is_set():
+            return  # never turn an already-delivered score into an error
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> tuple[float, int]:
+        """(score, model_version); raises the scorer's error if the
+        batch failed, TimeoutError if not resolved in time."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("event not scored within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._score, self._version
+
+
+class _Pending:
+    __slots__ = ("raw", "t_enqueue", "future")
+
+    def __init__(self, raw, t_enqueue: float) -> None:
+        self.raw = raw
+        self.t_enqueue = t_enqueue
+        self.future = ScoreFuture()
+
+
+class BatchScorer:
+    """Micro-batching scoring front end over a ModelRegistry.
+
+    `featurizer` is a serving featurizer (serving/events.py): it
+    validates single events, turns a list of them into a feature
+    container, and names its dsource.  `on_batch(snapshot, feats,
+    scores)` runs on the worker thread after each flush — the refresh
+    loop and output sinks hang off it.
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        featurizer,
+        config: ServingConfig | None = None,
+        metrics: MetricsEmitter | None = None,
+        on_batch=None,
+    ) -> None:
+        self.registry = registry
+        self.featurizer = featurizer
+        self.config = config or ServingConfig()
+        if self.config.max_batch < 1 or self.config.queue_max < 1:
+            # max_batch=0 would make the first flush return an empty
+            # batch — which the worker loop reads as shutdown — and
+            # queue_max=0 deadlocks the first submit; fail construction
+            # instead of hanging every future.
+            raise ValueError(
+                f"max_batch ({self.config.max_batch}) and queue_max "
+                f"({self.config.queue_max}) must both be >= 1"
+            )
+        if self.config.max_wait_ms <= 0:
+            raise ValueError(
+                f"max_wait_ms must be > 0, got {self.config.max_wait_ms}"
+            )
+        self.metrics = metrics
+        self.on_batch = on_batch
+        self._pending: deque[_Pending] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._force_flush = False
+        self._batch_seq = 0
+        self._events_scored = 0
+        self._worker = threading.Thread(
+            target=self._run, name="oni-batch-scorer", daemon=True
+        )
+        self._worker.start()
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, raw) -> ScoreFuture:
+        """Enqueue one raw event; raises ValueError immediately on a
+        malformed event (never enqueued), RuntimeError after close().
+        BLOCKS for backpressure once queue_max events are pending, so a
+        producer that outruns scoring throttles instead of growing the
+        queue without bound."""
+        validated = self.featurizer.validate(raw)
+        with self._cond:
+            while not self._closed and \
+                    len(self._pending) >= self.config.queue_max:
+                self._cond.wait()
+            if self._closed:
+                raise RuntimeError("BatchScorer is closed")
+            p = _Pending(validated, time.perf_counter())
+            self._pending.append(p)
+            self._cond.notify_all()
+            return p.future
+
+    def submit_many(self, raws) -> list[ScoreFuture]:
+        return [self.submit(r) for r in raws]
+
+    def flush(self) -> None:
+        """Flush whatever is queued without waiting for either trigger.
+        No-op on an empty queue (an armed flag would otherwise flush the
+        NEXT event, minutes later, as a batch of one)."""
+        with self._cond:
+            if self._pending:
+                self._force_flush = True
+                self._cond.notify_all()
+
+    def close(self, timeout: float | None = None) -> bool:
+        """Drain the queue, then stop the worker.  With the default
+        timeout=None this blocks until every event submitted before
+        close() has been scored (zero dropped).  With a finite timeout,
+        a drain that outlives it FAILS the still-queued futures (so no
+        caller blocks forever on a score that will never come) and
+        returns False instead of silently abandoning them."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout)
+        if not self._worker.is_alive():
+            return True
+        with self._cond:
+            undrained = list(self._pending)
+            self._pending.clear()
+        err = RuntimeError(
+            f"BatchScorer.close timed out after {timeout}s with "
+            f"{len(undrained)} events undrained"
+        )
+        for p in undrained:
+            p.future._fail(err)
+        return False
+
+    @property
+    def events_scored(self) -> int:
+        return self._events_scored
+
+    @property
+    def batches_flushed(self) -> int:
+        return self._batch_seq
+
+    # -- worker side --------------------------------------------------------
+
+    def _take_batch(self) -> tuple[list[_Pending], str, int]:
+        """Block until a flush trigger fires; returns (batch, trigger,
+        queue_depth_after).  Empty batch means shutdown."""
+        cfg = self.config
+        max_wait_s = cfg.max_wait_ms / 1e3
+        with self._cond:
+            while not self._pending and not self._closed:
+                self._cond.wait()
+            if not self._pending:
+                return [], "shutdown", 0
+            trigger = "close" if self._closed else None
+            while trigger is None:
+                if self._force_flush:
+                    trigger = "flush"
+                    break
+                if len(self._pending) >= cfg.max_batch:
+                    trigger = "max_batch"
+                    break
+                waited = time.perf_counter() - self._pending[0].t_enqueue
+                if waited >= max_wait_s:
+                    trigger = "max_wait"
+                    break
+                self._cond.wait(max_wait_s - waited)
+                if self._closed:
+                    trigger = "close"
+            self._force_flush = False
+            batch = [
+                self._pending.popleft()
+                for _ in range(min(len(self._pending), cfg.max_batch))
+            ]
+            self._cond.notify_all()  # release submitters blocked on queue_max
+            return batch, trigger, len(self._pending)
+
+    def _run(self) -> None:
+        while True:
+            batch, trigger, depth = self._take_batch()
+            if not batch:
+                return
+            try:
+                self._score_batch(batch, trigger, depth)
+            except Exception as e:
+                # The worker must survive ANYTHING a batch throws
+                # (metrics IO, a consumer bug): a dead worker would hang
+                # every future submit.  Futures already resolved keep
+                # their scores; unresolved ones fail with the cause.
+                for p in batch:
+                    p.future._fail(e)
+
+    def _score_batch(self, batch: list[_Pending], trigger: str,
+                     depth: int) -> None:
+        cfg = self.config
+        t0 = time.perf_counter()
+        try:
+            snap = self.registry.active()
+            feats = self.featurizer([p.raw for p in batch])
+            if feats.num_raw_events != len(batch):
+                # submit() validation should make this unreachable; if a
+                # featurizer ever drops a validated row the misalignment
+                # must fail the batch loudly, not score wrong rows.
+                raise RuntimeError(
+                    f"featurizer returned {feats.num_raw_events} rows "
+                    f"for {len(batch)} events"
+                )
+            scores = score_features(
+                snap.model, feats, self.featurizer.dsource,
+                device_min=cfg.device_score_min,
+            )
+        except Exception as e:
+            for p in batch:
+                p.future._fail(e)
+            self._emit_safe({
+                "stage": "serve", "batch": self._batch_seq,
+                "events": len(batch), "error": repr(e),
+                "trigger": trigger,
+            })
+            self._batch_seq += 1
+            return
+        t1 = time.perf_counter()
+        for p, s in zip(batch, scores):
+            p.future._resolve(float(s), snap.version)
+        self._events_scored += len(batch)
+        seq = self._batch_seq
+        self._batch_seq += 1
+        # Consumers run BEFORE the metrics emit: a metrics IO failure (a
+        # full disk under --metrics) must not cost the batch its flagged
+        # output / refresh evidence — observability is secondary to
+        # delivery.  Both sides are isolated so neither can kill the
+        # worker or skip the other.
+        if self.on_batch is not None:
+            try:
+                self.on_batch(snap, feats, scores)
+            except Exception as e:
+                # A consumer failure (refresh-loop publish rejected, a
+                # broken output pipe) must never take down scoring: the
+                # batch's scores are already delivered — record the
+                # error and keep serving.
+                self._emit_safe({
+                    "stage": "serve", "batch": seq,
+                    "on_batch_error": repr(e),
+                })
+        n = len(batch)
+        score_s = t1 - t0
+        self._emit_safe({
+            "stage": "serve",
+            "batch": seq,
+            "events": n,
+            "trigger": trigger,
+            "model_version": snap.version,
+            "scorer": (
+                "device" if n >= cfg.device_score_min else "host"
+            ),
+            # Latency of the oldest event, enqueue -> scored (the
+            # number max_wait_ms bounds the left edge of), plus the
+            # pure scoring cost and the resulting throughput.
+            "latency_ms": round((t1 - batch[0].t_enqueue) * 1e3, 3),
+            "score_ms": round(score_s * 1e3, 3),
+            "events_per_sec": round(n / score_s, 1) if score_s else None,
+            "queue_depth": depth,
+            "flagged": int(np.sum(scores < cfg.threshold)),
+        })
+
+    def _emit_safe(self, record: dict) -> None:
+        """Metrics emit that cannot take anything else down with it."""
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.emit(record)
+        except Exception as e:
+            import sys
+
+            print(f"serving metrics emit failed: {e!r}", file=sys.stderr)
+
+    def observe_documents(self, feats):
+        """Convenience passthrough so on_batch consumers need not import
+        events.py: (ips, words) for this batch's refresh contribution."""
+        return event_documents(feats, self.featurizer.dsource)

@@ -1,0 +1,141 @@
+"""Event ingress for the serving path: raw CSV events -> featurized
+micro-batches -> (ip, word) model lookups, through the SAME featurizers
+the batch pre stage runs (features/flow.py, features/dns.py).
+
+The one thing serving must pin that the batch path derives per-day is
+the quantile cuts: a micro-batch's own ECDF would bin values differently
+from the trained day and silently unmap every word from the model
+vocabulary.  Featurizers here therefore always carry precomputed cuts —
+taken from the trained day's features.pkl (every FlowFeatures /
+DnsFeatures instance records its cuts) or a qtiles file.
+
+Events are validated at submit time (column count), so a featurized
+micro-batch always has exactly one row per submitted event — the
+exactly-once accounting in BatchScorer depends on that alignment.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..features.dns import DNS_COLUMNS, NUM_DNS_COLUMNS, featurize_dns
+from ..features.flow import NUM_FLOW_COLUMNS, featurize_flow
+from ..scoring import ScoringModel, batched_scores
+
+
+class FlowEventFeaturizer:
+    """Raw 27-column netflow CSV lines -> FlowFeatures, with the trained
+    day's (time, ibyt, ipkt) cuts."""
+
+    dsource = "flow"
+
+    def __init__(self, cuts: tuple) -> None:
+        self.cuts = tuple(np.asarray(c, np.float64) for c in cuts)
+
+    def validate(self, line: str) -> str:
+        if len(line.strip().split(",")) != NUM_FLOW_COLUMNS:
+            raise ValueError(
+                f"flow event needs {NUM_FLOW_COLUMNS} columns: {line!r}"
+            )
+        return line
+
+    def __call__(self, lines: Sequence[str]):
+        return featurize_flow(
+            lines, skip_header=False, precomputed_cuts=self.cuts
+        )
+
+
+class DnsEventFeaturizer:
+    """Raw 8-column DNS CSV lines (or pre-split rows) -> DnsFeatures,
+    with the trained day's five cut vectors."""
+
+    dsource = "dns"
+
+    def __init__(self, cuts: tuple,
+                 top_domains: frozenset = frozenset()) -> None:
+        self.cuts = tuple(np.asarray(c, np.float64) for c in cuts)
+        self.top_domains = top_domains
+
+    def validate(self, event) -> list[str]:
+        row = event.strip().split(",") if isinstance(event, str) else list(event)
+        if len(row) != NUM_DNS_COLUMNS:
+            raise ValueError(
+                f"dns event needs {NUM_DNS_COLUMNS} columns: {event!r}"
+            )
+        return row
+
+    def __call__(self, rows: Sequence[Sequence[str]]):
+        return featurize_dns(
+            rows, top_domains=self.top_domains,
+            precomputed_cuts=self.cuts,
+        )
+
+
+def featurizer_from_features(features, top_domains: frozenset = frozenset()):
+    """Build the serving featurizer from a trained day's feature
+    container (features.pkl) — the cuts ride on every FlowFeatures /
+    DnsFeatures instance, native- or Python-backed."""
+    if hasattr(features, "ibyt_cuts"):
+        return FlowEventFeaturizer(
+            (features.time_cuts, features.ibyt_cuts, features.ipkt_cuts)
+        )
+    if hasattr(features, "entropy_cuts"):
+        return DnsEventFeaturizer(
+            (features.time_cuts, features.frame_length_cuts,
+             features.subdomain_length_cuts, features.entropy_cuts,
+             features.numperiods_cuts),
+            top_domains=top_domains,
+        )
+    raise TypeError(
+        f"{type(features).__name__} carries no quantile cuts — not a "
+        "flow/dns feature container"
+    )
+
+
+def score_features(
+    model: ScoringModel, feats, dsource: str,
+    device_min: "int | None" = None,
+) -> np.ndarray:
+    """Per-event suspicion scores for one featurized micro-batch —
+    min(src, dest) dot for flow (flow_post_lda.scala:227-239), single
+    <theta_ip, p_word> for DNS — through the size-dispatched
+    host/device scorer."""
+    n = feats.num_raw_events
+    if dsource == "flow":
+        src = batched_scores(
+            model,
+            model.ip_rows([feats.sip(i) for i in range(n)]),
+            model.word_rows(list(feats.src_word[:n])),
+            device_min,
+        )
+        dst = batched_scores(
+            model,
+            model.ip_rows([feats.dip(i) for i in range(n)]),
+            model.word_rows(list(feats.dest_word[:n])),
+            device_min,
+        )
+        return np.minimum(src, dst)
+    return batched_scores(
+        model,
+        model.ip_rows([feats.client_ip(i) for i in range(n)]),
+        model.word_rows(list(feats.word[:n])),
+        device_min,
+    )
+
+
+def event_documents(feats, dsource: str) -> tuple[list[str], list[str]]:
+    """(ips, words) training pairs a micro-batch contributes to the
+    online refresh — the same document mapping the corpus stage uses:
+    flow events feed BOTH endpoints' documents
+    (flow_pre_lda.scala:366-380), DNS events feed the querying client
+    (dns_pre_lda.scala:330)."""
+    n = feats.num_raw_events
+    if dsource == "flow":
+        ips = [feats.sip(i) for i in range(n)]
+        ips += [feats.dip(i) for i in range(n)]
+        words = list(feats.src_word[:n]) + list(feats.dest_word[:n])
+        return ips, words
+    ip_col = DNS_COLUMNS["ip_dst"]
+    return [r[ip_col] for r in feats.rows[:n]], list(feats.word[:n])

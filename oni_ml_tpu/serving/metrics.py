@@ -1,0 +1,48 @@
+"""Per-batch serving metrics as JSON lines.
+
+Same convention as runner/ml_ops.py's stage metrics (one json.dumps'd
+dict per line to stdout, records retained for a file dump) so the
+observability surface is uniform across batch and serving: a consumer
+tailing metrics sees {"stage": "serve", ...} lines exactly where it
+already sees {"stage": "lda", ...} ones.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+
+
+class MetricsEmitter:
+    """Thread-safe JSON-lines emitter.  `path` appends each line to a
+    file as it is emitted (crash-safe: flushed per line, nothing held
+    for an exit-time dump); stdout printing can be disabled for
+    library/test embedding.  `records` keeps only the most recent
+    `keep_records` entries — a serve process flushing every 50 ms emits
+    ~1.7M records/day, so unbounded retention (the batch runner's
+    exit-time-dump convention) would be a slow OOM here; the durable
+    history is the file/stdout stream."""
+
+    def __init__(self, path: str = "", to_stdout: bool = True,
+                 keep_records: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._to_stdout = to_stdout
+        self._file = open(path, "a") if path else None
+        self.records: deque[dict] = deque(maxlen=keep_records)
+
+    def emit(self, record: dict) -> None:
+        line = json.dumps(record)
+        with self._lock:
+            self.records.append(record)
+            if self._to_stdout:
+                print(line, flush=True)
+            if self._file is not None:
+                self._file.write(line + "\n")
+                self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None

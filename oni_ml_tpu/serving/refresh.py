@@ -1,0 +1,153 @@
+"""RefreshLoop — fold scored micro-batches into online-LDA updates and
+republish theta/p to the registry on a cadence.
+
+This closes the loop the batch pipeline leaves open: the day's model
+goes stale the moment it is published, and the reference's only answer
+is tomorrow's retrain (ml_ops.sh runs once a day).  Here every scored
+micro-batch also contributes its (ip, word) pairs as training evidence;
+every `refresh_every` batches the accumulated evidence becomes one
+stochastic-variational natural-gradient step (models/online_lda.py —
+the SVI update is built for exactly this micro-batch regime), and the
+updated topics republish through the registry's atomic hot-swap, so
+in-flight scoring never sees a half-updated model.
+
+Scope pinned at load time: the model's vocabulary and IP population are
+frozen (events with unseen words/IPs score via the fallback rows and are
+skipped as refresh evidence — extending the populations online would
+change word/doc identity out from under the registry's validation).
+Growing them is a corpus-versioning feature, not a refresh feature.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import OnlineLDAConfig
+from ..io import Batch
+from ..models.online_lda import OnlineLDATrainer
+from ..scoring import ScoringModel
+from .registry import ModelRegistry, ModelSnapshot
+
+
+def topic_probs_from_log_beta(log_beta: np.ndarray) -> np.ndarray:
+    """[K, V] log p(w|z) -> the [V, K] per-topic-normalized matrix the
+    scorer consumes — the same exp-normalize io/formats.py
+    write_word_results performs, so a refresh publishes exactly what a
+    re-run of the batch post stage would."""
+    log_beta = np.asarray(log_beta, np.float64)
+    shifted = np.exp(log_beta - log_beta.max(axis=1, keepdims=True))
+    return (shifted / shifted.sum(axis=1, keepdims=True)).T
+
+
+class RefreshLoop:
+    """Accumulates (ip, word) evidence per scored batch; every
+    `every` batches performs one SVI step and publishes the result."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        config: OnlineLDAConfig | None = None,
+        every: int = 8,
+        total_docs: int = 0,
+        pseudo_tokens: float = 1e4,
+    ) -> None:
+        snap = registry.active()
+        model = snap.model
+        k = model.num_topics
+        self.registry = registry
+        self.every = every
+        self.config = config or OnlineLDAConfig(num_topics=k)
+        if self.config.num_topics != k:
+            raise ValueError(
+                f"refresh config has K={self.config.num_topics} but the "
+                f"registry model has K={k}"
+            )
+        num_ips = len(model.ip_index)
+        # p without its fallback row is the [V, K] matrix SVI refines.
+        self.trainer = OnlineLDATrainer.from_topic_probs(
+            self.config,
+            np.asarray(model.p[:-1], np.float64),
+            total_docs=total_docs or max(num_ips, 1),
+            pseudo_tokens=pseudo_tokens,
+        )
+        self._counts: dict[str, dict[int, float]] = {}
+        self._batches_seen = 0
+        self.refreshes = 0
+
+    def observe(self, snapshot: ModelSnapshot, ips: list[str],
+                words: list[str]) -> "ModelSnapshot | None":
+        """Fold one scored batch's (ip, word) pairs in; returns the new
+        snapshot when this batch crossed the refresh cadence, else
+        None.  Pairs with out-of-vocabulary words or unknown IPs are
+        skipped (fallback rows are config constants, not trainable)."""
+        model = snapshot.model
+        v = len(model.word_index)
+        word_rows = model.word_rows(words)
+        ip_index = model.ip_index
+        for ip, wr in zip(ips, word_rows):
+            if wr == v or ip not in ip_index:
+                continue
+            doc = self._counts.setdefault(ip, {})
+            doc[int(wr)] = doc.get(int(wr), 0.0) + 1.0
+        self._batches_seen += 1
+        if self.every and self._batches_seen % self.every == 0 \
+                and self._counts:
+            return self.refresh()
+        return None
+
+    def _build_batch(self) -> tuple[Batch, list[str]]:
+        """Accumulated per-IP counts -> one padded micro-batch (the
+        Batch contract of io/corpus.py: ids padded with 0, counts/mask
+        0).  L pads to a multiple of 8 and B to a multiple of 8 so a
+        steady refresh cadence reuses a handful of compiled shapes."""
+        docs = sorted(self._counts.items())
+        ips = [ip for ip, _ in docs]
+        b = len(docs)
+        l = max(len(d) for _, d in docs)
+        l_pad = max(8, -(-l // 8) * 8)
+        b_pad = max(8, -(-b // 8) * 8)
+        word_idx = np.zeros((b_pad, l_pad), np.int32)
+        counts = np.zeros((b_pad, l_pad), np.float32)
+        mask = np.zeros((b_pad,), np.float32)
+        for i, (_, doc) in enumerate(docs):
+            for j, (wid, c) in enumerate(sorted(doc.items())):
+                word_idx[i, j] = wid
+                counts[i, j] = c
+            mask[i] = 1.0
+        return Batch(
+            word_idx=word_idx,
+            counts=counts,
+            doc_index=np.arange(b_pad, dtype=np.int32),
+            doc_mask=mask,
+        ), ips
+
+    def refresh(self) -> ModelSnapshot:
+        """One natural-gradient step over the accumulated evidence, then
+        publish: new p for every word, new theta rows for the IPs that
+        appeared (everyone else keeps their batch-day posterior — SVI's
+        doc-topic gamma is per-document local state, so absent documents
+        have no update)."""
+        batch, ips = self._build_batch()
+        active = self.registry.active().model
+        self.trainer.step(batch)
+        gamma = self.trainer.infer_gamma([batch],
+                                         num_docs=batch.word_idx.shape[0])
+        p_vk = topic_probs_from_log_beta(self.trainer.log_beta())
+        new_p = np.concatenate([p_vk, active.p[-1:]])  # keep fallback row
+        new_theta = np.array(active.theta, np.float64, copy=True)
+        for i, ip in enumerate(ips):
+            row = gamma[i]
+            total = row.sum()
+            if total > 0:
+                new_theta[active.ip_index[ip]] = row / total
+        model = ScoringModel(
+            ip_index=active.ip_index,
+            theta=new_theta,
+            word_index=active.word_index,
+            p=new_p,
+        )
+        self._counts.clear()
+        self.refreshes += 1
+        return self.registry.publish(
+            model, source=f"refresh-step{self.trainer.step_count}"
+        )

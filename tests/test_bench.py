@@ -529,3 +529,30 @@ def test_bench_sigterm_salvages_parseable_record(tmp_path):
     # grade must ride here too.
     ldv = rec["last_driver_verified"]
     assert ldv is None or "driver-captured" in ldv["provenance"]
+
+
+def test_bench_em_reports_effective_alpha_max_iters():
+    """The payload's alpha_max_iters is threaded from the chunk runner
+    make_chunk_runner actually built (via _setup_em's info), so a
+    monkeypatched maker that overrides the cap — tools/tpu_probes.py's
+    alpha_ab newton100 — reports its real setting instead of re-reading
+    bench.ALPHA_MAX_ITERS."""
+    import bench
+    from oni_ml_tpu.models import fused
+
+    em = bench.bench_em(4, 128, 32, 16, chunk=2, rounds=1, var_max_iters=3)
+    assert em["alpha_max_iters"] == bench.ALPHA_MAX_ITERS
+
+    orig = fused.make_chunk_runner
+
+    def newton100(**kw):
+        kw["alpha_max_iters"] = 100
+        return orig(**kw)
+
+    fused.make_chunk_runner = newton100
+    try:
+        em = bench.bench_em(4, 128, 32, 16, chunk=2, rounds=1,
+                            var_max_iters=3)
+    finally:
+        fused.make_chunk_runner = orig
+    assert em["alpha_max_iters"] == 100

@@ -287,3 +287,42 @@ def test_fast_path_engages_for_production_dense_shape(problem, monkeypatch):
     fused.LAST_CHUNK_PLAN = None
     train_corpus(problem, LDAConfig(**cfg))
     assert fused.LAST_CHUNK_PLAN == "generic"
+
+
+def test_host_sync_every_bounds_dispatch_without_changing_results(
+    problem, monkeypatch
+):
+    """host_sync_every caps EM iterations per device dispatch
+    independently of fused_em_chunk (likelihood.dat / progress stream at
+    least that often — the ADVICE r05 crash-safety note), and the
+    trajectory is unchanged: the chunk program just runs with a smaller
+    dynamic step count."""
+    from oni_ml_tpu.models import fused
+
+    steps_seen = []
+    orig = fused.make_chunk_runner
+
+    def counting_maker(**kw):
+        runner = orig(**kw)
+
+        def counting(log_beta, alpha, ll_prev, groups, n_steps, *a, **k):
+            steps_seen.append(int(n_steps))
+            return runner(log_beta, alpha, ll_prev, groups, n_steps,
+                          *a, **k)
+
+        return counting
+
+    monkeypatch.setattr(fused, "make_chunk_runner", counting_maker)
+    base = run(problem, em_max_iters=6, em_tol=0.0, fused_em_chunk=64)
+    assert steps_seen == [6]  # one dispatch covers the whole fit
+
+    steps_seen.clear()
+    synced = run(problem, em_max_iters=6, em_tol=0.0, fused_em_chunk=64,
+                 host_sync_every=2)
+    assert steps_seen == [2, 2, 2]  # bounded dispatches, same total
+    assert synced.em_iters == base.em_iters == 6
+    np.testing.assert_allclose(
+        [ll for ll, _ in synced.likelihoods],
+        [ll for ll, _ in base.likelihoods], rtol=1e-6,
+    )
+    np.testing.assert_allclose(synced.log_beta, base.log_beta, atol=1e-5)

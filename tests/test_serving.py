@@ -1,0 +1,622 @@
+"""Streaming scoring service (oni_ml_tpu/serving): registry hot-swap
+under concurrent scoring, micro-batch flush triggers, device-vs-host
+scorer agreement, refresh-loop republish, and the end-to-end golden-day
+smoke the acceptance criteria name.  All CPU, no markers — this file IS
+the tier-1 serving smoke.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.config import OnlineLDAConfig, ServingConfig
+from oni_ml_tpu.runner.serve import _synthetic_day
+from oni_ml_tpu.scoring import ScoringModel, batched_scores, device_scores
+from oni_ml_tpu.scoring.score import _batched_scores
+from oni_ml_tpu.serving import (
+    BatchScorer,
+    DnsEventFeaturizer,
+    MetricsEmitter,
+    ModelRegistry,
+    RefreshLoop,
+    event_documents,
+    validate_model,
+)
+
+
+@pytest.fixture(scope="module")
+def day():
+    """(raw dns rows, trained model, day cuts) — one synthetic day
+    shared by the serving tests (runner/serve.py's dry-run fixture)."""
+    return _synthetic_day()
+
+
+def _perturbed(model: ScoringModel, seed: int = 7) -> ScoringModel:
+    """A validly-normalized variant of `model` — a stand-in refresh."""
+    rng = np.random.default_rng(seed)
+    theta = model.theta * rng.uniform(0.5, 1.5, model.theta.shape)
+    theta[:-1] /= theta[:-1].sum(1, keepdims=True)
+    p = model.p * rng.uniform(0.5, 1.5, model.p.shape)
+    p[:-1] /= p[:-1].sum(0, keepdims=True)
+    return ScoringModel(
+        ip_index=model.ip_index, theta=theta,
+        word_index=model.word_index, p=p,
+    )
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_publish_and_hot_swap(day):
+    _, model, _ = day
+    reg = ModelRegistry()
+    with pytest.raises(RuntimeError):
+        reg.active()
+    s1 = reg.publish(model, source="t1")
+    assert (s1.version, reg.version) == (1, 1)
+    s2 = reg.publish(_perturbed(model), source="t2")
+    assert s2.version == 2
+    # Double-buffered: the retired snapshot stays pinned as previous.
+    assert reg.previous() is s1
+    assert reg.active() is s2
+
+
+def test_registry_rejects_invalid_models(day):
+    _, model, _ = day
+    reg = ModelRegistry()
+    reg.publish(model, source="good")
+    bad_k = ScoringModel(model.ip_index, model.theta,
+                         model.word_index, model.p[:, :-1])
+    bad_rows = ScoringModel(model.ip_index, model.theta[:-1],
+                            model.word_index, model.p)
+    bad_neg = ScoringModel(model.ip_index, -model.theta,
+                           model.word_index, model.p)
+    bad_nan = ScoringModel(model.ip_index,
+                           np.full_like(model.theta, np.nan),
+                           model.word_index, model.p)
+    for bad in (bad_k, bad_rows, bad_neg, bad_nan):
+        with pytest.raises(ValueError):
+            reg.publish(bad, source="bad")
+    # A rejected publish must leave the active snapshot untouched.
+    assert reg.active().model is model
+    assert reg.version == 1
+    assert validate_model(model) is model
+
+
+def test_registry_load_day_from_golden(tmp_path):
+    import os
+
+    golden = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden", "expected", "dns")
+    reg = ModelRegistry()
+    snap = reg.load_day(golden, fallback=0.1)
+    assert snap.version == 1 and snap.source == golden
+    assert snap.model.theta.shape[0] == len(snap.model.ip_index) + 1
+    with pytest.raises(FileNotFoundError):
+        reg.load_day(str(tmp_path), fallback=0.1)
+
+
+# ---------------------------------------------------------------------------
+# device scorer vs host scorer
+# ---------------------------------------------------------------------------
+
+
+def test_device_scorer_matches_host(day):
+    _, model, _ = day
+    rng = np.random.default_rng(3)
+    n = 1000
+    ip_idx = rng.integers(0, model.theta.shape[0], n).astype(np.int32)
+    w_idx = rng.integers(0, model.p.shape[0], n).astype(np.int32)
+    host = _batched_scores(model, ip_idx, w_idx)
+    dev = device_scores(model, ip_idx, w_idx)
+    assert dev.dtype == np.float64
+    # f32 gather+accumulate vs float64 host path: well inside 1e-5
+    # relative at K=5..20 (the suspicion threshold cuts orders of
+    # magnitude, not ulps).
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-12)
+
+
+def test_device_scorer_pads_and_range_checks(day):
+    _, model, _ = day
+    # Non-power-of-two sizes pad internally; results must slice back.
+    for n in (1, 3, 17):
+        idx = np.arange(n, dtype=np.int32) % (model.theta.shape[0])
+        widx = np.arange(n, dtype=np.int32) % (model.p.shape[0])
+        out = device_scores(model, idx, widx)
+        assert out.shape == (n,)
+    assert device_scores(model, np.zeros(0, np.int32),
+                         np.zeros(0, np.int32)).shape == (0,)
+    with pytest.raises(IndexError):
+        device_scores(model, np.asarray([-1], np.int32),
+                      np.asarray([0], np.int32))
+    with pytest.raises(IndexError):
+        device_scores(model, np.asarray([0], np.int32),
+                      np.asarray([model.p.shape[0]], np.int32))
+
+
+def test_batched_scores_size_dispatch(day):
+    _, model, _ = day
+    rng = np.random.default_rng(5)
+    n = 64
+    ip_idx = rng.integers(0, model.theta.shape[0], n).astype(np.int32)
+    w_idx = rng.integers(0, model.p.shape[0], n).astype(np.int32)
+    host = batched_scores(model, ip_idx, w_idx)                 # no device
+    below = batched_scores(model, ip_idx, w_idx, device_min=n + 1)
+    at = batched_scores(model, ip_idx, w_idx, device_min=n)     # device
+    np.testing.assert_array_equal(host, below)  # same host path
+    np.testing.assert_allclose(at, host, rtol=1e-5, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# micro-batch flush triggers
+# ---------------------------------------------------------------------------
+
+
+def _scorer(day, registry=None, **cfg_kw):
+    rows, model, cuts = day
+    reg = registry or ModelRegistry()
+    if reg.version == 0:
+        reg.publish(model, source="test")
+    metrics = MetricsEmitter(to_stdout=False)
+    scorer = BatchScorer(
+        reg, DnsEventFeaturizer(cuts), ServingConfig(**cfg_kw),
+        metrics=metrics,
+    )
+    return rows, reg, metrics, scorer
+
+
+def test_flush_on_max_batch(day):
+    rows, _, metrics, scorer = _scorer(
+        day, max_batch=8, max_wait_ms=60_000.0
+    )
+    try:
+        futures = scorer.submit_many(rows[:16])
+        # Two full batches must flush on size alone — well before the
+        # 60 s wait trigger could fire.
+        results = [f.result(timeout=30.0) for f in futures]
+        assert len(results) == 16
+        assert {r["trigger"] for r in metrics.records} == {"max_batch"}
+        assert all(r["events"] == 8 for r in metrics.records)
+    finally:
+        scorer.close()
+
+
+def test_flush_on_max_wait(day):
+    rows, _, metrics, scorer = _scorer(
+        day, max_batch=10_000, max_wait_ms=40.0
+    )
+    try:
+        futures = scorer.submit_many(rows[:3])
+        results = [f.result(timeout=30.0) for f in futures]
+        assert len(results) == 3
+        assert [r["trigger"] for r in metrics.records] == ["max_wait"]
+        assert metrics.records[0]["events"] == 3
+        # The latency counter reflects the enforced wait.
+        assert metrics.records[0]["latency_ms"] >= 40.0
+    finally:
+        scorer.close()
+
+
+def test_metrics_lines_shape(day):
+    rows, _, metrics, scorer = _scorer(day, max_batch=16, max_wait_ms=20.0)
+    try:
+        for f in scorer.submit_many(rows[:32]):
+            f.result(timeout=30.0)
+    finally:
+        scorer.close()
+    assert len(metrics.records) >= 2
+    for rec in metrics.records:
+        for key in ("stage", "batch", "events", "latency_ms", "score_ms",
+                    "events_per_sec", "queue_depth", "model_version",
+                    "scorer", "flagged", "trigger"):
+            assert key in rec, key
+        assert rec["stage"] == "serve"
+
+
+def test_submit_rejects_malformed_events(day):
+    rows, _, _, scorer = _scorer(day, max_batch=4, max_wait_ms=20.0)
+    try:
+        with pytest.raises(ValueError):
+            scorer.submit("not,enough,columns")
+        futures = scorer.submit_many(rows[:4])
+        assert len([f.result(timeout=30.0) for f in futures]) == 4
+        assert scorer.events_scored == 4  # the malformed one never entered
+    finally:
+        scorer.close()
+
+
+def test_close_drains_queue(day):
+    rows, _, _, scorer = _scorer(day, max_batch=7, max_wait_ms=60_000.0)
+    futures = scorer.submit_many(rows)  # 96 events, non-multiple of 7
+    scorer.close()                      # drains everything, all triggers
+    assert scorer.events_scored == len(rows)
+    assert all(f.done() for f in futures)
+    with pytest.raises(RuntimeError):
+        scorer.submit(rows[0])
+
+
+# ---------------------------------------------------------------------------
+# hot-swap under concurrent scoring
+# ---------------------------------------------------------------------------
+
+
+def test_hot_swap_under_concurrent_scoring(day):
+    rows, model, cuts = day
+    reg = ModelRegistry()
+    reg.publish(model, source="v1")
+    metrics = MetricsEmitter(to_stdout=False)
+    scorer = BatchScorer(
+        reg, DnsEventFeaturizer(cuts),
+        ServingConfig(max_batch=8, max_wait_ms=10.0), metrics=metrics,
+    )
+    stop = threading.Event()
+    published = []
+
+    def swapper():
+        # Keep republishing while the stream is in flight so swaps land
+        # between (and concurrently with) batch flushes.
+        i = 0
+        while not stop.is_set():
+            published.append(
+                reg.publish(_perturbed(model, seed=i), f"swap{i}").version
+            )
+            i += 1
+            time.sleep(0.005)
+
+    t = threading.Thread(target=swapper)
+    t.start()
+    try:
+        futures = []
+        for r in rows * 3:  # 288 events while swaps happen
+            futures.append(scorer.submit(r))
+        results = [f.result(timeout=60.0) for f in futures]
+    finally:
+        stop.set()
+        t.join()
+        scorer.close()
+    # Exactly-once: every submitted event resolved, none double-counted.
+    assert len(results) == len(rows) * 3
+    assert scorer.events_scored == len(rows) * 3
+    assert all(np.isfinite(s) for s, _ in results)
+    # Each batch scored on ONE coherent snapshot, and the swaps were
+    # actually observed by traffic.
+    versions = {v for _, v in results}
+    assert versions <= {1, *published}
+    assert len(published) >= 1
+    per_batch = {r["batch"]: r["model_version"] for r in metrics.records}
+    assert len(per_batch) == scorer.batches_flushed
+
+
+# ---------------------------------------------------------------------------
+# refresh loop
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_loop_republishes(day):
+    rows, model, cuts = day
+    reg = ModelRegistry()
+    snap = reg.publish(model, source="day0")
+    loop = RefreshLoop(
+        reg, OnlineLDAConfig(num_topics=model.num_topics), every=2
+    )
+    feats = DnsEventFeaturizer(cuts)(rows[:48])
+    ips, words = event_documents(feats, "dns")
+    assert loop.observe(snap, ips, words) is None      # batch 1 of 2
+    new = loop.observe(snap, ips, words)               # cadence crossed
+    assert new is not None and new.version == 2
+    assert reg.active() is new
+    assert loop.refreshes == 1
+    m = new.model
+    # Same populations (vocab/IP identity is pinned at load)...
+    assert m.ip_index is model.ip_index
+    assert m.word_index is model.word_index
+    # ...updated topics, still a valid model: per-topic word columns
+    # re-normalize and refreshed theta rows stay distributions.
+    validate_model(m)
+    np.testing.assert_allclose(m.p[:-1].sum(0), 1.0, rtol=1e-8)
+    touched = sorted({m.ip_index[ip] for ip in ips if ip in m.ip_index})
+    np.testing.assert_allclose(
+        m.theta[touched].sum(1), 1.0, rtol=1e-8
+    )
+    assert not np.allclose(m.p[:-1], model.p[:-1])     # actually moved
+    # Fallback rows are config constants, never trained.
+    np.testing.assert_array_equal(m.p[-1], model.p[-1])
+
+
+def test_refresh_skips_out_of_vocab_evidence(day):
+    rows, model, cuts = day
+    reg = ModelRegistry()
+    snap = reg.publish(model, source="day0")
+    loop = RefreshLoop(
+        reg, OnlineLDAConfig(num_topics=model.num_topics), every=1
+    )
+    ip = next(iter(model.ip_index))
+    word = next(iter(model.word_index))
+    new = loop.observe(
+        snap,
+        [ip, "10.99.99.99", ip],          # unknown IP skipped
+        [word, word, "not_a_word"],        # OOV word skipped
+    )
+    assert new is not None and new.version == 2
+    # Only the known (ip, word) pair trained; unknown IP rows untouched.
+    fallback_row = len(model.ip_index)
+    np.testing.assert_array_equal(
+        new.model.theta[fallback_row], model.theta[fallback_row]
+    )
+
+
+def test_refresh_with_no_evidence_does_not_publish(day):
+    _, model, _ = day
+    reg = ModelRegistry()
+    snap = reg.publish(model, source="day0")
+    loop = RefreshLoop(
+        reg, OnlineLDAConfig(num_topics=model.num_topics), every=1
+    )
+    assert loop.observe(snap, [], []) is None
+    assert reg.version == 1
+
+
+def test_from_topic_probs_seeds_near_input(day):
+    from oni_ml_tpu.models.online_lda import OnlineLDATrainer
+
+    _, model, _ = day
+    p = np.asarray(model.p[:-1], np.float64)       # [V, K]
+    cfg = OnlineLDAConfig(num_topics=model.num_topics)
+    tr = OnlineLDATrainer.from_topic_probs(cfg, p, total_docs=100)
+    lam = np.asarray(tr.lam, np.float64)
+    beta = lam / lam.sum(-1, keepdims=True)        # E[beta] [K, V]
+    np.testing.assert_allclose(beta.T, p, atol=2e-3)
+    with pytest.raises(ValueError):
+        OnlineLDATrainer.from_topic_probs(cfg, p[:, :-1], total_docs=100)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: golden-day model, >= 3 micro-batches, mid-stream hot-swap
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_golden_day_serving():
+    """The acceptance smoke: load the golden day's model, stream its raw
+    events through >= 3 micro-batches, hot-swap to a refreshed model
+    mid-stream via the refresh loop, and verify zero dropped / zero
+    double-scored events plus per-batch metrics lines."""
+    import os
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "golden"))
+    from generate import DNS_FALLBACK, load_dns_feats
+
+    reg = ModelRegistry()
+    snap = reg.load_day(os.path.join(here, "golden", "expected", "dns"),
+                        fallback=DNS_FALLBACK)
+    day_feats = load_dns_feats()
+    from oni_ml_tpu.serving import featurizer_from_features
+
+    featurizer = featurizer_from_features(day_feats)
+    assert featurizer.dsource == "dns"
+
+    loop = RefreshLoop(
+        reg, OnlineLDAConfig(num_topics=snap.model.num_topics), every=2
+    )
+    swaps = []
+
+    def on_batch(snapshot, feats, scores):
+        ips, words = event_documents(feats, "dns")
+        new = loop.observe(snapshot, ips, words)
+        if new is not None:
+            swaps.append(new.version)
+
+    metrics = MetricsEmitter(to_stdout=False)
+    scorer = BatchScorer(
+        reg, featurizer,
+        ServingConfig(max_batch=12, max_wait_ms=50.0), metrics=metrics,
+        on_batch=on_batch,
+    )
+    with open(os.path.join(here, "golden", "inputs", "dns.csv")) as f:
+        lines = [ln for ln in f if ln.strip()]
+    assert len(lines) == 40
+    futures = [scorer.submit(ln) for ln in lines]
+    results = [f.result(timeout=60.0) for f in futures]
+    scorer.close()
+
+    # >= 3 micro-batches; zero dropped; zero double-scored.
+    assert scorer.batches_flushed >= 3
+    assert len(results) == 40 and scorer.events_scored == 40
+    assert all(f.done() for f in futures)
+    # Mid-stream hot-swap: a refresh published and later batches served
+    # on the refreshed model.
+    assert len(swaps) >= 1
+    assert {v for _, v in results} >= {1, swaps[0]}
+    # Scores on the day model are real probabilities of this day.
+    assert all(0.0 <= s <= 1.0 for s, _ in results)
+    # Per-batch latency/throughput metrics lines were emitted.
+    assert len(metrics.records) == scorer.batches_flushed
+    assert all("latency_ms" in r and "events_per_sec" in r
+               for r in metrics.records)
+
+
+def test_serve_dry_run_cli(capsys):
+    """`ml_ops serve --dry-run` — the tools/serve_smoke.py path — runs
+    the whole stack in-process and reports ok."""
+    import json
+
+    from oni_ml_tpu.runner import ml_ops
+
+    assert ml_ops.main(["serve", "--dry-run"]) == 0
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(last)
+    assert summary["serve_dry_run"] == "ok"
+    assert summary["batches"] >= 3
+    assert summary["refresh_swaps"] >= 1
+
+
+def test_on_batch_error_does_not_kill_worker(day):
+    """A consumer failure (refresh publish rejected, broken sink) must
+    be recorded and survived — a dead worker would hang every
+    subsequent submit's future."""
+    rows, model, cuts = day
+    reg = ModelRegistry()
+    reg.publish(model, source="v1")
+    metrics = MetricsEmitter(to_stdout=False)
+    calls = []
+
+    def exploding(snapshot, feats, scores):
+        calls.append(len(scores))
+        raise ValueError("consumer bug")
+
+    scorer = BatchScorer(
+        reg, DnsEventFeaturizer(cuts),
+        ServingConfig(max_batch=8, max_wait_ms=20.0), metrics=metrics,
+        on_batch=exploding,
+    )
+    try:
+        first = scorer.submit_many(rows[:8])
+        [f.result(timeout=30.0) for f in first]      # scores delivered
+        second = scorer.submit_many(rows[8:16])      # worker still alive
+        [f.result(timeout=30.0) for f in second]
+    finally:
+        scorer.close()
+    assert len(calls) == 2
+    assert scorer.events_scored == 16
+    assert any("on_batch_error" in r for r in metrics.records)
+
+
+def test_submit_backpressure_blocks_not_grows(day):
+    """With queue_max=4 a fast producer must still stream everything
+    through (submit blocks until the worker drains) and the queue can
+    never exceed the bound."""
+    raw_rows, model, cuts = day
+    reg = ModelRegistry()
+    reg.publish(model, source="v1")
+    scorer = BatchScorer(
+        reg, DnsEventFeaturizer(cuts),
+        ServingConfig(max_batch=2, max_wait_ms=5.0, queue_max=4),
+    )
+    try:
+        futures = scorer.submit_many(raw_rows[:24])
+        results = [f.result(timeout=60.0) for f in futures]
+        assert len(results) == 24
+        assert scorer.events_scored == 24
+    finally:
+        scorer.close()
+
+
+def test_flush_on_empty_queue_is_noop(day):
+    """flush() with nothing queued must not arm a flag that flushes the
+    NEXT event as a premature batch of one."""
+    rows, _, metrics, scorer = _scorer(
+        day, max_batch=4, max_wait_ms=60_000.0
+    )
+    try:
+        scorer.flush()                       # empty: must not arm
+        time.sleep(0.05)
+        futures = scorer.submit_many(rows[:4])
+        [f.result(timeout=30.0) for f in futures]
+        assert [r["trigger"] for r in metrics.records] == ["max_batch"]
+    finally:
+        scorer.close()
+
+
+def test_validate_rejects_denormalized_model(day):
+    _, model, _ = day
+    reg = ModelRegistry()
+    bad_theta = ScoringModel(model.ip_index, model.theta * 37.0,
+                             model.word_index, model.p)
+    bad_p = ScoringModel(model.ip_index, model.theta,
+                         model.word_index, model.p * 37.0)
+    for bad in (bad_theta, bad_p):
+        with pytest.raises(ValueError):
+            reg.publish(bad, source="denormalized")
+
+
+def test_from_topic_probs_resume_wins_over_seed(day, tmp_path):
+    """A checkpoint_path restoring an in-progress stream must keep the
+    checkpoint's lambda — half-applying the seed over the checkpoint's
+    step_count would desync topics from the rho schedule."""
+    from oni_ml_tpu.models.online_lda import (
+        OnlineLDATrainer,
+        save_stream_checkpoint,
+    )
+
+    _, model, _ = day
+    p = np.asarray(model.p[:-1], np.float64)
+    cfg = OnlineLDAConfig(num_topics=model.num_topics)
+    ckpt = str(tmp_path / "stream.npz")
+    lam_ckpt = np.full((cfg.num_topics, p.shape[0]), 3.25)
+    save_stream_checkpoint(ckpt, lam_ckpt, cfg.alpha, step=5,
+                           history=[(-1.0, 0.1)] * 5)
+    tr = OnlineLDATrainer.from_topic_probs(
+        cfg, p, total_docs=100, checkpoint_path=ckpt
+    )
+    assert tr.step_count == 5
+    np.testing.assert_allclose(np.asarray(tr.lam), lam_ckpt, rtol=1e-6)
+
+
+def test_host_sync_every_negative_rejected():
+    import reference_lda as ref
+    from oni_ml_tpu.config import LDAConfig
+    from oni_ml_tpu.models import train_corpus
+    from test_lda import corpus_from_docs
+
+    docs, _ = ref.make_synthetic_corpus(
+        num_docs=16, num_terms=20, num_topics=2, seed=0
+    )
+    corpus = corpus_from_docs(docs, 20)
+    with pytest.raises(ValueError, match="host_sync_every"):
+        train_corpus(corpus, LDAConfig(
+            num_topics=2, em_max_iters=2, batch_size=16,
+            host_sync_every=-1,
+        ))
+
+
+def test_dry_run_honors_flags(capsys):
+    import json
+
+    from oni_ml_tpu.runner import ml_ops
+
+    assert ml_ops.main(["serve", "--dry-run", "--max-batch", "8",
+                        "--refresh-every", "1"]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["serve_dry_run"] == "ok"
+    assert summary["batches"] == 12        # 96 events / max_batch 8
+    assert summary["refresh_swaps"] >= 2   # refresh every batch
+
+
+def test_batch_scorer_rejects_degenerate_config(day):
+    """max_batch=0 would silently kill the worker (empty flush reads as
+    shutdown) and queue_max=0 deadlocks the first submit — both must
+    fail construction instead."""
+    rows, model, cuts = day
+    reg = ModelRegistry()
+    reg.publish(model, source="v1")
+    for bad in (dict(max_batch=0), dict(queue_max=0),
+                dict(max_wait_ms=0.0)):
+        with pytest.raises(ValueError):
+            BatchScorer(reg, DnsEventFeaturizer(cuts),
+                        ServingConfig(**bad))
+
+
+def test_serve_stream_header_detection():
+    """The serving ingress matches the batch pre stage's removeHeader:
+    a leading column-name line is a header, data and garbage rows are
+    not (garbage keeps the batch path's NaN-score semantics)."""
+    from oni_ml_tpu.runner.serve import _looks_like_header
+
+    flow_header = ("tstart,year,month,day,hour,min,sec,tdur,sip,dip,"
+                   "sport,dport,proto,flag,fwd,stos,ipkt,ibyt,opkt,obyt,"
+                   "in,out,sas,das,dtos,dir,rip")
+    flow_row = ("2016-01-22 00:00:00,2016,1,22,5,38,2,0.0,10.0.0.2,"
+                "10.1.0.3,46720,53,TCP,,0,0,69,26614,0,0,0,0,0,0,0,0,0")
+    assert _looks_like_header(flow_header, "flow")
+    assert not _looks_like_header(flow_row, "flow")
+    dns_header = ("frame_time,unix_tstamp,frame_len,ip_dst,dns_qry_name,"
+                  "dns_qry_class,dns_qry_type,dns_qry_rcode")
+    dns_row = "t,1454000000,100,10.0.0.1,a.example.com,1,1,0"
+    assert _looks_like_header(dns_header, "dns")
+    assert not _looks_like_header(dns_row, "dns")
+    assert not _looks_like_header("short", "flow")

@@ -1,0 +1,56 @@
+"""Fast serving sanity check: run `ml_ops serve --dry-run` in a clean
+subprocess (CPU pinned) and verify its summary line.
+
+The dry run exercises the whole serving stack — registry publish,
+micro-batch flush triggers, host scoring, mid-stream online-LDA refresh
+hot-swap, per-batch metrics — against a synthetic in-memory day, so
+this is the one-command check that the streaming path still works on a
+box with no chip grant and no day data.  tests/test_serving.py carries
+the same path as a tier-1 test; this wrapper is the operator/CI
+front door:
+
+    python tools/serve_smoke.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def run_smoke(timeout_s: float = 300.0) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "oni_ml_tpu.runner.ml_ops",
+         "serve", "--dry-run"],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    summary = None
+    if lines:
+        try:
+            summary = json.loads(lines[-1])
+        except ValueError:
+            pass
+    return {
+        "rc": proc.returncode,
+        "summary": summary,
+        "stderr_tail": proc.stderr.strip()[-500:],
+    }
+
+
+def main() -> int:
+    out = run_smoke()
+    ok = (
+        out["rc"] == 0
+        and isinstance(out["summary"], dict)
+        and out["summary"].get("serve_dry_run") == "ok"
+    )
+    print(json.dumps({"serve_smoke": "ok" if ok else "FAILED", **out}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
