@@ -618,6 +618,107 @@ def bench_flow_scoring(n_events=400_000, reps=3):
     return n_events / p50, p50
 
 
+def bench_scoring_e2e(n_events=400_000, reps=3, chunk=None):
+    """CSV-in -> results-out flow scoring at day scale through BOTH
+    engines: the float64 host path (the golden-bytes oracle and
+    production default) and the device pipeline (scoring/pipeline.py:
+    fused gather·dot·threshold, chunked double-buffered dispatch,
+    survivors-only readback, f32 on-chip).  The payload carries the
+    dispatch/transfer accounting and the measured host-vs-device
+    break-even (scoring.dispatch_calibration) so every round documents
+    the constant the serving dispatch ran under, plus the projected
+    dispatch count for a 400k-event day — the number the r05 regression
+    was about (1 full-result f64 round-trip -> ceil(N/chunk) index-only
+    H2D with survivors-only D2H)."""
+    import os
+    import tempfile
+
+    from oni_ml_tpu.features.native_flow import featurize_flow_file
+    from oni_ml_tpu.scoring import (
+        DEFAULT_CHUNK,
+        DispatchStats,
+        ScoringModel,
+        dispatch_calibration,
+        score_flow_csv,
+    )
+
+    chunk = chunk or DEFAULT_CHUNK
+    rng = np.random.default_rng(11)
+    k = 20
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    try:
+        with os.fdopen(fd, "w") as f:
+            _write_flow_day(f, n_events)
+        t0 = time.perf_counter()
+        feats = featurize_flow_file(path)     # CSV-in
+        featurize_s = time.perf_counter() - t0
+    finally:
+        os.unlink(path)
+    n = feats.num_raw_events
+    if hasattr(feats, "ip_table"):
+        ips, vocab = list(feats.ip_table), list(feats.word_table)
+    else:
+        ips = sorted(
+            {feats.sip(i) for i in range(n)} | {feats.dip(i) for i in range(n)}
+        )
+        vocab = sorted(set(feats.src_word[:n]) | set(feats.dest_word[:n]))
+    theta = rng.dirichlet(np.ones(k), size=len(ips))
+    p = rng.dirichlet(np.ones(len(vocab)), size=k).T
+    model = ScoringModel.from_results(ips, theta, vocab, p, fallback=0.05)
+
+    # Representative TOL (half the rows emitted) picked from a host
+    # warmup pass; the same pass warms caches for the timed reps.
+    _, scores = score_flow_csv(feats, model, threshold=np.inf)
+    threshold = float(np.median(scores))
+    # Compile the device programs outside the timed region.
+    score_flow_csv(feats, model, threshold, engine="device", chunk=chunk)
+
+    out_path = path + ".results"
+    rates, stats = {}, None
+    try:
+        for engine in ("host", "device"):
+            times = []
+            for _ in range(reps):
+                st = DispatchStats() if engine == "device" else None
+                t0 = time.perf_counter()
+                blob, s = score_flow_csv(
+                    feats, model, threshold,
+                    engine=engine, chunk=chunk, stats=st,
+                )
+                with open(out_path, "wb") as f:
+                    f.write(blob)                 # results-out
+                times.append(time.perf_counter() - t0)
+                if st is not None:
+                    stats = st
+            p50 = float(np.median(times))
+            rates[engine] = (n_events / p50, p50)
+            assert len(blob) and len(s)
+    finally:
+        if os.path.exists(out_path):
+            os.unlink(out_path)
+    host_eps, host_p50 = rates["host"]
+    dev_eps, dev_p50 = rates["device"]
+    return {
+        # Headline: CSV-in -> results-out through the production
+        # default engine (featurize + host score + write).
+        "value": round(n_events / (featurize_s + host_p50), 1),
+        "unit": "events/sec",
+        "n_events": n_events,
+        "featurize_s": round(featurize_s, 3),
+        "host_events_per_sec": round(host_eps, 1),
+        "host_p50_s": round(host_p50, 3),
+        "device_events_per_sec": round(dev_eps, 1),
+        "device_p50_s": round(dev_p50, 3),
+        "chunk": chunk,
+        "dispatch": stats.as_record(),
+        "projected_dispatches_400k": -(-400_000 // chunk),
+        "calibration": dispatch_calibration(),
+        # Bench-settings note (ADVICE r05 convention): scoring runs at
+        # the module defaults; no non-default dispatch caps here.
+        "engine_default": "host (float64 oracle)",
+    }
+
+
 def _write_dns_day(f, n_events, n_clients=20_000, n_doms=5_000, seed=13,
                    chunk=200_000):
     """Write a synthetic 8-column DNS day (CSV) chunked to an open
@@ -1147,6 +1248,14 @@ def phase_flow_scoring():
             "p50_seconds": round(flow_p50, 3), "n_events": 400_000}
 
 
+def phase_scoring_e2e():
+    """CSV-in -> results-out scoring through both engines, with the
+    dispatch/transfer probe and the measured host-vs-device break-even
+    in the payload (tracked per round since the r05 device-loses
+    regression)."""
+    return bench_scoring_e2e()
+
+
 def phase_config4():
     """Config-4 scale (BASELINE.json: high-cardinality DNS vocab,
     dns_pre_lda.scala:320-326).  At V=512k the full-V dense corpus
@@ -1214,6 +1323,7 @@ PHASES = [
     ("lda_em_convergence", phase_convergence, 300.0, True),
     ("dns_scoring", phase_dns_scoring, 360.0, False),
     ("flow_scoring", phase_flow_scoring, 420.0, False),
+    ("scoring_e2e", phase_scoring_e2e, 480.0, True),
     ("lda_em_throughput_k50_v50k", phase_k50_v50k, 720.0, True),
     ("lda_em_throughput_config4_v512k", phase_config4, 720.0, True),
     ("pipeline_e2e", phase_pipeline_e2e, 900.0, True),

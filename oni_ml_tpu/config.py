@@ -194,6 +194,18 @@ class ScoringConfig:
     threshold: float = 1e-20
     flow_fallback: float = 0.05
     dns_fallback: float = 0.1
+    # Batch-path scoring engine: "host" (default) is the float64 path
+    # whose scored-CSV bytes are golden-pinned — the parity oracle;
+    # "device" runs the fused gather·dot·threshold pipeline
+    # (scoring/pipeline.py): f32 on-chip arithmetic (~1e-6 relative
+    # score drift in the emitted columns), chunked double-buffered
+    # dispatch, survivors-only PCIe readback, sharded over the mesh for
+    # multi-device grants.  "" = follow ONI_ML_TPU_SCORE (default host).
+    engine: str = ""
+    # Events per device dispatch for engine="device"
+    # (scoring/pipeline.py DEFAULT_CHUNK; sweep with
+    # tools/score_probe.py on a live grant).
+    device_chunk: int = 1 << 16
 
 
 @dataclass(frozen=True)
@@ -208,16 +220,19 @@ class ServingConfig:
     max_batch: int = 4096
     # ...or when its oldest event has waited this long, whichever first.
     max_wait_ms: float = 50.0
-    # Batches at/above this size score through the jit-compiled device
-    # scorer (scoring.device_scores); smaller ones stay on the host f64
-    # path (scoring._batched_scores), whose per-call overhead is lower.
-    # At K=20 the dot is memory-bound bookkeeping, so the device only
-    # wins once the batch amortizes transfer + dispatch.  Flushes are
-    # capped at max_batch, so this must stay <= max_batch for the
-    # device path to be reachable at all — the default equals max_batch
-    # (full flushes go to the device, latency-triggered partials stay
-    # host); set it past max_batch to pin the host path everywhere.
-    device_score_min: int = 4096
+    # Host-vs-device scorer dispatch.  0 (the default) prices the
+    # decision from a MEASURED per-dispatch overhead calibration
+    # (scoring.dispatch_calibration): the device path engages only for
+    # batches past the measured break-even, and is pinned off entirely
+    # on backends where its marginal per-event cost cannot beat the
+    # host — the r05 fix for the device scorer silently LOSING to host
+    # (BENCH_r05: host 516k/621k ev/s vs 150k/326k on-chip under a raw
+    # size threshold).  A positive int restores the legacy hard
+    # threshold (batches >= it take the device scorer); None pins host
+    # everywhere.  ONI_ML_TPU_SCORE_BREAK_EVEN overrides the measured
+    # constant.  Flushes are capped at max_batch, so a hard threshold
+    # must stay <= max_batch for the device path to be reachable.
+    device_score_min: int = 0
     # Backpressure bound on the pending-event queue: submit() BLOCKS
     # once this many events are queued, so an ingest stream that
     # outruns scoring throttles at the source instead of growing the

@@ -65,8 +65,12 @@ def newton_recip(q: jnp.ndarray) -> jnp.ndarray:
     interchangeable with the exact divide at a third of its cost (the
     vector divide dominated the fixed-point bodies).  Interpret mode
     (CPU tests) computes the exact reciprocal, so the polish is a
-    no-op there."""
-    r0 = pl.reciprocal(q, approx=True)
+    no-op there.  jax 0.4.x pallas has no reciprocal primitive at all —
+    the exact divide is the correct (slower) fallback."""
+    recip = getattr(pl, "reciprocal", None)
+    if recip is None:
+        return 1.0 / q
+    r0 = recip(q, approx=True)
     return r0 * (2.0 - q * r0)
 
 

@@ -11,6 +11,7 @@ from .mesh import (
 )
 from .sharded import (
     make_data_parallel_e_step,
+    make_sharded_score_fn,
     make_vocab_sharded_dense_e_step,
     make_vocab_sharded_fns,
     pad_vocab,
@@ -27,6 +28,7 @@ __all__ = [
     "replicated",
     "vocab_sharding",
     "make_data_parallel_e_step",
+    "make_sharded_score_fn",
     "make_vocab_sharded_dense_e_step",
     "make_vocab_sharded_fns",
     "pad_vocab",

@@ -29,6 +29,33 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:                                   # jax >= 0.5 exports it top-level
+    shard_map = jax.shard_map
+except AttributeError:                 # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        """0.4.x compat: the varying-mesh-axes check is spelled
+        check_rep there, and its replication checker has no rule for
+        while_loop — which every in-package E-step kernel contains —
+        so when the caller didn't ask for the check it is disabled
+        (the documented workaround; purely a static verification,
+        numerics are unchanged)."""
+        kw.setdefault("check_rep",
+                      False if check_vma is None else check_vma)
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+
+def _pcast_varying(x, axis):
+    """lax.pcast(to="varying") where the jax version has it; 0.4.x has
+    no varying-axes type system, so the value passes through unchanged
+    (the compat shard_map above runs with the check disabled there)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis, to="varying")
+
 from ..ops import estep
 from ..ops.stop import fp_continue
 from .mesh import DATA_AXIS, MODEL_AXIS
@@ -66,7 +93,7 @@ def make_data_parallel_e_step(mesh: Mesh):
                 var_max_iters, var_tol, gamma_prev=None, warm=None):
         if gamma_prev is None:
             gamma_prev, warm = _fresh_warm_fill(log_beta, word_idx)
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(local, var_max_iters=var_max_iters, var_tol=var_tol),
             mesh=mesh,
             in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
@@ -131,7 +158,7 @@ def make_data_parallel_dense_e_step(mesh: Mesh, wmajor: bool = False,
                 f"batch {dense.shape[batch_axis]} not divisible by data "
                 f"axis {mesh.shape[DATA_AXIS]}"
             )
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(local, var_max_iters=var_max_iters, var_tol=var_tol,
                     interpret=interpret),
             mesh=mesh,
@@ -257,8 +284,8 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
         gamma0 = jnp.where(warm != 0, gamma_prev, fresh0)
         # delta varies over `data` (each data row stops independently);
         # the initial scalar must carry the same varying-axes type.
-        delta0 = jax.lax.pcast(
-            jnp.asarray(jnp.inf, jnp.float32), DATA_AXIS, to="varying"
+        delta0 = _pcast_varying(
+            jnp.asarray(jnp.inf, jnp.float32), DATA_AXIS
         )
         gamma, iters, _, _ = jax.lax.while_loop(
             cond, body,
@@ -317,7 +344,7 @@ def make_vocab_sharded_dense_e_step(mesh: Mesh, precision: str = "f32"):
                 f"log_beta width {log_beta.shape[1]} != dense width {w} "
                 "(pad log_beta with LOG_ZERO columns to match)"
             )
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(local, var_max_iters=var_max_iters, var_tol=var_tol),
             mesh=mesh,
             in_specs=(P(None, MODEL_AXIS), P(), P(DATA_AXIS, MODEL_AXIS),
@@ -387,7 +414,7 @@ def make_vocab_sharded_fns(mesh: Mesh):
             )
         if gamma_prev is None:
             gamma_prev, warm = _fresh_warm_fill(log_beta, word_idx)
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(local_e_step, var_max_iters=var_max_iters, var_tol=var_tol),
             mesh=mesh,
             in_specs=(P(None, MODEL_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS),
@@ -411,7 +438,7 @@ def make_vocab_sharded_fns(mesh: Mesh):
         return estep.m_step(ss_l, topic_total=total)
 
     def m_step_fn(suff):
-        fn = jax.shard_map(
+        fn = shard_map(
             local_m_step,
             mesh=mesh,
             in_specs=(P(MODEL_AXIS, None),),
@@ -431,3 +458,30 @@ def make_vocab_sharded_fns(mesh: Mesh):
 def pad_vocab(v: int, model_size: int) -> int:
     """Smallest padded vocab size divisible by the model axis."""
     return -(-v // model_size) * model_size
+
+
+def make_sharded_score_fn(mesh: Mesh):
+    """Data-parallel event SCORING over the same (data, model) mesh the
+    training side holds: the event axis (int32 model-row index arrays)
+    shards over `data`, theta/p replicate, and each device runs the
+    two-gather dot on its own slice — the scoring analogue of the
+    reference's 20-rank document split, with no collective at all (the
+    per-event dot is embarrassingly parallel).
+
+    Returns a jitted (theta [D+1, K], p [V+1, K], ip_idx [N], word_idx
+    [N]) -> scores [N] with the output sharded over `data`; the scoring
+    pipeline (scoring/pipeline.py) drives it chunk by chunk for
+    multi-device grants and composes on-device threshold compaction on
+    the sharded scores.  N must divide by the data-axis size (the
+    pipeline's chunker guarantees it).  Parity with the single-device
+    scorer is pinned by tests/test_scoring_pipeline.py and executed in
+    the driver's dryrun_multichip — which is why the per-shard body is
+    the scoring pipeline's own kernel, not a local copy."""
+    from ..scoring.pipeline import score_dot_rows
+
+    return jax.jit(shard_map(
+        score_dot_rows,
+        mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+    ))

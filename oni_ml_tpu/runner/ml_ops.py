@@ -505,17 +505,31 @@ def stage_score(ctx: RunContext) -> dict:
     model = ScoringModel.from_files(
         ctx.path("doc_results.csv"), ctx.path("word_results.csv"), fallback
     )
-    from ..scoring import score_dns_csv, score_flow_csv
+    from ..scoring import DispatchStats, score_dns_csv, score_flow_csv
 
     score_fn = score_flow_csv if ctx.dsource == "flow" else score_dns_csv
-    blob, scores = score_fn(features, model, sc.threshold)
+    # engine="device" runs the fused on-chip filter pipeline
+    # (scoring/pipeline.py), data-parallel over the run's mesh when one
+    # is active — the same mesh the LDA stage trained on.  The default
+    # host engine keeps the golden float64 CSV bytes.
+    from ..scoring.score import _score_engine
+
+    stats = DispatchStats() if _score_engine(sc.engine) == "device" else None
+    blob, scores = score_fn(
+        features, model, sc.threshold,
+        engine=sc.engine, chunk=sc.device_chunk, mesh=ctx.mesh,
+        stats=stats,
+    )
     with open(ctx.path(ctx.results_name()), "wb") as f:
         f.write(blob)
-    return {
+    out = {
         "scored_events": features.num_raw_events,
         "flagged": int(len(scores)),
         "min_score": float(scores[0]) if len(scores) else None,
     }
+    if stats is not None:
+        out["score_dispatch"] = stats.as_record()
+    return out
 
 
 _STAGE_FNS = {

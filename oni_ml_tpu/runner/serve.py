@@ -60,7 +60,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--device-score-min", type=int,
                    default=ServingConfig.device_score_min,
                    help="batches at/above this size score on device "
-                   "(jit); smaller stay on the host f64 path")
+                   "(jit); smaller stay on the host f64 path; 0 = "
+                   "pick the break-even from the measured dispatch "
+                   "calibration (the default, so the device path can "
+                   "never silently lose to host)")
     p.add_argument("--refresh-every", type=int, default=0, metavar="N",
                    help="fold every N scored batches into one online-LDA "
                    "step and hot-swap the refreshed model in (0=off)")

@@ -1,22 +1,40 @@
 """Event scoring — the framework's replacement for flow_post_lda.scala /
 dns_post_lda.scala."""
 
+from .pipeline import (
+    DEFAULT_CHUNK,
+    DispatchStats,
+    chunked_scores,
+    filtered_flow_scores,
+    filtered_scores,
+)
 from .score import (
+    AUTO_DEVICE_MIN,
     ScoringModel,
     batched_scores,
     device_scores,
+    dispatch_calibration,
     score_dns,
     score_dns_csv,
     score_flow,
     score_flow_csv,
+    use_device_path,
 )
 
 __all__ = [
+    "AUTO_DEVICE_MIN",
+    "DEFAULT_CHUNK",
+    "DispatchStats",
     "ScoringModel",
     "batched_scores",
+    "chunked_scores",
     "device_scores",
+    "dispatch_calibration",
+    "filtered_flow_scores",
+    "filtered_scores",
     "score_flow",
     "score_flow_csv",
     "score_dns",
     "score_dns_csv",
+    "use_device_path",
 ]

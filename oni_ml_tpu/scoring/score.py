@@ -19,16 +19,28 @@ SURVEY §2.6).
 
 Scoring reuses the featurization computed by the pre stage (FlowFeatures /
 DnsFeatures) instead of re-running it the way the post scripts do.
+
+Engines: the host float64 path above is the default and the golden-
+bytes oracle; scoring/pipeline.py is the DEVICE engine — a fused
+gather·dot·threshold kernel with chunked double-buffered dispatch,
+survivors-only readback, and a data-parallel sharded path for
+multi-device grants (opt in per call via engine="device", per run via
+ScoringConfig.engine, or process-wide via ONI_ML_TPU_SCORE=device).
+The host-vs-device decision for the serving path is priced from a
+measured per-dispatch overhead calibration (dispatch_calibration), not
+a raw size threshold.
 """
 
 from __future__ import annotations
 
+import os
+import time
 from dataclasses import dataclass
 
 import numpy as np
 
-from ..features.flow import FlowFeatures
-from ..features.dns import DnsFeatures
+from ..features.flow import FLOW_COLUMNS, FlowFeatures
+from ..features.dns import DNS_COLUMNS, DnsFeatures
 from ..io import formats
 
 
@@ -180,23 +192,22 @@ def _device_score_fn():
     global _DEVICE_SCORE_FN
     if _DEVICE_SCORE_FN is None:
         import jax
-        import jax.numpy as jnp
 
-        def score(theta, p, ip_idx, word_idx):
-            a = jnp.take(theta, ip_idx, axis=0)
-            b = jnp.take(p, word_idx, axis=0)
-            return jnp.sum(a * b, axis=-1)
+        from .pipeline import score_dot_rows
 
-        _DEVICE_SCORE_FN = jax.jit(score)
+        _DEVICE_SCORE_FN = jax.jit(score_dot_rows)
     return _DEVICE_SCORE_FN
 
 
-def _device_model(model: ScoringModel):
+def _device_model(model: ScoringModel, stats=None):
     """Device copies of theta/p, cached on the model instance so a
     long-running scorer transfers each published model once, not once
-    per micro-batch.  f32: the serving path trades the batch pipeline's
-    pinned-float64 bytes for vectorized device throughput (the golden
-    CSV contract never routes through here)."""
+    per micro-batch or per chunk.  f32 on the wire: HALF the H2D bytes
+    of the float64 host matrices, and at K=20 the f32 gather+accumulate
+    agrees with the float64 host oracle to ~1e-6 relative
+    (tests/test_scoring_pipeline.py::test_f32_transfer_tolerance pins
+    the bound) — the golden CSV contract never routes through here.
+    `stats` (pipeline.DispatchStats) records the one-time transfer."""
     cached = getattr(model, "_device_cache", None)
     if cached is None:
         import jax.numpy as jnp
@@ -206,61 +217,229 @@ def _device_model(model: ScoringModel):
             jnp.asarray(model.p, jnp.float32),
         )
         model._device_cache = cached
+        if stats is not None:
+            stats.weight_h2d_bytes += 4 * model.theta.size + 4 * model.p.size
     return cached
 
 
-def device_scores(model: ScoringModel, ip_idx, word_idx) -> np.ndarray:
-    """score[i] = <theta[ip_idx[i]], p[word_idx[i]]> as ONE jit-compiled
-    device call — the large-batch serving scorer.  Index arrays pad to
-    the next power of two so a stream of ragged micro-batch sizes
-    compiles O(log max_batch) programs, not one per size; results come
-    back float64 for drop-in use where _batched_scores is used.
+def device_scores(
+    model: ScoringModel, ip_idx, word_idx, *, chunk: int | None = None,
+    mesh=None, stats=None,
+) -> np.ndarray:
+    """score[i] = <theta[ip_idx[i]], p[word_idx[i]]> on device — the
+    large-batch serving scorer.  Micro-batch-sized inputs (<= one
+    pipeline chunk) pad to the next power of two and run as one jit
+    call, so a stream of ragged micro-batch sizes compiles
+    O(log max_batch) programs; anything larger runs through the
+    chunked, double-buffered pipeline (scoring/pipeline.py) so a
+    replay/day-scale batch never becomes one monolithic dispatch.
+    `mesh` routes chunks through the data-parallel sharded scorer for
+    multi-device grants.  Results come back float64 for drop-in use
+    where _batched_scores is used.
 
     Accuracy: f32 gather + f32 accumulate over K terms — agrees with the
-    host float64 path to ~1e-6 relative at K=20
-    (tests/test_serving.py pins the tolerance), which is far inside the
-    orders-of-magnitude spread suspicion thresholds cut at.  Anything
-    needing the reference's exact double-precision bytes (the batch
-    score stage) stays on _batched_scores."""
+    host float64 path to ~1e-6 relative at K=20 (pinned in tests), far
+    inside the orders-of-magnitude spread suspicion thresholds cut at.
+    Anything needing the reference's exact double-precision bytes (the
+    batch score stage) stays on _batched_scores."""
+    from . import pipeline
+
     _check_index_range(model, ip_idx, word_idx)
     n = len(ip_idx)
     if n == 0:
         return np.zeros(0, np.float64)
-    theta, p = _device_model(model)
+    limit = pipeline.DEFAULT_CHUNK if chunk is None else chunk
+    if n > limit or mesh is not None:
+        return pipeline.chunked_scores(
+            model, ip_idx, word_idx, chunk=limit, mesh=mesh, stats=stats
+        )
+    theta, p = _device_model(model, stats=stats)
     m = 1 << (n - 1).bit_length()
     ip_pad = np.zeros(m, np.int32)
     w_pad = np.zeros(m, np.int32)
     ip_pad[:n] = np.asarray(ip_idx, np.int32)
     w_pad[:n] = np.asarray(word_idx, np.int32)
+    if stats is not None:
+        stats.dispatches += 1
+        stats.chunks += 1
+        stats.chunk = m
+        stats.events += n
+        stats.h2d_bytes += ip_pad.nbytes + w_pad.nbytes
+        stats.d2h_bytes += 4 * n
     out = _device_score_fn()(theta, p, ip_pad, w_pad)
     return np.asarray(out[:n], np.float64)
+
+
+# Sentinel for batched_scores/ServingConfig: pick the engine from the
+# measured dispatch calibration instead of a raw size threshold.
+AUTO_DEVICE_MIN = 0
+
+_CALIBRATION: dict | None = None
+
+
+def dispatch_calibration(force: bool = False) -> dict:
+    """Measured break-even batch size for the host-vs-device dispatch
+    decision — the r05 fix for the device path silently LOSING to host
+    (BENCH_r05: 516k/621k host events/sec vs 150k/326k on-chip): a raw
+    size threshold can route day-scale batches onto a path whose
+    per-dispatch glue exceeds the host's whole stage, so the decision
+    is now priced from this process's own measurements.
+
+    Returns {"dispatch_s", "host_event_s", "device_event_s",
+    "break_even", "source"}; break_even None means the device's marginal
+    per-event cost is not below the host's on this backend, so the
+    device path can NEVER win and auto dispatch pins the host path.
+    The record rides in bench.py's scoring_e2e payload so every round
+    documents the constant it ran under.  ONI_ML_TPU_SCORE_BREAK_EVEN
+    overrides with a pinned constant (<= 0 means "never device").
+
+    Cost: a few tiny synthetic scoring calls, run once per process on
+    first auto dispatch and cached."""
+    global _CALIBRATION
+    if _CALIBRATION is not None and not force:
+        return _CALIBRATION
+    env = os.environ.get("ONI_ML_TPU_SCORE_BREAK_EVEN")
+    if env is not None:
+        be = int(env)
+        _CALIBRATION = {
+            "dispatch_s": None, "host_event_s": None,
+            "device_event_s": None,
+            "break_even": be if be > 0 else None, "source": "env",
+        }
+        return _CALIBRATION
+    rng = np.random.default_rng(0)
+    k, d, v, n = 20, 1024, 1024, 4096
+    model = ScoringModel(
+        ip_index={}, theta=rng.random((d + 1, k)),
+        word_index={}, p=rng.random((v + 1, k)),
+    )
+    ia = rng.integers(0, d, n).astype(np.int32)
+    ib = rng.integers(0, v, n).astype(np.int32)
+
+    def best_of(fn, reps=3):
+        t = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            t = min(t, time.perf_counter() - t0)
+        return t
+
+    host_event_s = best_of(lambda: _batched_scores(model, ia, ib)) / n
+    # Warm both compiled shapes before timing (compile is once-ever).
+    device_scores(model, ia[:1], ib[:1])
+    device_scores(model, ia, ib)
+    dispatch_s = best_of(lambda: device_scores(model, ia[:1], ib[:1]))
+    t_n = best_of(lambda: device_scores(model, ia, ib))
+    device_event_s = max(0.0, (t_n - dispatch_s) / (n - 1))
+    if device_event_s >= host_event_s:
+        break_even = None            # device can never win here
+    else:
+        break_even = int(
+            np.ceil(dispatch_s / (host_event_s - device_event_s))
+        )
+    _CALIBRATION = {
+        "dispatch_s": dispatch_s, "host_event_s": host_event_s,
+        "device_event_s": device_event_s, "break_even": break_even,
+        "source": "measured",
+    }
+    return _CALIBRATION
+
+
+def use_device_path(n: int, device_min) -> bool:
+    """The one host-vs-device dispatch decision, shared by
+    batched_scores and the serving metrics label so they cannot drift:
+    None pins host (the batch pipeline's float64 oracle),
+    AUTO_DEVICE_MIN (0) / "auto" consults dispatch_calibration(), and a
+    positive int keeps the legacy hard threshold (tests and operators
+    pinning a path)."""
+    if device_min is None or n == 0:
+        return False
+    if device_min == "auto" or device_min == AUTO_DEVICE_MIN:
+        break_even = dispatch_calibration()["break_even"]
+        return break_even is not None and n >= break_even
+    return n >= device_min
 
 
 def batched_scores(
     model: ScoringModel, ip_idx, word_idx, device_min: int | None = None
 ) -> np.ndarray:
-    """Size-dispatched scorer for the serving path: batches of
-    >= device_min events take the jit device scorer (one vectorized
-    call; wins once the batch amortizes transfer + dispatch), smaller
-    ones the host float64 path.  device_min=None pins the host path —
-    the batch pipeline's behavior."""
-    if device_min is not None and len(ip_idx) >= device_min:
+    """Size-dispatched scorer for the serving path: device_min=None
+    pins the host float64 path (the batch pipeline's behavior), 0 or
+    "auto" picks device-vs-host from the measured per-dispatch overhead
+    (dispatch_calibration — the device path can no longer silently lose
+    to host as it did in r05), and a positive int is a legacy hard
+    threshold."""
+    if use_device_path(len(ip_idx), device_min):
         return device_scores(model, ip_idx, word_idx)
     return _batched_scores(model, ip_idx, word_idx)
 
 
 def _keep_order(scores: np.ndarray, threshold: float) -> np.ndarray:
     """Event indices under threshold, ascending by score (the
-    reference's `filter < TOL` + `sortByKey()`)."""
+    reference's `filter < TOL` + `sortByKey()`).  The device pipeline's
+    on-chip compaction (scoring/pipeline.py) is pinned to this exact
+    ordering — including stable threshold-boundary ties — by
+    tests/test_scoring_pipeline.py."""
     keep = np.where(scores < threshold)[0]
     return keep[np.argsort(scores[keep], kind="stable")]
 
 
-def _flow_scored(features, model: ScoringModel, threshold: float):
+def _score_engine(engine: str | None) -> str:
+    """Batch-path engine selection: "host" (default) is the float64
+    oracle whose scored-CSV bytes are golden-pinned; "device" runs the
+    fused gather·dot·threshold pipeline with f32 on-chip arithmetic
+    (~1e-6 relative score drift in the emitted columns — opt in via
+    ScoringConfig.engine or ONI_ML_TPU_SCORE=device)."""
+    if not engine:
+        engine = os.environ.get("ONI_ML_TPU_SCORE", "host")
+    if engine not in ("host", "device"):
+        raise ValueError(
+            f"scoring engine must be 'host' or 'device', got {engine!r}"
+        )
+    return engine
+
+
+def _flow_endpoint_strings(features, n: int):
+    """(sips, dips) without the O(N) per-event METHOD dispatch: the
+    Python-backed containers store raw rows, so one column-slicing
+    comprehension replaces 2N bound-method calls (the native containers
+    never reach here — they carry interned id arrays).  Instance-dict
+    lookup, NOT getattr: the native containers expose `rows` as a
+    materializing @property, which this fast path must never trip."""
+    rows = features.__dict__.get("rows")
+    if rows is not None:
+        s_col, d_col = FLOW_COLUMNS["sip"], FLOW_COLUMNS["dip"]
+        return ([r[s_col] for r in rows[:n]], [r[d_col] for r in rows[:n]])
+    return (
+        [features.sip(i) for i in range(n)],
+        [features.dip(i) for i in range(n)],
+    )
+
+
+def _dns_client_strings(features, n: int):
+    """Client IPs without per-event method dispatch (see
+    _flow_endpoint_strings; instance-dict lookup for the same
+    property-trip reason)."""
+    rows = features.__dict__.get("rows")
+    if rows is not None:
+        ip_col = DNS_COLUMNS["ip_dst"]
+        return [r[ip_col] for r in rows[:n]]
+    return [features.client_ip(i) for i in range(n)]
+
+
+def _flow_scored(features, model: ScoringModel, threshold: float,
+                 engine: str | None = None, chunk: int | None = None,
+                 mesh=None, stats=None):
     """Shared flow scoring core -> (blob | None, rows | None, scores):
     exactly one of blob/rows is set — native emit produces the bytes
     buffer, the Python loop produces the row list — so each public
-    wrapper converts at most once."""
+    wrapper converts at most once.  Row formatting only ever touches
+    post-filter survivors (`order`), never the full day.
+
+    engine="device" routes the score+filter through the fused on-chip
+    pipeline (scoring/pipeline.py): f32 arithmetic, chunked dispatch,
+    survivors-only readback; `mesh` shards it data-parallel.  The
+    default host engine stays the float64 golden-bytes oracle."""
     n = features.num_raw_events
     if hasattr(features, "sip_id"):
         # Native-backed features carry interned id arrays: resolve model
@@ -268,23 +447,36 @@ def _flow_scored(features, model: ScoringModel, threshold: float):
         # lookups instead of O(events).
         ip_map = model.ip_rows(features.ip_table)
         word_map = model.word_rows(features.word_table)
-        src_scores = _batched_scores(
-            model, ip_map[features.sip_id[:n]], word_map[features.sw_id[:n]]
-        )
-        dest_scores = _batched_scores(
-            model, ip_map[features.dip_id[:n]], word_map[features.dw_id[:n]]
-        )
+        sip_idx = ip_map[features.sip_id[:n]]
+        sw_idx = word_map[features.sw_id[:n]]
+        dip_idx = ip_map[features.dip_id[:n]]
+        dw_idx = word_map[features.dw_id[:n]]
     else:
-        sips = [features.sip(i) for i in range(n)]
-        dips = [features.dip(i) for i in range(n)]
-        src_scores = _batched_scores(
-            model, model.ip_rows(sips), model.word_rows(features.src_word[:n])
+        sips, dips = _flow_endpoint_strings(features, n)
+        sip_idx = model.ip_rows(sips)
+        sw_idx = model.word_rows(features.src_word[:n])
+        dip_idx = model.ip_rows(dips)
+        dw_idx = model.word_rows(features.dest_word[:n])
+    if _score_engine(engine) == "device":
+        from . import pipeline
+
+        order, src_k, dest_k, sorted_scores = pipeline.filtered_flow_scores(
+            model, sip_idx, sw_idx, dip_idx, dw_idx, threshold,
+            chunk=chunk or pipeline.DEFAULT_CHUNK, mesh=mesh, stats=stats,
         )
-        dest_scores = _batched_scores(
-            model, model.ip_rows(dips), model.word_rows(features.dest_word[:n])
-        )
-    min_scores = np.minimum(src_scores, dest_scores)
-    order = _keep_order(min_scores, threshold)
+        # Emit indexes by event position: scatter the survivors' scores
+        # back into full-length arrays (positions outside `order` are
+        # never read — only survivors are formatted).
+        src_scores = np.zeros(n, np.float64)
+        dest_scores = np.zeros(n, np.float64)
+        src_scores[order] = src_k
+        dest_scores[order] = dest_k
+    else:
+        src_scores = _batched_scores(model, sip_idx, sw_idx)
+        dest_scores = _batched_scores(model, dip_idx, dw_idx)
+        min_scores = np.minimum(src_scores, dest_scores)
+        order = _keep_order(min_scores, threshold)
+        sorted_scores = min_scores[order]
     blob = rows = None
     if hasattr(features, "sip_id"):
         from .. import native_emit
@@ -298,18 +490,23 @@ def _flow_scored(features, model: ScoringModel, threshold: float):
             )
             for i in order
         ]
-    return blob, rows, min_scores[order]
+    return blob, rows, sorted_scores
 
 
 def score_flow_csv(
-    features: FlowFeatures, model: ScoringModel, threshold: float
+    features: FlowFeatures, model: ScoringModel, threshold: float,
+    engine: str | None = None, chunk: int | None = None,
+    mesh=None, stats=None,
 ) -> tuple[bytes, np.ndarray]:
     """Flow scoring with the output as one CSV buffer (newline-
     terminated rows) — the fast path for the runner, which writes the
     bytes straight to <dsource>_results.csv.  Row assembly runs in C++
     for native-backed features (native_src/row_emit.cpp; >90% of the
-    stage is emit otherwise), bit-identical to the Python loop."""
-    blob, rows, scores = _flow_scored(features, model, threshold)
+    stage is emit otherwise), bit-identical to the Python loop.
+    engine/chunk/mesh/stats select and instrument the device pipeline
+    (see _flow_scored)."""
+    blob, rows, scores = _flow_scored(features, model, threshold,
+                                      engine, chunk, mesh, stats)
     if blob is None:
         blob = "".join(r + "\n" for r in rows).encode(
             "utf-8", "surrogateescape"
@@ -318,7 +515,8 @@ def score_flow_csv(
 
 
 def score_flow(
-    features: FlowFeatures, model: ScoringModel, threshold: float
+    features: FlowFeatures, model: ScoringModel, threshold: float,
+    engine: str | None = None,
 ) -> tuple[list[str], np.ndarray]:
     """Flow scoring: score = min(<theta_sip, p_srcword>, <theta_dip,
     p_destword>); emit rows under threshold sorted ascending by that min
@@ -329,7 +527,7 @@ def score_flow(
     index num_raw_events train the model but must not reappear in the
     suspicious-connects output (the reference's post stage re-reads raw
     data without feedback injection)."""
-    blob, rows, scores = _flow_scored(features, model, threshold)
+    blob, rows, scores = _flow_scored(features, model, threshold, engine)
     if rows is None:
         rows = (
             blob.decode("utf-8", "surrogateescape").split("\n")[:-1]
@@ -338,22 +536,33 @@ def score_flow(
     return rows, scores
 
 
-def _dns_scored(features, model: ScoringModel, threshold: float):
+def _dns_scored(features, model: ScoringModel, threshold: float,
+                engine: str | None = None, chunk: int | None = None,
+                mesh=None, stats=None):
     """Shared DNS scoring core (see _flow_scored)."""
     n = features.num_raw_events
     if hasattr(features, "word_id"):
         # Native-backed: O(unique) model-row resolution (see score_flow).
         ip_map = model.ip_rows(features.ip_table)
         word_map = model.word_rows(features.word_table)
-        scores = _batched_scores(
-            model, ip_map[features.ip_id[:n]], word_map[features.word_id[:n]]
-        )
+        ip_idx = ip_map[features.ip_id[:n]]
+        word_idx = word_map[features.word_id[:n]]
     else:
-        ips = [features.client_ip(i) for i in range(n)]
-        scores = _batched_scores(
-            model, model.ip_rows(ips), model.word_rows(features.word[:n])
+        ip_idx = model.ip_rows(_dns_client_strings(features, n))
+        word_idx = model.word_rows(features.word[:n])
+    if _score_engine(engine) == "device":
+        from . import pipeline
+
+        order, sorted_scores = pipeline.filtered_scores(
+            model, ip_idx, word_idx, threshold,
+            chunk=chunk or pipeline.DEFAULT_CHUNK, mesh=mesh, stats=stats,
         )
-    order = _keep_order(scores, threshold)
+        scores = np.zeros(n, np.float64)
+        scores[order] = sorted_scores   # survivors only; see _flow_scored
+    else:
+        scores = _batched_scores(model, ip_idx, word_idx)
+        order = _keep_order(scores, threshold)
+        sorted_scores = scores[order]
     blob = rows = None
     if hasattr(features, "word_id"):
         from .. import native_emit
@@ -364,14 +573,17 @@ def _dns_scored(features, model: ScoringModel, threshold: float):
             ",".join(features.featurized_row(i) + [str(scores[i])])
             for i in order
         ]
-    return blob, rows, scores[order]
+    return blob, rows, sorted_scores
 
 
 def score_dns_csv(
-    features: DnsFeatures, model: ScoringModel, threshold: float
+    features: DnsFeatures, model: ScoringModel, threshold: float,
+    engine: str | None = None, chunk: int | None = None,
+    mesh=None, stats=None,
 ) -> tuple[bytes, np.ndarray]:
     """DNS scoring as one CSV buffer (see score_flow_csv)."""
-    blob, rows, scores = _dns_scored(features, model, threshold)
+    blob, rows, scores = _dns_scored(features, model, threshold,
+                                     engine, chunk, mesh, stats)
     if blob is None:
         blob = "".join(r + "\n" for r in rows).encode(
             "utf-8", "surrogateescape"
@@ -380,12 +592,13 @@ def score_dns_csv(
 
 
 def score_dns(
-    features: DnsFeatures, model: ScoringModel, threshold: float
+    features: DnsFeatures, model: ScoringModel, threshold: float,
+    engine: str | None = None,
 ) -> tuple[list[str], np.ndarray]:
     """DNS scoring: single <theta_ip_dst, p_word> per event
     (dns_post_lda.scala:312-331).  Each emitted row is the 15 featurized
     columns + score.  Only raw events are scored (see score_flow)."""
-    blob, rows, scores = _dns_scored(features, model, threshold)
+    blob, rows, scores = _dns_scored(features, model, threshold, engine)
     if rows is None:
         rows = (
             blob.decode("utf-8", "surrogateescape").split("\n")[:-1]

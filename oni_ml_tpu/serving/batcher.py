@@ -36,6 +36,7 @@ from collections import deque
 import numpy as np
 
 from ..config import ServingConfig
+from ..scoring import use_device_path
 from .events import event_documents, score_features
 from .metrics import MetricsEmitter
 from .registry import ModelRegistry
@@ -124,6 +125,16 @@ class BatchScorer:
             )
         self.metrics = metrics
         self.on_batch = on_batch
+        if self.config.device_score_min in (0, "auto"):
+            # Auto host-vs-device dispatch: pay the one-time calibration
+            # (jit compiles + a few timed reps, ~a second) HERE at
+            # construction, not inside the first flush — the worker's
+            # scoring path is latency-bounded by max_wait_ms and must
+            # never stall on it.  Cached per process, so only the first
+            # scorer constructed pays.
+            from ..scoring import dispatch_calibration
+
+            dispatch_calibration()
         self._pending: deque[_Pending] = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -309,8 +320,13 @@ class BatchScorer:
             "events": n,
             "trigger": trigger,
             "model_version": snap.version,
+            # The SAME predicate batched_scores dispatched on (shared
+            # helper, so the label cannot drift from the actual path;
+            # device_score_min=0 prices the choice from the measured
+            # dispatch calibration).
             "scorer": (
-                "device" if n >= cfg.device_score_min else "host"
+                "device" if use_device_path(n, cfg.device_score_min)
+                else "host"
             ),
             # Latency of the oldest event, enqueue -> scored (the
             # number max_wait_ms bounds the left edge of), plus the

@@ -23,6 +23,7 @@ import numpy as np
 from ..features.dns import DNS_COLUMNS, NUM_DNS_COLUMNS, featurize_dns
 from ..features.flow import NUM_FLOW_COLUMNS, featurize_flow
 from ..scoring import ScoringModel, batched_scores
+from ..scoring.score import _dns_client_strings, _flow_endpoint_strings
 
 
 class FlowEventFeaturizer:
@@ -100,26 +101,30 @@ def score_features(
 ) -> np.ndarray:
     """Per-event suspicion scores for one featurized micro-batch —
     min(src, dest) dot for flow (flow_post_lda.scala:227-239), single
-    <theta_ip, p_word> for DNS — through the size-dispatched
-    host/device scorer."""
+    <theta_ip, p_word> for DNS — through the calibration-dispatched
+    host/device scorer (scoring.use_device_path; device batches run the
+    chunked pipeline of scoring/pipeline.py).  Endpoint strings come
+    from one column-slicing pass over the raw rows, not 2N bound-method
+    calls (scoring.score._flow_endpoint_strings)."""
     n = feats.num_raw_events
     if dsource == "flow":
+        sips, dips = _flow_endpoint_strings(feats, n)
         src = batched_scores(
             model,
-            model.ip_rows([feats.sip(i) for i in range(n)]),
-            model.word_rows(list(feats.src_word[:n])),
+            model.ip_rows(sips),
+            model.word_rows(feats.src_word[:n]),
             device_min,
         )
         dst = batched_scores(
             model,
-            model.ip_rows([feats.dip(i) for i in range(n)]),
-            model.word_rows(list(feats.dest_word[:n])),
+            model.ip_rows(dips),
+            model.word_rows(feats.dest_word[:n]),
             device_min,
         )
         return np.minimum(src, dst)
     return batched_scores(
         model,
-        model.ip_rows([feats.client_ip(i) for i in range(n)]),
+        model.ip_rows(_dns_client_strings(feats, n)),
         model.word_rows(list(feats.word[:n])),
         device_min,
     )

@@ -236,6 +236,16 @@ def _patch_phases(bench, monkeypatch):
         bench, "bench_convergence",
         lambda *a, **k: (1.5, 20, -1e5, "fused+sparse"),
     )
+    monkeypatch.setattr(
+        bench, "bench_scoring_e2e",
+        lambda *a, **k: {"value": 90000.0, "unit": "events/sec",
+                         "n_events": 400_000,
+                         "host_events_per_sec": 500000.0,
+                         "device_events_per_sec": 800000.0,
+                         "chunk": 65536, "dispatch": {"dispatches": 7},
+                         "projected_dispatches_400k": 7,
+                         "calibration": {"break_even": 4096}},
+    )
 
 
 def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
@@ -263,6 +273,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "lda_em_convergence",
         "dns_scoring",
         "flow_scoring",
+        "scoring_e2e",
         "pipeline_e2e",
         "pipeline_e2e_dns",
     }

@@ -1,0 +1,461 @@
+"""Device scoring pipeline (scoring/pipeline.py): threshold-compaction
+parity with the host `_keep_order` oracle (exact ordering, boundary
+ties, all-/none-kept edges), the f32 transfer tolerance, the dispatch-
+count / transfer-bytes contract the acceptance criteria name, the
+calibrated host-vs-device dispatch heuristic, and 8-virtual-device
+sharded scoring parity — all on the CPU backend, so this file is
+tier-1."""
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.scoring import (
+    AUTO_DEVICE_MIN,
+    DispatchStats,
+    ScoringModel,
+    batched_scores,
+    chunked_scores,
+    device_scores,
+    dispatch_calibration,
+    filtered_flow_scores,
+    filtered_scores,
+    use_device_path,
+)
+from oni_ml_tpu.scoring import score as score_mod
+from oni_ml_tpu.scoring.score import _batched_scores, _keep_order
+
+
+def _model(k=20, d=300, v=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return ScoringModel(
+        ip_index={}, theta=rng.random((d + 1, k)),
+        word_index={}, p=rng.random((v + 1, k)),
+    )
+
+
+def _idx(model, n, seed=1):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, model.theta.shape[0], n).astype(np.int32),
+        rng.integers(0, model.p.shape[0], n).astype(np.int32),
+    )
+
+
+def _gap_threshold(scores):
+    """A threshold no score sits within f32 epsilon of, so the f32
+    device filter and the f64 host filter must make identical keep
+    decisions: the geometric midpoint of the widest relative gap
+    between adjacent distinct scores near the median."""
+    s = np.sort(np.unique(scores))
+    mid = len(s) // 2
+    i0, i1 = max(1, mid - 64), min(len(s), mid + 64)
+    ratios = s[i0:i1] / s[i0 - 1: i1 - 1]
+    j = int(np.argmax(ratios)) + i0
+    assert s[j] / s[j - 1] > 1 + 1e-5, "no f32-safe gap in the sample"
+    return float(np.sqrt(s[j] * s[j - 1]))
+
+
+def _exact_model(k=4, d=32, v=16):
+    """Scores exactly representable in BOTH f32 and f64: theta rows are
+    one-hot powers of two and p rows are constant powers of two, so
+    every score is a single product 2^-((i%5)+(j%3)) with no summation
+    error — the device/host comparison becomes bit-exact and ordering
+    (including stable threshold-boundary ties) must match EXACTLY."""
+    theta = np.zeros((d + 1, k))
+    p = np.zeros((v + 1, k))
+    for i in range(d + 1):
+        theta[i, i % k] = 2.0 ** -(i % 5)
+    for j in range(v + 1):
+        p[j] = 2.0 ** -(j % 3)
+    return ScoringModel(ip_index={}, theta=theta, word_index={}, p=p)
+
+
+# ---------------------------------------------------------------------------
+# threshold compaction parity vs the host _keep_order oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk", [7, 64, 1 << 16])
+def test_filtered_matches_keep_order_exact(chunk):
+    """Exact-ordering parity on f32-exact scores, across chunk sizes
+    that split the day mid-stream, at a mid threshold, a threshold
+    sitting EXACTLY on a score value (strict <, ties dropped on both
+    paths), all-kept, and none-kept."""
+    model = _exact_model()
+    ia, ib = _idx(model, 500, seed=3)
+    host = _batched_scores(model, ia, ib)
+    assert len(np.unique(host)) < 16          # dense tie structure
+    for threshold in (2.0 ** -4, 2.0 ** -3, np.inf, 0.0):
+        want = _keep_order(host, threshold)
+        got_order, got_scores = filtered_scores(
+            model, ia, ib, threshold, chunk=chunk
+        )
+        np.testing.assert_array_equal(got_order, want)
+        np.testing.assert_array_equal(got_scores, host[want])
+
+
+def test_filtered_empty_input():
+    model = _exact_model()
+    order, scores = filtered_scores(
+        model, np.zeros(0, np.int32), np.zeros(0, np.int32), 1.0
+    )
+    assert order.shape == (0,) and scores.shape == (0,)
+    out = filtered_flow_scores(
+        model, *(np.zeros(0, np.int32) for _ in range(4)), 1.0
+    )
+    assert all(a.shape == (0,) for a in out)
+
+
+def test_filtered_random_parity_k20():
+    """Acceptance criterion: filtered event sets identical and scores
+    within 1e-6 relative of the float64 host oracle at K=20."""
+    model = _model(k=20)
+    ia, ib = _idx(model, 20_000)
+    host = _batched_scores(model, ia, ib)
+    threshold = _gap_threshold(host)
+    want = _keep_order(host, threshold)
+    got_order, got_scores = filtered_scores(
+        model, ia, ib, threshold, chunk=4096
+    )
+    assert set(got_order.tolist()) == set(want.tolist())
+    np.testing.assert_allclose(got_scores, host[got_order], rtol=1e-6)
+    # Ascending output, like the host oracle.
+    assert np.all(np.diff(got_scores) >= 0)
+
+
+def test_flow_filtered_parity():
+    model = _model(k=20, seed=5)
+    sa, sw = _idx(model, 10_000, seed=6)
+    da, dw = _idx(model, 10_000, seed=7)
+    src = _batched_scores(model, sa, sw)
+    dest = _batched_scores(model, da, dw)
+    mn = np.minimum(src, dest)
+    threshold = _gap_threshold(mn)
+    want = _keep_order(mn, threshold)
+    order, src_k, dest_k, mn_k = filtered_flow_scores(
+        model, sa, sw, da, dw, threshold, chunk=2048
+    )
+    assert set(order.tolist()) == set(want.tolist())
+    np.testing.assert_allclose(src_k, src[order], rtol=1e-6)
+    np.testing.assert_allclose(dest_k, dest[order], rtol=1e-6)
+    np.testing.assert_allclose(mn_k, mn[order], rtol=1e-6)
+    assert np.all(np.diff(mn_k) >= 0)
+
+
+def test_flow_filtered_exact_order():
+    model = _exact_model()
+    sa, sw = _idx(model, 400, seed=8)
+    da, dw = _idx(model, 400, seed=9)
+    mn = np.minimum(
+        _batched_scores(model, sa, sw), _batched_scores(model, da, dw)
+    )
+    for threshold in (2.0 ** -3, np.inf, 0.0):
+        want = _keep_order(mn, threshold)
+        order, _, _, mn_k = filtered_flow_scores(
+            model, sa, sw, da, dw, threshold, chunk=128
+        )
+        np.testing.assert_array_equal(order, want)
+        np.testing.assert_array_equal(mn_k, mn[want])
+
+
+# ---------------------------------------------------------------------------
+# f32 transfer dtype: halved H2D bytes, pinned tolerance
+# ---------------------------------------------------------------------------
+
+
+def test_f32_transfer_tolerance():
+    """theta/p ship to device as float32 (half the float64 bytes); the
+    documented guarantee is ~1e-6 relative agreement with the float64
+    host oracle at K=20."""
+    model = _model(k=20, seed=11)
+    ia, ib = _idx(model, 8192, seed=12)
+    host = _batched_scores(model, ia, ib)
+    dev = chunked_scores(model, ia, ib, chunk=1024)
+    np.testing.assert_allclose(dev, host, rtol=1e-6)
+    theta_dev, p_dev = score_mod._device_model(model)
+    assert str(theta_dev.dtype) == "float32"
+    assert str(p_dev.dtype) == "float32"
+    # Cached on the model: a second lookup must NOT re-transfer.
+    assert score_mod._device_model(model) is not (None,) and \
+        score_mod._device_model(model)[0] is theta_dev
+
+
+def test_chunked_scores_bitwise_equals_single_dispatch():
+    """Chunking must not change a single score bit: per-event dots are
+    independent, so the chunked pipeline and the one-shot padded
+    dispatch agree exactly."""
+    model = _model(seed=13)
+    ia, ib = _idx(model, 3000, seed=14)
+    one = device_scores(model, ia, ib)           # single padded dispatch
+    many = chunked_scores(model, ia, ib, chunk=256)
+    np.testing.assert_array_equal(one, many)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-count / transfer-bytes contract (acceptance criteria probe)
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_count_and_transfer_bytes_400k_day():
+    """The acceptance projection: a 400k-event day at the default-ish
+    chunk runs ceil(N/chunk) index-only H2D dispatches with
+    survivors-only D2H — not the old single full-result float64
+    round-trip."""
+    n, chunk = 400_000, 1 << 16
+    model = _model(k=20, seed=15)
+    ia, ib = _idx(model, n, seed=16)
+    host = _batched_scores(model, ia, ib)
+    threshold = float(np.quantile(host, 0.003))   # a real TOL keeps few
+    stats = DispatchStats()
+    order, scores = filtered_scores(
+        model, ia, ib, threshold, chunk=chunk, stats=stats
+    )
+    n_chunks = -(-n // chunk)                     # 7
+    assert stats.dispatches == n_chunks
+    assert stats.chunks == n_chunks
+    # H2D: exactly the two int32 index arrays per chunk, nothing else.
+    assert stats.h2d_bytes == n_chunks * chunk * 4 * 2
+    # D2H: one count scalar per chunk plus the survivors' (pos, score)
+    # pairs — pow2-rounded per chunk so slice programs stay O(log
+    # chunk) — a sliver of the old full-f64 return (8 bytes x N).
+    assert stats.survivors == len(order)
+    assert 4 * n_chunks + 8 * stats.survivors <= stats.d2h_bytes
+    assert stats.d2h_bytes <= 4 * n_chunks + 16 * max(stats.survivors,
+                                                      n_chunks)
+    assert stats.d2h_bytes < 0.02 * 8 * n
+    # Weights crossed once (f32 — half of float64).
+    assert stats.weight_h2d_bytes == 4 * (model.theta.size + model.p.size)
+
+
+def test_model_weights_transfer_once_per_swap():
+    model = _model(seed=17)
+    ia, ib = _idx(model, 2000, seed=18)
+    stats = DispatchStats()
+    filtered_scores(model, ia, ib, 1.0, chunk=512, stats=stats)
+    once = stats.weight_h2d_bytes
+    assert once > 0
+    filtered_scores(model, ia, ib, 1.0, chunk=512, stats=stats)
+    chunked_scores(model, ia, ib, chunk=512, stats=stats)
+    assert stats.weight_h2d_bytes == once        # cached per model
+    # A probe shared across calls accumulates coherently.
+    assert stats.events == 3 * 2000
+    assert stats.chunks == 3 * 4
+
+
+def test_small_input_shrinks_chunk():
+    """A tiny batch must not pad to the full 64k chunk: the effective
+    chunk shrinks to the next power of two, keeping compiled-program
+    count O(log chunk) and the H2D bytes proportional to the batch."""
+    model = _model(seed=19)
+    ia, ib = _idx(model, 100, seed=20)
+    stats = DispatchStats()
+    filtered_scores(model, ia, ib, np.inf, stats=stats)
+    assert stats.chunk == 128
+    assert stats.h2d_bytes == 128 * 4 * 2
+
+
+# ---------------------------------------------------------------------------
+# calibrated host-vs-device dispatch (the r05 "device loses" fix)
+# ---------------------------------------------------------------------------
+
+
+def test_use_device_path_semantics(monkeypatch):
+    monkeypatch.setattr(score_mod, "_CALIBRATION",
+                        {"break_even": 64, "source": "test"})
+    assert not use_device_path(10, None)          # None pins host
+    assert not use_device_path(0, AUTO_DEVICE_MIN)
+    assert use_device_path(64, AUTO_DEVICE_MIN)   # auto: >= break-even
+    assert use_device_path(64, "auto")
+    assert not use_device_path(63, AUTO_DEVICE_MIN)
+    assert use_device_path(5, 5) and not use_device_path(4, 5)  # legacy
+    # A backend where the device can never win pins host at ANY size.
+    monkeypatch.setattr(score_mod, "_CALIBRATION",
+                        {"break_even": None, "source": "test"})
+    assert not use_device_path(1 << 30, AUTO_DEVICE_MIN)
+
+
+def test_batched_scores_auto_routes_through_calibration(monkeypatch):
+    model = _model(seed=21)
+    ia, ib = _idx(model, 128, seed=22)
+    calls = []
+    real = score_mod.device_scores
+    monkeypatch.setattr(
+        score_mod, "device_scores",
+        lambda *a, **kw: calls.append(1) or real(*a, **kw),
+    )
+    monkeypatch.setattr(score_mod, "_CALIBRATION",
+                        {"break_even": 64, "source": "test"})
+    batched_scores(model, ia, ib, device_min=AUTO_DEVICE_MIN)
+    assert calls == [1]
+    monkeypatch.setattr(score_mod, "_CALIBRATION",
+                        {"break_even": None, "source": "test"})
+    batched_scores(model, ia, ib, device_min=AUTO_DEVICE_MIN)
+    assert calls == [1]                           # host path: no device call
+
+
+def test_dispatch_calibration_measures_and_caches(monkeypatch):
+    monkeypatch.setattr(score_mod, "_CALIBRATION", None)
+    monkeypatch.delenv("ONI_ML_TPU_SCORE_BREAK_EVEN", raising=False)
+    cal = dispatch_calibration()
+    assert cal["source"] == "measured"
+    assert cal["dispatch_s"] > 0 and cal["host_event_s"] > 0
+    assert cal["break_even"] is None or cal["break_even"] >= 1
+    assert dispatch_calibration() is cal          # cached
+    monkeypatch.setenv("ONI_ML_TPU_SCORE_BREAK_EVEN", "123")
+    env_cal = dispatch_calibration(force=True)
+    assert env_cal == {**env_cal, "break_even": 123, "source": "env"}
+    monkeypatch.setenv("ONI_ML_TPU_SCORE_BREAK_EVEN", "0")
+    assert dispatch_calibration(force=True)["break_even"] is None
+    monkeypatch.setattr(score_mod, "_CALIBRATION", None)
+
+
+# ---------------------------------------------------------------------------
+# sharded multi-device scoring (8-device virtual mesh, MULTICHIP pattern)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    from oni_ml_tpu.parallel import make_mesh
+
+    return make_mesh(data=8, model=1)
+
+
+def test_sharded_scores_equal_single_device(mesh8):
+    model = _model(k=20, seed=23)
+    ia, ib = _idx(model, 5000, seed=24)
+    single = chunked_scores(model, ia, ib, chunk=1024)
+    stats = DispatchStats()
+    sharded = chunked_scores(
+        model, ia, ib, chunk=1024, mesh=mesh8, stats=stats
+    )
+    np.testing.assert_array_equal(sharded, single)
+    np.testing.assert_allclose(
+        sharded, _batched_scores(model, ia, ib), rtol=1e-6
+    )
+    assert stats.chunk % 8 == 0                  # divisible by data axis
+
+
+def test_sharded_filtered_equal_single_device(mesh8):
+    model = _model(k=20, seed=25)
+    ia, ib = _idx(model, 5000, seed=26)
+    host = _batched_scores(model, ia, ib)
+    threshold = _gap_threshold(host)
+    o1, s1 = filtered_scores(model, ia, ib, threshold, chunk=1024)
+    o2, s2 = filtered_scores(
+        model, ia, ib, threshold, chunk=1024, mesh=mesh8
+    )
+    np.testing.assert_array_equal(o1, o2)
+    np.testing.assert_array_equal(s1, s2)
+    f1 = filtered_flow_scores(model, ia, ib, ia, ib, threshold, chunk=1024)
+    f2 = filtered_flow_scores(
+        model, ia, ib, ia, ib, threshold, chunk=1024, mesh=mesh8
+    )
+    for a, b in zip(f1, f2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_model_replicates_once(mesh8):
+    model = _model(seed=27)
+    ia, ib = _idx(model, 2000, seed=28)
+    stats = DispatchStats()
+    chunked_scores(model, ia, ib, chunk=512, mesh=mesh8, stats=stats)
+    once = stats.weight_h2d_bytes
+    assert once == 4 * (model.theta.size + model.p.size)
+    chunked_scores(model, ia, ib, chunk=512, mesh=mesh8, stats=stats)
+    assert stats.weight_h2d_bytes == once
+
+
+# ---------------------------------------------------------------------------
+# device engine through the public score_* wrappers
+# ---------------------------------------------------------------------------
+
+
+def _dns_day(n=300):
+    from test_features import dns_row
+
+    from oni_ml_tpu.features import featurize_dns
+
+    rng = np.random.default_rng(31)
+    rows = [
+        dns_row(ip=f"10.0.0.{int(rng.integers(0, 40))}")
+        for _ in range(n)
+    ]
+    feats = featurize_dns(rows)
+    uniq_ips = sorted({feats.client_ip(i) for i in range(n)})
+    vocab = sorted(set(feats.word))
+    # Power-of-two theta/p (same construction as _exact_model): every
+    # score is exact in f32 AND f64, so host and device must agree on
+    # ordering bit-for-bit even under threshold=inf (all kept).
+    k = 4
+    theta = np.zeros((len(uniq_ips), k))
+    for i in range(len(uniq_ips)):
+        theta[i, i % k] = 2.0 ** -(i % 5)
+    p = np.full((len(vocab), k), 0.0)
+    for j in range(len(vocab)):
+        p[j] = 2.0 ** -(j % 3)
+    model = ScoringModel.from_results(uniq_ips, theta, vocab, p,
+                                      fallback=0.1)
+    return feats, model
+
+
+def test_score_dns_device_engine_matches_host():
+    feats, model = _dns_day()
+    from oni_ml_tpu.scoring import score_dns
+
+    host_rows, host_scores = score_dns(feats, model, threshold=np.inf)
+    dev_rows, dev_scores = score_dns(
+        feats, model, threshold=np.inf, engine="device"
+    )
+    assert len(dev_rows) == len(host_rows)
+    np.testing.assert_allclose(dev_scores, host_scores, rtol=1e-5)
+    # Featurized columns identical; only the trailing score column may
+    # drift in its float repr (f32-derived).
+    for hr, dr in zip(host_rows, dev_rows):
+        assert hr.rsplit(",", 1)[0] == dr.rsplit(",", 1)[0]
+        np.testing.assert_allclose(
+            float(dr.rsplit(",", 1)[1]), float(hr.rsplit(",", 1)[1]),
+            rtol=1e-5,
+        )
+
+
+def test_score_engine_rejects_unknown():
+    feats, model = _dns_day(10)
+    from oni_ml_tpu.scoring import score_dns
+
+    with pytest.raises(ValueError, match="engine"):
+        score_dns(feats, model, threshold=1.0, engine="gpu")
+
+
+def test_default_engine_bytes_unchanged(monkeypatch):
+    """The golden-bytes contract: with no engine override the host
+    float64 path runs and the CSV bytes are what the oracle emits."""
+    monkeypatch.delenv("ONI_ML_TPU_SCORE", raising=False)
+    feats, model = _dns_day(50)
+    from oni_ml_tpu.scoring import score_dns_csv
+
+    blob_default, _ = score_dns_csv(feats, model, threshold=np.inf)
+    blob_host, _ = score_dns_csv(
+        feats, model, threshold=np.inf, engine="host"
+    )
+    assert blob_default == blob_host
+
+
+# ---------------------------------------------------------------------------
+# bench phase smoke
+# ---------------------------------------------------------------------------
+
+
+def test_bench_scoring_e2e_smoke():
+    import bench
+
+    rec = bench.bench_scoring_e2e(n_events=1500, reps=1)
+    assert rec["n_events"] == 1500
+    assert rec["host_events_per_sec"] > 0
+    assert rec["device_events_per_sec"] > 0
+    assert rec["value"] > 0
+    assert rec["dispatch"]["dispatches"] >= 1
+    assert "calibration" in rec
+    # The dispatch-count projection the acceptance criteria name.
+    n, chunk = 400_000, rec["chunk"]
+    assert rec["projected_dispatches_400k"] == -(-n // chunk)
