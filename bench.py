@@ -742,13 +742,21 @@ def _write_dns_day(f, n_events, n_clients=20_000, n_doms=5_000, seed=13,
 
 
 def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
-                       em_max_iters=40, dsource="flow"):
+                       em_max_iters=40, dsource="flow", pre_workers=0,
+                       compare_pre_workers1=True):
     """One full `run_pipeline` day — the reference's actual unit of work
     (`./ml_ops.sh YYYYMMDD flow`, timed per stage at ml_ops.sh:57-108):
     featurize + word counts, corpus build, LDA to convergence, scoring +
     emit, on a synthetic ~5M-event flow day.  Returns (total_seconds,
-    {stage: seconds}, events_per_sec) so any host-side stage that comes
-    to dominate the device work is visible in the breakdown."""
+    {stage: seconds}, events_per_sec, pre_detail) so any host-side stage
+    that comes to dominate the device work is visible in the breakdown.
+
+    `pre_detail` carries the pre stage's parallel-featurization record:
+    resolved worker count, per-pass walls, merge overhead, the
+    featurizer→corpus handoff mode, and — when `compare_pre_workers1`
+    and the resolved count is > 1 — a `pre_s_workers1` sequential
+    re-measurement of just the pre stage, so the sharding win (or
+    single-core parity) is recorded in the bench payload itself."""
     import shutil
     import tempfile
 
@@ -758,7 +766,8 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
         PipelineConfig,
         ScoringConfig,
     )
-    from oni_ml_tpu.runner.ml_ops import run_pipeline
+    from oni_ml_tpu.features.shards import resolve_pre_workers
+    from oni_ml_tpu.runner.ml_ops import Stage, run_pipeline
 
     # Under the orchestrator, BENCH_E2E_DIR scopes this run's day dirs
     # so the parent can clean up a killed child's leftovers without
@@ -782,6 +791,7 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
             # Reference-like tiny TOL: almost nothing emitted — the
             # emit-heavy path is measured by bench_flow_scoring.
             scoring=ScoringConfig(threshold=1e-20),
+            pre_workers=pre_workers,
         )
         t0 = time.perf_counter()
         metrics = run_pipeline(cfg, "20160122", dsource, force=True)
@@ -791,7 +801,40 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
             for m in metrics
             if "wall_s" in m
         }
-        return total, stages, n_events / total
+        pre_rec = next(
+            (m for m in metrics if m.get("stage") == "pre"), {}
+        )
+        pre_detail = {
+            "pre_workers": pre_rec.get("pre_workers"),
+            "wall": pre_rec.get("wall"),
+            "handoff": next(
+                (m.get("handoff") for m in metrics
+                 if m.get("stage") == "corpus"), None,
+            ),
+        }
+        if "merge_wall_s" in pre_rec:
+            pre_detail["merge_wall_s"] = pre_rec["merge_wall_s"]
+        if compare_pre_workers1 and resolve_pre_workers(pre_workers) > 1:
+            # Sequential baseline of JUST the pre stage into a second
+            # day dir (same raw file): the sharding comparison the
+            # acceptance contract wants recorded, without re-running
+            # LDA/scoring.
+            work1 = os.path.join(work, "w1")
+            os.makedirs(work1, exist_ok=True)
+            m1 = run_pipeline(
+                cfg.replace(data_dir=work1, pre_workers=1),
+                "20160122", dsource, force=True, stages=[Stage.PRE],
+            )
+            w1 = next(
+                (m["wall_s"] for m in m1
+                 if m.get("stage") == "pre" and "wall_s" in m), None,
+            )
+            if w1 is not None and stages.get("pre"):
+                pre_detail["pre_s_workers1"] = round(w1, 2)
+                pre_detail["pre_speedup_vs_workers1"] = round(
+                    w1 / stages["pre"], 2
+                )
+        return total, stages, n_events / total, pre_detail
     finally:
         shutil.rmtree(work, ignore_errors=True)
         _E2E_WORKDIRS.remove(work)
@@ -1286,22 +1329,27 @@ def phase_config4():
 def phase_pipeline_e2e():
     """The reference's actual unit of work: one full day start-to-finish
     (`./ml_ops.sh YYYYMMDD flow`, ml_ops.sh:57-108), with the stage
-    breakdown exposing any host-side stage that dominates."""
-    total, stages, eps = bench_pipeline_e2e()
+    breakdown exposing any host-side stage that dominates.  Runs the
+    pre stage sharded (pre_workers=auto) and records the sequential
+    pre-stage baseline alongside, so the featurization win — or
+    single-core parity — is in the payload, not just in docs prose."""
+    total, stages, eps, pre = bench_pipeline_e2e()
     return {"value": round(total, 1), "unit": "seconds",
             "events_per_sec": round(eps, 1), "n_events": 5_000_000,
-            "stages": stages}
+            "stages": stages, "pre": pre,
+            "pre_workers": pre.get("pre_workers")}
 
 
 def phase_pipeline_e2e_dns():
     """DNS day (combinatorial word space; one document per querying
     client, dns_pre_lda.scala:330-334)."""
-    total, stages, eps = bench_pipeline_e2e(
+    total, stages, eps, pre = bench_pipeline_e2e(
         n_events=2_000_000, n_src=20_000, dsource="dns"
     )
     return {"value": round(total, 1), "unit": "seconds",
             "events_per_sec": round(eps, 1), "n_events": 2_000_000,
-            "stages": stages}
+            "stages": stages, "pre": pre,
+            "pre_workers": pre.get("pre_workers")}
 
 
 # Every phase: (name, fn, per-subprocess timeout, touches_device).

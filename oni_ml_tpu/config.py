@@ -265,6 +265,15 @@ class PipelineConfig:
     dns_path: str = ""             # raw DNS CSV/parquet paths (DNS_PATH)
     top_domains_path: str = ""     # Alexa top-1m.csv (dns_pre_lda.scala:62)
     qtiles_path: str = ""          # precomputed flow cuts (SURVEY §2.7)
+    # Pre-stage shard workers: day files split into line-aligned byte
+    # ranges and featurized concurrently (native std::threads, or
+    # concurrent.futures in the pure-Python fallback), with a
+    # deterministic first-seen merge that keeps word_counts.dat and
+    # every downstream artifact byte-identical across worker counts.
+    # 0 = auto (one worker per host core), 1 = the exact legacy
+    # sequential path.  The reference's answer to this stage was a
+    # 62-executor Spark cluster (dns_pre_lda.scala:1-2).
+    pre_workers: int = 0
     lda: LDAConfig = field(default_factory=LDAConfig)
     online_lda: OnlineLDAConfig = field(default_factory=OnlineLDAConfig)
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)

@@ -11,6 +11,7 @@ consume.
 from .quantiles import ecdf_cuts, bin_values
 from .flow import FlowFeatures, featurize_flow, FLOW_COLUMNS
 from .native_flow import featurize_flow_file
+from .shards import resolve_pre_workers
 from .dns import (
     DnsFeatures,
     featurize_dns,
@@ -39,4 +40,5 @@ __all__ = [
     "DNS_COLUMNS",
     "read_flow_feedback_rows",
     "read_dns_feedback_rows",
+    "resolve_pre_workers",
 ]

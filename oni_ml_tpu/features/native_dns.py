@@ -40,6 +40,12 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.dfz_ingest_csv_file.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
     ]
+    lib.dfz_ingest_csv_file_parallel.restype = ctypes.c_int64
+    lib.dfz_ingest_csv_file_parallel.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.dfz_merge_ns.restype = ctypes.c_int64
+    lib.dfz_merge_ns.argtypes = [ctypes.c_void_p]
     lib.dfz_ingest_rows.restype = ctypes.c_int64
     lib.dfz_ingest_rows.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
@@ -62,6 +68,12 @@ def _configure(lib: ctypes.CDLL) -> None:
         [ctypes.c_void_p]
         + [_F64P, ctypes.c_int] * 5
         + [ctypes.c_char_p, ctypes.c_int64]
+    )
+    lib.dfz_finish_mt.restype = ctypes.c_int
+    lib.dfz_finish_mt.argtypes = (
+        [ctypes.c_void_p]
+        + [_F64P, ctypes.c_int] * 5
+        + [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
     )
     lib.dfz_ids.restype = _I32P
     lib.dfz_ids.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -275,6 +287,8 @@ def _featurize_native(
     feedback_rows: Sequence[Sequence[str]],
     top_domains: frozenset,
     spill_path: str | None = None,
+    workers: int = 1,
+    timings: "dict | None" = None,
 ) -> "NativeDnsFeatures | None":
     """Run the native featurizer; returns None when ingest saw a CSV
     field embedding the \\x1f transport separator (the stored rows blob
@@ -285,15 +299,29 @@ def _featurize_native(
     # matters for multi-source days).  An unsafe field mid-run simply
     # returns None: the finally below destroys the half-ingested
     # handle and the caller falls back to the Python path.
+    import time as _time
+
     h = lib.dfz_create()
     try:
         if spill_path is not None and lib.dfz_set_spill(
             h, os.fsencode(spill_path)
         ) < 0:
             raise OSError(lib.dfz_error(h).decode("utf-8", "replace"))
+        t0 = _time.perf_counter()
         for src in sources:
             if isinstance(src, str):
-                if lib.dfz_ingest_csv_file(h, os.fsencode(src), 0) < 0:
+                # Parallel ingest shards each CSV file (pass A) across
+                # std::thread workers with a deterministic first-seen
+                # merge; in-memory row blobs (parquet) stay sequential
+                # — source order, and so the id contract, is unchanged.
+                rc = (
+                    lib.dfz_ingest_csv_file_parallel(
+                        h, os.fsencode(src), 0, workers
+                    )
+                    if workers > 1
+                    else lib.dfz_ingest_csv_file(h, os.fsencode(src), 0)
+                )
+                if rc < 0:
                     raise OSError(
                         lib.dfz_error(h).decode("utf-8", "replace")
                     )
@@ -317,6 +345,7 @@ def _featurize_native(
                 raise OSError(lib.dfz_error(h).decode("utf-8", "replace"))
             del blob
 
+        t1 = _time.perf_counter()
         n = lib.dfz_num_events(h)
         tstamp = _copy(lib.dfz_tstamp(h), n, np.float64)
         frame_len = _copy(lib.dfz_frame_len(h), n, np.float64)
@@ -328,6 +357,8 @@ def _featurize_native(
         sub_len = _copy(lib.dfz_sublen(h), n, np.int32)
         n_parts = _copy(lib.dfz_nparts(h), n, np.int32)
 
+        # One global ECDF over the merged arrays, whatever the worker
+        # count — sharding can never move a bin edge.
         time_cuts = ecdf_cuts(tstamp, DECILES)
         frame_length_cuts = ecdf_cuts(frame_len, DECILES)
         subdomain_length_cuts = ecdf_cuts(sub_len[sub_len > 0], QUINTILES)
@@ -341,8 +372,18 @@ def _featurize_native(
         def fp(a):
             return np.ascontiguousarray(a, np.float64).ctypes.data_as(_F64P)
 
-        if (
-            lib.dfz_finish(
+        t2 = _time.perf_counter()
+        if workers > 1:
+            rc = lib.dfz_finish_mt(
+                h, fp(time_cuts), len(time_cuts),
+                fp(frame_length_cuts), len(frame_length_cuts),
+                fp(subdomain_length_cuts), len(subdomain_length_cuts),
+                fp(entropy_cuts), len(entropy_cuts),
+                fp(numperiods_cuts), len(numperiods_cuts),
+                top_blob, len(top_blob), workers,
+            )
+        else:
+            rc = lib.dfz_finish(
                 h, fp(time_cuts), len(time_cuts),
                 fp(frame_length_cuts), len(frame_length_cuts),
                 fp(subdomain_length_cuts), len(subdomain_length_cuts),
@@ -350,9 +391,15 @@ def _featurize_native(
                 fp(numperiods_cuts), len(numperiods_cuts),
                 top_blob, len(top_blob),
             )
-            < 0
-        ):
+        if rc < 0:
             raise ValueError(lib.dfz_error(h).decode("utf-8", "replace"))
+        if timings is not None:
+            timings.update(
+                parse_s=round(t1 - t0, 3),
+                cuts_s=round(t2 - t1, 3),
+                word_build_s=round(_time.perf_counter() - t2, 3),
+                merge_s=round(lib.dfz_merge_ns(h) / 1e9, 3),
+            )
 
         nwc = lib.dfz_wc_len(h)
         if spill_path is not None:
@@ -401,6 +448,8 @@ def featurize_dns_sources(
     top_domains: frozenset = frozenset(),
     feedback_rows: Sequence[Sequence[str]] = (),
     spill_path: str | None = None,
+    workers: int = 1,
+    timings: "dict | None" = None,
 ) -> "NativeDnsFeatures | DnsFeatures":
     """Featurize DNS events, native when possible.
 
@@ -427,8 +476,17 @@ def featurize_dns_sources(
     the Python path instead of silently dropping events.  CSV files can
     likewise embed '\\x1f' inside a field; native ingest detects that
     and the run falls back the same way.
-    """
 
+    `workers` shards each CSV source into line-aligned byte ranges and
+    runs the parse/word-build passes concurrently (0 = auto from the
+    host core count, 1 = the exact legacy sequential path); the
+    deterministic merge keeps every output byte-identical across worker
+    counts.  `timings` (dict, filled in place) receives per-pass walls
+    and the merge overhead for the runner's stage metrics.
+    """
+    from .shards import resolve_pre_workers
+
+    workers = resolve_pre_workers(workers)
     lib = _LIB.load()
     if lib is not None:
         # _featurize_native returns None when any in-memory field embeds
@@ -436,19 +494,42 @@ def featurize_dns_sources(
         # detects an embedded separator — the whole run then falls back
         # (a partially-written spill file is simply left unreferenced).
         feats = _featurize_native(lib, sources, feedback_rows, top_domains,
-                                  spill_path=spill_path)
+                                  spill_path=spill_path, workers=workers,
+                                  timings=timings)
         if feats is not None:
             return feats
+    import time as _time
+
     from .lineio import iter_raw_lines
 
+    t0 = _time.perf_counter()
     rows: list[list[str]] = []
     for src in sources:
         if isinstance(src, str):
-            rows.extend(
-                line.split(",") for line in iter_raw_lines(src) if line
-            )
+            if workers > 1:
+                # Fallback parallelism: concurrent shard reads with
+                # bounded buffering (shards.py), order-preserving —
+                # featurization below stays the one sequential pass.
+                from .shards import iter_lines_sharded
+
+                rows.extend(
+                    line.split(",")
+                    for line in iter_lines_sharded([src], workers)
+                    if line
+                )
+            else:
+                rows.extend(
+                    line.split(",") for line in iter_raw_lines(src) if line
+                )
         else:
             rows.extend(list(r) for r in src)
-    return featurize_dns(
+    if timings is not None:
+        timings["parse_s"] = round(_time.perf_counter() - t0, 3)
+    feats = featurize_dns(
         rows, top_domains=top_domains, feedback_rows=feedback_rows
     )
+    if timings is not None:
+        timings["word_build_s"] = round(
+            _time.perf_counter() - t0 - timings["parse_s"], 3
+        )
+    return feats

@@ -38,6 +38,12 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.ffz_error.argtypes = [ctypes.c_void_p]
     lib.ffz_ingest_file.restype = ctypes.c_int64
     lib.ffz_ingest_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ffz_ingest_file_parallel.restype = ctypes.c_int64
+    lib.ffz_ingest_file_parallel.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.ffz_merge_ns.restype = ctypes.c_int64
+    lib.ffz_merge_ns.argtypes = [ctypes.c_void_p]
     lib.ffz_ingest_buffer.restype = ctypes.c_int64
     lib.ffz_ingest_buffer.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
@@ -58,6 +64,11 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.ffz_finish.argtypes = [
         ctypes.c_void_p, _F64P, ctypes.c_int, _F64P, ctypes.c_int, _F64P,
         ctypes.c_int,
+    ]
+    lib.ffz_finish_mt.restype = ctypes.c_int
+    lib.ffz_finish_mt.argtypes = [
+        ctypes.c_void_p, _F64P, ctypes.c_int, _F64P, ctypes.c_int, _F64P,
+        ctypes.c_int, ctypes.c_int,
     ]
     for fn in ("ffz_bins", "ffz_ids"):
         getattr(lib, fn).restype = _I32P
@@ -311,15 +322,29 @@ def _featurize_native(
     feedback_rows: Sequence[str],
     precomputed_cuts=None,
     spill_path: str | None = None,
+    workers: int = 1,
+    timings: "dict | None" = None,
 ) -> NativeFlowFeatures:
+    import time as _time
+
     h = lib.ffz_create(1)
     try:
         if spill_path is not None and lib.ffz_set_spill(
             h, os.fsencode(spill_path)
         ) < 0:
             raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
+        t0 = _time.perf_counter()
         for path in paths:
-            if lib.ffz_ingest_file(h, os.fsencode(path)) < 0:
+            # Parallel ingest shards EACH file (pass A) across
+            # std::thread workers with a deterministic first-seen merge
+            # — byte-identical to the sequential path, which workers=1
+            # takes verbatim.
+            rc = (
+                lib.ffz_ingest_file_parallel(h, os.fsencode(path), workers)
+                if workers > 1
+                else lib.ffz_ingest_file(h, os.fsencode(path))
+            )
+            if rc < 0:
                 raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
         lib.ffz_mark_raw(h)
         if feedback_rows:
@@ -328,6 +353,7 @@ def _featurize_native(
             )
             if lib.ffz_ingest_buffer(h, blob, len(blob)) < 0:
                 raise OSError(lib.ffz_error(h).decode("utf-8", "replace"))
+        t1 = _time.perf_counter()
         n = lib.ffz_num_events(h)
         num_time = _copy(lib.ffz_num_time(h), n, np.float64)
         ibyt = _copy(lib.ffz_ibyt(h), n, np.float64)
@@ -338,21 +364,36 @@ def _featurize_native(
                 for x in precomputed_cuts
             )
         else:
+            # ECDF cuts keep their single global definition: computed
+            # ONCE over the merged arrays whatever the worker count, so
+            # sharding can never move a bin edge.
             time_cuts = ecdf_cuts(num_time, DECILES)
             ibyt_cuts = ecdf_cuts(ibyt, DECILES)
             ipkt_cuts = ecdf_cuts(ipkt, QUINTILES)
+        t2 = _time.perf_counter()
 
         def fp(a):
             return a.ctypes.data_as(_F64P)
 
-        if (
-            lib.ffz_finish(
+        if workers > 1:
+            rc = lib.ffz_finish_mt(
+                h, fp(time_cuts), len(time_cuts), fp(ibyt_cuts),
+                len(ibyt_cuts), fp(ipkt_cuts), len(ipkt_cuts), workers,
+            )
+        else:
+            rc = lib.ffz_finish(
                 h, fp(time_cuts), len(time_cuts), fp(ibyt_cuts),
                 len(ibyt_cuts), fp(ipkt_cuts), len(ipkt_cuts),
             )
-            < 0
-        ):
+        if rc < 0:
             raise ValueError(lib.ffz_error(h).decode("utf-8", "replace"))
+        if timings is not None:
+            timings.update(
+                parse_s=round(t1 - t0, 3),
+                cuts_s=round(t2 - t1, 3),
+                word_build_s=round(_time.perf_counter() - t2, 3),
+                merge_s=round(lib.ffz_merge_ns(h) / 1e9, 3),
+            )
         nwc = lib.ffz_wc_len(h)
         if spill_path is not None:
             from .blob import MmapBlob
@@ -401,6 +442,8 @@ def featurize_flow_file(
     feedback_rows: Sequence[str] = (),
     precomputed_cuts=None,
     spill_path: str | None = None,
+    workers: int = 1,
+    timings: "dict | None" = None,
 ) -> "NativeFlowFeatures | FlowFeatures":
     """Featurize raw netflow CSV input, native when possible.
 
@@ -416,7 +459,19 @@ def featurize_flow_file(
     stays bounded by the numeric per-event arrays, and pickling the
     returned container stores the spill path, not the bytes.  The
     Python fallback keeps rows in memory (it exists for environments
-    without a C++ toolchain, where day-scale data is not expected)."""
+    without a C++ toolchain, where day-scale data is not expected).
+
+    `workers` shards each input file into line-aligned byte ranges and
+    runs the parse/word-build passes concurrently (0 = auto from the
+    host core count, 1 = the exact legacy sequential path); the
+    deterministic first-seen merge keeps every output byte-identical
+    across worker counts — pinned by tests/test_pre_parallel.py.
+    `timings` (a dict, filled in place) receives the per-pass walls
+    (parse_s / cuts_s / word_build_s) and the merge overhead (merge_s)
+    for the runner's stage metrics."""
+    from .shards import resolve_pre_workers
+
+    workers = resolve_pre_workers(workers)
     paths = expand_flow_paths(path)
     if not paths:
         # An empty expansion (empty directory, unmatched glob, empty
@@ -425,13 +480,31 @@ def featurize_flow_file(
     lib = _LIB.load()
     if lib is not None:
         return _featurize_native(lib, paths, feedback_rows,
-                                 precomputed_cuts, spill_path=spill_path)
+                                 precomputed_cuts, spill_path=spill_path,
+                                 workers=workers, timings=timings)
+    import time as _time
+
     from itertools import chain
 
     from .lineio import iter_raw_lines
 
-    return featurize_flow(
-        chain.from_iterable(iter_raw_lines(p) for p in paths),
+    t0 = _time.perf_counter()
+    if workers > 1:
+        # Fallback parallelism: the shard plan reads/decodes/splits
+        # concurrently ahead of the consumer with bounded buffering
+        # (shards.py iter_lines_sharded); featurization itself stays
+        # the one sequential pass over the ordered line stream, so the
+        # output is the sequential output by construction.
+        from .shards import iter_lines_sharded
+
+        lines = iter_lines_sharded(paths, workers)
+    else:
+        lines = chain.from_iterable(iter_raw_lines(p) for p in paths)
+    feats = featurize_flow(
+        lines,
         feedback_rows=feedback_rows,
         precomputed_cuts=precomputed_cuts,
     )
+    if timings is not None:
+        timings["word_build_s"] = round(_time.perf_counter() - t0, 3)
+    return feats

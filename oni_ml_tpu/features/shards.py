@@ -1,0 +1,106 @@
+"""Shard plan for the parallel pre stage.
+
+The native featurizers shard a day file internally (std::thread workers
+behind ``ffz_ingest_file_parallel`` / ``dfz_ingest_csv_file_parallel``,
+native_src/common.h ``shard_bounds``); this module is the Python twin:
+the same line-aligned byte-range plan, used by the pure-Python fallback
+(`concurrent.futures` over shards) and by tests that pin the plan's
+invariants.  Boundaries always land right after a ``\\n``, so a CRLF
+pair or a multi-megabyte line is never torn across workers, and the
+ranges concatenated in order cover the input exactly once — which is
+what makes workers=N output byte-identical to workers=1.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+
+def resolve_pre_workers(workers: int) -> int:
+    """Config semantics of ``pre_workers``: 0 = auto (one worker per
+    host core), 1 = the exact legacy sequential path, N = that many
+    shard workers."""
+    if workers < 0:
+        raise ValueError(f"pre_workers must be >= 0, got {workers}")
+    if workers == 0:
+        return max(1, os.cpu_count() or 1)
+    return workers
+
+
+def plan_file_shards(
+    path: str, workers: int, data_start: int = 0
+) -> list[tuple[int, int]]:
+    """`workers` line-aligned [begin, end) byte ranges covering
+    [data_start, size).  Each range begins at a line start (the byte
+    after a ``\\n``; range 0 at data_start); ranges collapse to empty
+    when one line spans several raw splits."""
+    size = os.path.getsize(path)
+    bounds = [data_start]
+    span = size - data_start
+    with open(path, "rb") as f:
+        for i in range(1, workers):
+            cand = max(data_start + span * i // workers, bounds[-1])
+            f.seek(cand)
+            bound = size
+            pos = cand
+            while pos < size:
+                chunk = f.read(min(1 << 20, size - pos))
+                if not chunk:
+                    break
+                j = chunk.find(b"\n")
+                if j >= 0:
+                    bound = pos + j + 1
+                    break
+                pos += len(chunk)
+            bounds.append(bound)
+    bounds.append(size)
+    return [(bounds[i], bounds[i + 1]) for i in range(workers)]
+
+
+def read_shard_lines(path: str, begin: int, end: int) -> list[str]:
+    """Decoded lines of one byte range, with exactly
+    ``lineio.iter_raw_lines`` semantics: ``\\n`` terminators dropped,
+    ONE trailing ``\\r`` stripped per line, empty lines kept (callers
+    filter), the final unterminated line included."""
+    if begin >= end:
+        return []
+    with open(path, "rb") as f:
+        f.seek(begin)
+        data = f.read(end - begin)
+    parts = data.split(b"\n")
+    if parts and parts[-1] == b"":
+        parts.pop()  # range ended right after a '\n', not mid-line
+    return [
+        (ln[:-1] if ln.endswith(b"\r") else ln).decode(
+            "utf-8", "surrogateescape"
+        )
+        for ln in parts
+    ]
+
+
+def iter_lines_sharded(paths: Sequence[str], workers: int):
+    """Ordered line stream over `paths`, each file read as concurrent
+    shards — the fallback path's parallelism: read/decode/split overlap
+    across shards while featurization stays one pass (the native entry
+    points are the production parallel path).
+
+    Shards are planned 4× finer than the worker count and consumed in
+    submission order with at most workers+1 in flight, so the peak
+    buffered text is a bounded fraction of the file — never the whole
+    decoded day at once (the fallback serves toolchain-free hosts,
+    where doubling peak memory is exactly the wrong trade)."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        for path in paths:
+            shards = plan_file_shards(path, workers * 4)
+            pending: deque = deque()
+            idx = 0
+            while idx < len(shards) or pending:
+                while idx < len(shards) and len(pending) <= workers:
+                    b, e = shards[idx]
+                    pending.append(ex.submit(read_shard_lines, path, b, e))
+                    idx += 1
+                yield from pending.popleft().result()

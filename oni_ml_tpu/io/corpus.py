@@ -60,30 +60,92 @@ class Corpus:
     @classmethod
     def from_word_counts(cls, triples: Iterable[tuple[str, str, int]]) -> "Corpus":
         """Build from ``(ip, word, count)`` triples, assigning ids in
-        first-seen order exactly like lda_pre.py:30-77."""
+        first-seen order exactly like lda_pre.py:30-77.
+
+        Interning stays a dict pass (it defines the id order), but the
+        CSR fill is vectorized: flat (doc, word, count) arrays gathered
+        in one ``np.fromiter`` pass each, then a stable argsort by doc
+        groups tokens per document while preserving their appearance
+        order — the former nested per-doc/per-token Python loop scaled
+        with every token of the day."""
         word_ids: dict[str, int] = {}
         doc_ids: dict[str, int] = {}
-        doc_tokens: list[list[tuple[int, int]]] = []
+        d_list: list[int] = []
+        w_list: list[int] = []
+        c_list: list[int] = []
         for ip, word, count in triples:
-            w = word_ids.setdefault(word, len(word_ids))
+            w_list.append(word_ids.setdefault(word, len(word_ids)))
             d = doc_ids.get(ip)
             if d is None:
                 d = len(doc_ids)
                 doc_ids[ip] = d
-                doc_tokens.append([])
-            doc_tokens[d].append((w, count))
+            d_list.append(d)
+            c_list.append(count)
 
-        ptr = np.zeros(len(doc_tokens) + 1, dtype=np.int64)
-        for d, toks in enumerate(doc_tokens):
-            ptr[d + 1] = ptr[d] + len(toks)
-        widx = np.empty(int(ptr[-1]), dtype=np.int32)
-        cnts = np.empty(int(ptr[-1]), dtype=np.int32)
-        for d, toks in enumerate(doc_tokens):
-            lo = int(ptr[d])
-            for j, (w, c) in enumerate(toks):
-                widx[lo + j] = w
-                cnts[lo + j] = c
-        return cls(list(doc_ids), list(word_ids), ptr, widx, cnts)
+        nnz = len(d_list)
+        d_arr = np.fromiter(d_list, dtype=np.int64, count=nnz)
+        widx = np.fromiter(w_list, dtype=np.int32, count=nnz)
+        cnts = np.fromiter(c_list, dtype=np.int32, count=nnz)
+        perm = np.argsort(d_arr, kind="stable")
+        ptr = np.zeros(len(doc_ids) + 1, dtype=np.int64)
+        np.cumsum(np.bincount(d_arr, minlength=len(doc_ids)), out=ptr[1:])
+        return cls(
+            list(doc_ids), list(word_ids), ptr, widx[perm], cnts[perm]
+        )
+
+    @classmethod
+    def from_features(cls, features) -> "Corpus":
+        """Direct featurizer→corpus handoff: build the CSR straight
+        from a native feature container's interned tables and
+        aggregated id arrays (``wc_ip``/``wc_word``/``wc_count``),
+        skipping the word_counts.dat text round-trip entirely — the
+        in-process ``run_pipeline`` used to emit ~1.5M triples as text
+        in stage_pre only for stage_corpus to re-parse and re-intern
+        the identical strings moments later.
+
+        Identical output to ``from_word_counts(features.word_counts())``
+        (and therefore to parsing the emitted file): corpus word/doc
+        ids are assigned in first-seen order over the aggregated
+        triples, which here is a vectorized first-occurrence remap of
+        the featurizer's table ids.  Pure-Python containers (no
+        ``wc_ip``) route through their triples."""
+        wc_ip = getattr(features, "wc_ip", None)
+        if wc_ip is None:
+            return cls.from_word_counts(features.word_counts())
+        wc_word = np.asarray(features.wc_word)
+        wc_count = np.asarray(features.wc_count)
+        wc_ip = np.asarray(wc_ip)
+
+        def first_seen(ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            """(table ids in first-seen order, old->new id map)."""
+            uniq, first = np.unique(ids, return_index=True)
+            order = uniq[np.argsort(first, kind="stable")]
+            remap = np.empty(
+                int(uniq.max()) + 1 if len(uniq) else 0, dtype=np.int64
+            )
+            remap[order] = np.arange(len(order))
+            return order, remap
+
+        w_order, w_remap = first_seen(wc_word)
+        d_order, d_remap = first_seen(wc_ip)
+        d_arr = d_remap[wc_ip] if len(wc_ip) else np.zeros(0, np.int64)
+        perm = np.argsort(d_arr, kind="stable")
+        ptr = np.zeros(len(d_order) + 1, dtype=np.int64)
+        np.cumsum(np.bincount(d_arr, minlength=len(d_order)), out=ptr[1:])
+        widx = (
+            w_remap[wc_word][perm].astype(np.int32)
+            if len(wc_word)
+            else np.zeros(0, np.int32)
+        )
+        word_table = features.word_table
+        ip_table = features.ip_table
+        return cls(
+            [ip_table[int(j)] for j in d_order],
+            [word_table[int(j)] for j in w_order],
+            ptr,
+            widx,
+            wc_count[perm].astype(np.int32, copy=False),
+        )
 
     @classmethod
     def from_word_counts_file(cls, path: str) -> "Corpus":

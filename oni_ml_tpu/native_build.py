@@ -99,6 +99,9 @@ class NativeLib:
             # Match CPython's unfused float arithmetic bit-for-bit
             # (the parity tests assert exact equality on entropy etc.).
             "-ffp-contract=off",
+            # The featurizers' parallel ingest/finish paths spawn
+            # std::threads; harmless for the thread-free modules.
+            "-pthread",
             "-o", tmp, self._src,
         ]
         try:

@@ -30,14 +30,17 @@
 #include "common.h"
 
 #include <charconv>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -138,6 +141,10 @@ struct Dfz {
   std::vector<int32_t> word_id;
   std::vector<int32_t> wc_ip, wc_word;
   std::vector<int64_t> wc_cnt;
+
+  // Wall spent in the deterministic merges of the parallel paths
+  // (pass-A shard-table remap + pass-B word/count merge).
+  int64_t merge_ns = 0;
 
   std::string error;
 
@@ -246,6 +253,107 @@ struct Dfz {
   }
 };
 
+// Pass-B state over one contiguous event range: binning, whitelist
+// flag, word construction, first-seen per-client aggregation.  The
+// sequential path runs one PassD over all events with `words` bound to
+// h->words; the parallel path runs one per shard with a shard-local
+// interner and merges deterministically in shard order — both walk
+// each event through exactly this code (the flow featurizer's PassB
+// design).
+struct PassD {
+  Dfz* h;
+  Interner& words;
+  const double* tc;
+  const double* lc;
+  const double* sc;
+  const double* ec;
+  const double* pc;
+  int ntc, nlc, nsc, nec, npc;
+  const std::vector<int32_t>& dom_top;
+
+  oni::FlatMap64 pos;
+  std::vector<int32_t> w_ip, w_w;  // word ids are in `words`
+  std::vector<int64_t> w_c;
+
+  // The word is a pure function of (top, 5 bins, qtype, qrcode); unique
+  // combinations number far below the row count, so cache the interned
+  // id behind a packed integer key and skip the per-row string build.
+  // Packing limits (bins < 256, interner ids < 2048, top in 0..3) hold
+  // for any real day; rows beyond them fall back to building the word.
+  oni::FlatMap64 word_cache;
+  std::string word;  // scratch
+
+  PassD(Dfz* h_, Interner& w, const std::vector<int32_t>& dt,
+        size_t expected)
+      : h(h_), words(w), dom_top(dt), pos(expected / 2) {}
+
+  void event(size_t i) {
+    int bt = bin_of(h->tstamp_[i], tc, ntc);
+    int bl = bin_of((double)h->flen_[i], lc, nlc);
+    int bs = bin_of((double)h->sublen_[i], sc, nsc);
+    int be = bin_of(h->entropy_[i], ec, nec);
+    int bp = bin_of((double)h->nparts_[i], pc, npc);
+    int tp = dom_top[(size_t)h->dom_id[i]];
+    h->top[i] = tp;
+
+    int32_t qt = h->qtype_id[i], qr = h->qrcode_id[i];
+    bool cacheable =
+        (unsigned)bt < 256 && (unsigned)bl < 256 && (unsigned)bs < 256 &&
+        (unsigned)be < 256 && (unsigned)bp < 256 && (unsigned)tp < 4 &&
+        (uint32_t)qt < 2048 && (uint32_t)qr < 2048;
+    uint64_t wkey = 0;
+    int64_t* wslot = nullptr;
+    bool fresh = true;
+    if (cacheable) {
+      wkey = ((uint64_t)tp << 62) | ((uint64_t)bt << 54) |
+             ((uint64_t)bl << 46) | ((uint64_t)bs << 38) |
+             ((uint64_t)be << 30) | ((uint64_t)bp << 22) |
+             ((uint64_t)(uint32_t)qt << 11) | (uint64_t)(uint32_t)qr;
+      if (wkey != oni::FlatMap64::EMPTY)
+        wslot = &word_cache.probe(wkey, &fresh);
+    }
+    int32_t wid;
+    if (!fresh) {
+      wid = (int32_t)*wslot;
+    } else {
+      // word = top_blen_btime_bsub_bent_bper_type_rcode
+      // (dns_pre_lda.scala:320-327; raw type/rcode field text).
+      word.clear();
+      append_int(word, tp);
+      word += '_';
+      append_int(word, bl);
+      word += '_';
+      append_int(word, bt);
+      word += '_';
+      append_int(word, bs);
+      word += '_';
+      append_int(word, be);
+      word += '_';
+      append_int(word, bp);
+      word += '_';
+      word += h->qtypes.arena[(size_t)h->qtype_id[i]];
+      word += '_';
+      word += h->qrcodes.arena[(size_t)h->qrcode_id[i]];
+      wid = words.intern(word);
+      if (wslot) *wslot = wid;
+    }
+    h->word_id[i] = wid;
+
+    uint64_t key = ((uint64_t)(uint32_t)h->ip_id[i] << 32) | (uint32_t)wid;
+    int64_t& slot = pos.probe(key, &fresh);
+    if (fresh) {
+      slot = (int64_t)w_c.size();
+      w_ip.push_back(h->ip_id[i]);
+      w_w.push_back(wid);
+      w_c.push_back(1);
+    } else {
+      w_c[(size_t)slot]++;
+    }
+  }
+};
+
+using oni::now_ns;
+
 }  // namespace
 
 extern "C" {
@@ -335,15 +443,138 @@ const double* dfz_entropy(void* h) { return ((Dfz*)h)->entropy_.data(); }
 const int32_t* dfz_sublen(void* h) { return ((Dfz*)h)->sublen_.data(); }
 const int32_t* dfz_nparts(void* h) { return ((Dfz*)h)->nparts_.data(); }
 
-// top_blob: '\n'-joined whitelisted base-domain names (load_top_domains
-// output), decoded into a set for the flag pass.
-int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
-               int nlc, const double* sc, int nsc, const double* ec, int nec,
-               const double* pc, int npc, const char* top_blob,
-               int64_t top_len) {
+// Shard the CSV file into line-aligned byte ranges and run pass A over
+// them on `workers` std::threads, each into its own shard-local Dfz,
+// then merge in shard order: every shard-local interner (client IPs,
+// domains, subdomains, qtypes, qrcodes) re-interns into the parent in
+// local first-seen order, reproducing the sequential first-seen order
+// exactly (flow_featurize.cpp ffz_ingest_file_parallel design notes;
+// spill handling and RSS tradeoff identical).
+int64_t dfz_ingest_csv_file_parallel(void* hv, const char* path,
+                                     int skip_header, int workers) {
   Dfz* h = (Dfz*)hv;
-  size_t n = h->tstamp_.size();
+  if (workers <= 1) return dfz_ingest_csv_file(hv, path, skip_header);
+  int64_t size = oni::file_size_of(path);
+  if (size < 0) {
+    h->error = std::string("cannot open ") + path;
+    return -1;
+  }
+  int64_t data_start = 0;
+  if (skip_header) {
+    std::string hdr, err;
+    if (!oni::read_first_line(path, hdr, &data_start, err)) {
+      if (!err.empty()) {
+        h->error = err;
+        return -1;
+      }
+      // No '\n' at all: the whole file is the header — nothing to
+      // ingest (the sequential path drops it the same way).
+      return (int64_t)h->tstamp_.size();
+    }
+  }
+  std::string err;
+  std::vector<int64_t> bounds =
+      oni::shard_bounds(path, data_start, size, workers, err);
+  if (bounds.empty()) {
+    h->error = err;
+    return -1;
+  }
+  std::vector<std::unique_ptr<Dfz>> shards((size_t)workers);
+  std::vector<int> ok((size_t)workers, 1);
+  std::vector<std::thread> threads;
+  for (int k = 0; k < workers; k++) {
+    shards[(size_t)k] = std::make_unique<Dfz>();
+    Dfz* w = shards[(size_t)k].get();
+    int64_t lo = bounds[(size_t)k], hi = bounds[(size_t)k + 1];
+    threads.emplace_back([w, path, lo, hi, &ok, k] {
+      ok[(size_t)k] = oni::stream_file_range(
+                          path, lo, hi, w->error,
+                          [w](const char* p, int64_t n) {
+                            w->ingest(p, n, ',', /*skip_empty=*/true);
+                          })
+                          ? 1
+                          : 0;
+    });
+  }
+  for (auto& t : threads) t.join();
+  for (int k = 0; k < workers; k++) {
+    if (!ok[(size_t)k]) {
+      h->error = shards[(size_t)k]->error;
+      return -1;
+    }
+  }
 
+  int64_t t0 = now_ns();
+  {
+    size_t tot_ev = 0, tot_bytes = 0;
+    for (int k = 0; k < workers; k++) {
+      tot_ev += shards[(size_t)k]->tstamp_.size();
+      tot_bytes += shards[(size_t)k]->rows.size();
+    }
+    h->tstamp_.reserve(h->tstamp_.size() + tot_ev);
+    h->flen_.reserve(h->flen_.size() + tot_ev);
+    h->entropy_.reserve(h->entropy_.size() + tot_ev);
+    h->sublen_.reserve(h->sublen_.size() + tot_ev);
+    h->nparts_.reserve(h->nparts_.size() + tot_ev);
+    h->row_off.reserve(h->row_off.size() + tot_ev);
+    if (!h->spill) h->rows.reserve(h->rows.size() + tot_bytes);
+  }
+  for (int k = 0; k < workers; k++) {
+    Dfz* w = shards[(size_t)k].get();
+    h->unsafe = h->unsafe || w->unsafe;
+    // Remap every shard-local interner into the parent (local
+    // first-seen order -> global first-seen order).
+    Interner* locals[5] = {&w->ips, &w->domains, &w->subdomains,
+                           &w->qtypes, &w->qrcodes};
+    Interner* globals[5] = {&h->ips, &h->domains, &h->subdomains,
+                            &h->qtypes, &h->qrcodes};
+    std::vector<int32_t>* ids[5] = {&w->ip_id, &w->dom_id, &w->sub_id,
+                                    &w->qtype_id, &w->qrcode_id};
+    std::vector<int32_t>* outs[5] = {&h->ip_id, &h->dom_id, &h->sub_id,
+                                     &h->qtype_id, &h->qrcode_id};
+    for (int t = 0; t < 5; t++) {
+      std::vector<int32_t> map(locals[t]->arena.size());
+      for (size_t j = 0; j < locals[t]->arena.size(); j++)
+        map[j] = globals[t]->intern(locals[t]->arena[j]);
+      outs[t]->reserve(outs[t]->size() + ids[t]->size());
+      for (int32_t lid : *ids[t])
+        outs[t]->push_back(map[(size_t)lid]);
+    }
+    h->tstamp_.insert(h->tstamp_.end(), w->tstamp_.begin(),
+                      w->tstamp_.end());
+    h->flen_.insert(h->flen_.end(), w->flen_.begin(), w->flen_.end());
+    h->entropy_.insert(h->entropy_.end(), w->entropy_.begin(),
+                       w->entropy_.end());
+    h->sublen_.insert(h->sublen_.end(), w->sublen_.begin(),
+                      w->sublen_.end());
+    h->nparts_.insert(h->nparts_.end(), w->nparts_.begin(),
+                      w->nparts_.end());
+    if (h->spill) {
+      if (!w->rows.empty() &&
+          fwrite(w->rows.data(), 1, w->rows.size(), h->spill) !=
+              w->rows.size()) {
+        h->spill_err = true;
+        h->error = "short write to rows spill file (disk full?)";
+      }
+      for (size_t j = 1; j < w->row_off.size(); j++)
+        h->row_off.push_back(h->spill_len + w->row_off[j]);
+      h->spill_len += (int64_t)w->rows.size();
+    } else {
+      int64_t base = (int64_t)h->rows.size();
+      h->rows += w->rows;
+      for (size_t j = 1; j < w->row_off.size(); j++)
+        h->row_off.push_back(base + w->row_off[j]);
+    }
+    shards[(size_t)k].reset();  // free shard memory as the merge walks
+  }
+  h->merge_ns += now_ns() - t0;
+  return h->spill_err ? -1 : (int64_t)h->tstamp_.size();
+}
+
+int64_t dfz_merge_ns(void* hv) { return ((Dfz*)hv)->merge_ns; }
+
+static void build_dom_top(Dfz* h, const char* top_blob, int64_t top_len,
+                          std::vector<int32_t>& dom_top) {
   std::unordered_set<std::string_view> top_set;
   const char* p = top_blob;
   const char* end = top_blob + top_len;
@@ -354,93 +585,150 @@ int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
     p = nl ? nl + 1 : end;
   }
   // Whitelist flag per unique domain, not per row.
-  std::vector<int32_t> dom_top(h->domains.arena.size());
+  dom_top.resize(h->domains.arena.size());
   for (size_t i = 0; i < h->domains.arena.size(); i++) {
     const std::string& d = h->domains.arena[i];
     dom_top[i] = d == "intel" ? 2 : (top_set.count(d) ? 1 : 0);
   }
+}
+
+// top_blob: '\n'-joined whitelisted base-domain names (load_top_domains
+// output), decoded into a set for the flag pass.
+int dfz_finish(void* hv, const double* tc, int ntc, const double* lc,
+               int nlc, const double* sc, int nsc, const double* ec, int nec,
+               const double* pc, int npc, const char* top_blob,
+               int64_t top_len) {
+  Dfz* h = (Dfz*)hv;
+  size_t n = h->tstamp_.size();
+
+  std::vector<int32_t> dom_top;
+  build_dom_top(h, top_blob, top_len, dom_top);
 
   h->top.resize(n);
   h->word_id.resize(n);
 
-  oni::FlatMap64 pos(n / 2);
+  PassD p(h, h->words, dom_top, n);
+  p.tc = tc;
+  p.lc = lc;
+  p.sc = sc;
+  p.ec = ec;
+  p.pc = pc;
+  p.ntc = ntc;
+  p.nlc = nlc;
+  p.nsc = nsc;
+  p.nec = nec;
+  p.npc = npc;
+  for (size_t i = 0; i < n; i++) p.event(i);
+
+  h->wc_ip = std::move(p.w_ip);
+  h->wc_word = std::move(p.w_w);
+  h->wc_cnt = std::move(p.w_c);
+  return 0;
+}
+
+// Pass B over `workers` contiguous event ranges (shard-local word
+// interners + first-seen maps), merged deterministically in shard
+// order — byte-identical output to dfz_finish given identical cuts
+// (flow_featurize.cpp ffz_finish_mt design notes).
+int dfz_finish_mt(void* hv, const double* tc, int ntc, const double* lc,
+                  int nlc, const double* sc, int nsc, const double* ec,
+                  int nec, const double* pc, int npc, const char* top_blob,
+                  int64_t top_len, int workers) {
+  Dfz* h = (Dfz*)hv;
+  size_t n = h->tstamp_.size();
+  if (workers <= 1 || n < 2)
+    return dfz_finish(hv, tc, ntc, lc, nlc, sc, nsc, ec, nec, pc, npc,
+                      top_blob, top_len);
+  if ((size_t)workers > n) workers = (int)n;
+
+  std::vector<int32_t> dom_top;
+  build_dom_top(h, top_blob, top_len, dom_top);
+  h->top.resize(n);
+  h->word_id.resize(n);
+
+  std::vector<std::unique_ptr<Interner>> local_words((size_t)workers);
+  std::vector<std::unique_ptr<PassD>> passes((size_t)workers);
+  std::vector<std::thread> threads;
+  for (int k = 0; k < workers; k++) {
+    size_t lo = n * (size_t)k / (size_t)workers;
+    size_t hi = n * ((size_t)k + 1) / (size_t)workers;
+    local_words[(size_t)k] = std::make_unique<Interner>();
+    passes[(size_t)k] = std::make_unique<PassD>(
+        h, *local_words[(size_t)k], dom_top, hi - lo);
+    PassD* p = passes[(size_t)k].get();
+    p->tc = tc;
+    p->lc = lc;
+    p->sc = sc;
+    p->ec = ec;
+    p->pc = pc;
+    p->ntc = ntc;
+    p->nlc = nlc;
+    p->nsc = nsc;
+    p->nec = nec;
+    p->npc = npc;
+    threads.emplace_back([p, lo, hi] {
+      for (size_t i = lo; i < hi; i++) p->event(i);
+    });
+  }
+  for (auto& t : threads) t.join();
+
+  int64_t t0 = now_ns();
+  // Sequential word interning (order is the id contract), parallel
+  // per-range id rewrites, merge maps pre-sized for the real entry
+  // totals (flow ffz_finish_mt design notes).
+  std::vector<std::vector<int32_t>> wmaps((size_t)workers);
+  for (int k = 0; k < workers; k++) {
+    Interner& lw = *local_words[(size_t)k];
+    std::vector<int32_t>& wmap = wmaps[(size_t)k];
+    wmap.resize(lw.arena.size());
+    for (size_t j = 0; j < lw.arena.size(); j++)
+      wmap[j] = h->words.intern(lw.arena[j]);
+  }
+  {
+    std::vector<std::thread> rewrite;
+    for (int k = 0; k < workers; k++) {
+      const std::vector<int32_t>* wmap = &wmaps[(size_t)k];
+      size_t lo = n * (size_t)k / (size_t)workers;
+      size_t hi = n * ((size_t)k + 1) / (size_t)workers;
+      rewrite.emplace_back([h, wmap, lo, hi] {
+        for (size_t i = lo; i < hi; i++)
+          h->word_id[i] = (*wmap)[(size_t)h->word_id[i]];
+      });
+    }
+    for (auto& t : rewrite) t.join();
+  }
+  size_t tot = 0;
+  for (int k = 0; k < workers; k++) tot += passes[(size_t)k]->w_c.size();
+  oni::FlatMap64 pos(tot);
   std::vector<int32_t> w_ip, w_w;
   std::vector<int64_t> w_c;
-
-  // The word is a pure function of (top, 5 bins, qtype, qrcode); unique
-  // combinations number far below the row count, so cache the interned
-  // id behind a packed integer key and skip the per-row string build.
-  // Packing limits (bins < 256, interner ids < 2048, top in 0..3) hold
-  // for any real day; rows beyond them fall back to building the word.
-  oni::FlatMap64 word_cache;
-
-  std::string word;
-  for (size_t i = 0; i < n; i++) {
-    int bt = bin_of(h->tstamp_[i], tc, ntc);
-    int bl = bin_of((double)h->flen_[i], lc, nlc);
-    int bs = bin_of((double)h->sublen_[i], sc, nsc);
-    int be = bin_of(h->entropy_[i], ec, nec);
-    int bp = bin_of((double)h->nparts_[i], pc, npc);
-    int tp = dom_top[(size_t)h->dom_id[i]];
-    h->top[i] = tp;
-
-    int32_t qt = h->qtype_id[i], qr = h->qrcode_id[i];
-    bool cacheable =
-        (unsigned)bt < 256 && (unsigned)bl < 256 && (unsigned)bs < 256 &&
-        (unsigned)be < 256 && (unsigned)bp < 256 && (unsigned)tp < 4 &&
-        (uint32_t)qt < 2048 && (uint32_t)qr < 2048;
-    uint64_t wkey = 0;
-    int64_t* wslot = nullptr;
-    bool fresh = true;
-    if (cacheable) {
-      wkey = ((uint64_t)tp << 62) | ((uint64_t)bt << 54) |
-             ((uint64_t)bl << 46) | ((uint64_t)bs << 38) |
-             ((uint64_t)be << 30) | ((uint64_t)bp << 22) |
-             ((uint64_t)(uint32_t)qt << 11) | (uint64_t)(uint32_t)qr;
-      if (wkey != oni::FlatMap64::EMPTY)
-        wslot = &word_cache.probe(wkey, &fresh);
+  w_ip.reserve(tot);
+  w_w.reserve(tot);
+  w_c.reserve(tot);
+  for (int k = 0; k < workers; k++) {
+    const std::vector<int32_t>& wmap = wmaps[(size_t)k];
+    PassD& p = *passes[(size_t)k];
+    for (size_t e = 0; e < p.w_c.size(); e++) {
+      int32_t gw = wmap[(size_t)p.w_w[e]];
+      uint64_t key =
+          ((uint64_t)(uint32_t)p.w_ip[e] << 32) | (uint32_t)gw;
+      bool fresh;
+      int64_t& slot = pos.probe(key, &fresh);
+      if (fresh) {
+        slot = (int64_t)w_c.size();
+        w_ip.push_back(p.w_ip[e]);
+        w_w.push_back(gw);
+        w_c.push_back(p.w_c[e]);
+      } else {
+        w_c[(size_t)slot] += p.w_c[e];
+      }
     }
-    int32_t wid;
-    if (!fresh) {
-      wid = (int32_t)*wslot;
-    } else {
-      // word = top_blen_btime_bsub_bent_bper_type_rcode
-      // (dns_pre_lda.scala:320-327; raw type/rcode field text).
-      word.clear();
-      append_int(word, tp);
-      word += '_';
-      append_int(word, bl);
-      word += '_';
-      append_int(word, bt);
-      word += '_';
-      append_int(word, bs);
-      word += '_';
-      append_int(word, be);
-      word += '_';
-      append_int(word, bp);
-      word += '_';
-      word += h->qtypes.arena[(size_t)h->qtype_id[i]];
-      word += '_';
-      word += h->qrcodes.arena[(size_t)h->qrcode_id[i]];
-      wid = h->words.intern(word);
-      if (wslot) *wslot = wid;
-    }
-    h->word_id[i] = wid;
-
-    uint64_t key = ((uint64_t)(uint32_t)h->ip_id[i] << 32) | (uint32_t)wid;
-    int64_t& slot = pos.probe(key, &fresh);
-    if (fresh) {
-      slot = (int64_t)w_c.size();
-      w_ip.push_back(h->ip_id[i]);
-      w_w.push_back(wid);
-      w_c.push_back(1);
-    } else {
-      w_c[(size_t)slot]++;
-    }
+    passes[(size_t)k].reset();
   }
   h->wc_ip = std::move(w_ip);
   h->wc_word = std::move(w_w);
   h->wc_cnt = std::move(w_c);
+  h->merge_ns += now_ns() - t0;
   return 0;
 }
 

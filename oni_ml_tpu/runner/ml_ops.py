@@ -86,6 +86,17 @@ class RunContext:
     eval_quality: bool = False
     eval_holdout: float = 0.0
     metrics: list = field(default_factory=list)
+    # In-process featurizer→corpus handoff: stage_pre parks the live
+    # feature container here so stage_corpus builds the Corpus straight
+    # from its interned tables (Corpus.from_features) instead of
+    # re-parsing word_counts.dat; stage_corpus clears it once consumed.
+    features: object = None
+    # Background word_counts.dat writer (stage_pre): the file is the
+    # resume/audit contract, not an input to this run, so its write
+    # overlaps the LDA stage.  Joined (and errors re-raised) before
+    # run_pipeline returns.
+    wc_writer: object = None
+    wc_writer_err: list = field(default_factory=list)
 
     def path(self, name: str) -> str:
         return os.path.join(self.day_dir, name)
@@ -197,6 +208,10 @@ def _read_parquet_rows(path: str) -> list[list[str]]:
 def stage_pre(ctx: RunContext) -> dict:
     cfg = ctx.config
     fb = cfg.feedback
+    from ..features.shards import resolve_pre_workers
+
+    workers = resolve_pre_workers(cfg.pre_workers)
+    timings: dict = {}
     if ctx.dsource == "flow":
         fb_rows = read_flow_feedback_rows(
             os.path.join(cfg.data_dir, "flow_scores.csv"),
@@ -218,6 +233,7 @@ def stage_pre(ctx: RunContext) -> dict:
         features = featurize_flow_file(
             cfg.flow_path, feedback_rows=fb_rows, precomputed_cuts=cuts,
             spill_path=ctx.path("raw_lines.bin"),
+            workers=workers, timings=timings,
         )
     else:
         fb_rows = read_dns_feedback_rows(
@@ -242,41 +258,111 @@ def stage_pre(ctx: RunContext) -> dict:
             _dns_sources(cfg.dns_path), top_domains=top,
             feedback_rows=fb_rows,
             spill_path=ctx.path("raw_lines.bin"),
+            workers=workers, timings=timings,
         )
+    t0 = time.perf_counter()
     with open(ctx.path("features.pkl"), "wb") as f:
         pickle.dump(features, f, protocol=pickle.HIGHEST_PROTOCOL)
+    timings["pickle_s"] = round(time.perf_counter() - t0, 3)
     # Native containers emit the whole word_counts buffer in C++ from
     # their interned tables + aggregated id arrays; building ~1.5M
     # Python (str,str,int) tuples and writing line-by-line was half the
     # pre stage on a 2M-event day.  Byte-identical to the fallback
     # (pinned by tests/test_scoring.py::test_native_word_counts_emit_*).
+    t0 = time.perf_counter()
     n_wc = None
+    blob = None
     if hasattr(features, "wc_ip"):
         from ..native_emit import word_counts_emit
 
         blob = word_counts_emit(features)
-        if blob is not None:
-            with open(ctx.path("word_counts.dat"), "wb") as f:
-                f.write(blob)
-            n_wc = len(features.wc_ip)
-    if n_wc is None:
+    if blob is not None:
+        timings["wc_emit_s"] = round(time.perf_counter() - t0, 3)
+        n_wc = len(features.wc_ip)
+        # word_counts.dat is the resume/audit contract (_stage_done),
+        # not an input to THIS run — stage_corpus consumes the live
+        # container via Corpus.from_features.  Writing it on a
+        # background thread overlaps the file IO with the LDA stage;
+        # run_pipeline joins (and surfaces errors) before returning.
+        # The write is tmp+rename so the contract name only ever names
+        # a COMPLETE file: _stage_done checks bare existence, and the
+        # overlap window spans the whole LDA stage — a hard kill
+        # mid-write must not leave a truncated word_counts.dat that a
+        # resumed run would silently parse.
+        wc_path = ctx.path("word_counts.dat")
+        # Remove any PRIOR run's contract file before the overlap
+        # window opens: tmp+rename protects against truncation, not
+        # staleness — a force rerun killed during LDA must leave a day
+        # dir whose resume re-runs pre, never one that silently pairs
+        # this run's features.pkl with the previous run's
+        # word_counts.dat.
+        for stale in (wc_path, wc_path + ".tmp"):
+            try:
+                os.unlink(stale)
+            except FileNotFoundError:
+                pass
+
+        def _write_wc(blob=blob, path=wc_path):
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)
+            except BaseException as e:  # surfaced at join
+                ctx.wc_writer_err.append(e)
+
+        import threading
+
+        ctx.wc_writer = threading.Thread(
+            target=_write_wc, name="wc-writer"
+        )
+        ctx.wc_writer.start()
+        timings["wc_write"] = "background"
+    else:
         triples = features.word_counts()
-        formats.write_word_counts(ctx.path("word_counts.dat"), triples)
+        # Same atomic publish as the background path: a crash mid-write
+        # must not leave a partial contract file under the real name.
+        formats.write_word_counts(ctx.path("word_counts.dat.tmp"), triples)
+        os.replace(ctx.path("word_counts.dat.tmp"),
+                   ctx.path("word_counts.dat"))
         n_wc = len(triples)
-    return {
+        timings["wc_emit_s"] = round(time.perf_counter() - t0, 3)
+        timings["wc_write"] = "inline"
+    ctx.features = features  # direct handoff to stage_corpus
+    merge_wall = timings.pop("merge_s", None)
+    out = {
         "events": features.num_events,
         "word_count_rows": n_wc,
         "feedback_rows": len(fb_rows),
+        "pre_workers": workers,
+        "wall": timings,
     }
+    if merge_wall is not None:
+        out["merge_wall_s"] = merge_wall
+    return out
 
 
 def stage_corpus(ctx: RunContext) -> dict:
-    corpus = Corpus.from_word_counts_file(ctx.path("word_counts.dat"))
+    if ctx.features is not None:
+        # In-process run: the featurizer's container is still live —
+        # build the CSR straight from its interned tables instead of
+        # re-parsing the ~word_count_rows text triples stage_pre just
+        # held in native arrays (identical output, pinned by
+        # tests/test_pre_parallel.py).
+        corpus = Corpus.from_features(ctx.features)
+        handoff = "direct"
+        ctx.features = None  # release featurizer arrays before LDA
+    else:
+        # Resume path (--stages corpus, or pre skipped as done): the
+        # emitted file is the contract.
+        corpus = Corpus.from_word_counts_file(ctx.path("word_counts.dat"))
+        handoff = "file"
     corpus.save(ctx.day_dir)
     return {
         "docs": corpus.num_docs,
         "vocab": corpus.num_terms,
         "tokens": corpus.num_tokens,
+        "handoff": handoff,
     }
 
 
@@ -623,8 +709,51 @@ def run_pipeline(
     multiproc = jax.process_count() > 1
     is_coord = jax.process_index() == 0
     wanted = stages or STAGE_ORDER
+    try:
+        _run_stages(ctx, wanted, force, multiproc, is_coord)
+    finally:
+        # The background word_counts.dat writer (stage_pre) must finish
+        # before this process hands the day dir to anyone — it is the
+        # resume/audit contract.  Joined even on a failing run so a
+        # crashed LDA stage can't leave a half-written contract file
+        # racing the interpreter exit.
+        th = ctx.wc_writer
+        if th is not None:
+            th.join()
+            ctx.wc_writer = None
+    if ctx.wc_writer_err:
+        raise RuntimeError(
+            "background word_counts.dat write failed"
+        ) from ctx.wc_writer_err[0]
+    def _dump_metrics() -> None:
+        with open(ctx.path("metrics.json"), "w") as f:
+            json.dump(ctx.metrics, f, indent=1)
+
+    # metrics.json lands BEFORE publish so the delivered day dir carries
+    # the run's metrics — and so a failed delivery cannot lose them.
+    if is_coord:
+        _dump_metrics()
+    if publish and is_coord:
+        t0 = time.perf_counter()
+        info = publish_day(day_dir, publish)
+        ctx.emit(
+            {"stage": "publish",
+             "wall_s": round(time.perf_counter() - t0, 3), **info}
+        )
+        _dump_metrics()  # refresh the local copy with the publish record
+    return ctx.metrics
+
+
+def _run_stages(ctx: RunContext, wanted, force: bool, multiproc: bool,
+                is_coord: bool) -> None:
     for stage in STAGE_ORDER:
         if stage not in wanted:
+            if stage is Stage.CORPUS:
+                # The handoff container only has one consumer; a run
+                # that excludes the corpus stage must not hold the
+                # featurizer's arrays (and, in no-spill runs, the raw
+                # blob) through LDA's peak.
+                ctx.features = None
             continue
         done = (
             _stage_done(ctx, stage) if (is_coord or not multiproc) else False
@@ -633,6 +762,8 @@ def run_pipeline(
         if multiproc:
             skip = _coord_decision(skip)
         if skip:
+            if stage is Stage.CORPUS:
+                ctx.features = None  # see above
             if is_coord:
                 record = {"stage": stage.value, "skipped": "outputs exist"}
                 if stage is Stage.LDA and ctx.eval_quality:
@@ -676,23 +807,6 @@ def run_pipeline(
                 )
         if err is not None:
             raise err
-    def _dump_metrics() -> None:
-        with open(ctx.path("metrics.json"), "w") as f:
-            json.dump(ctx.metrics, f, indent=1)
-
-    # metrics.json lands BEFORE publish so the delivered day dir carries
-    # the run's metrics — and so a failed delivery cannot lose them.
-    if is_coord:
-        _dump_metrics()
-    if publish and is_coord:
-        t0 = time.perf_counter()
-        info = publish_day(day_dir, publish)
-        ctx.emit(
-            {"stage": "publish",
-             "wall_s": round(time.perf_counter() - t0, 3), **info}
-        )
-        _dump_metrics()  # refresh the local copy with the publish record
-    return ctx.metrics
 
 
 def _build_config(args: argparse.Namespace) -> PipelineConfig:
@@ -703,6 +817,7 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
         dns_path=args.dns_path or env.get("DNS_PATH", ""),
         top_domains_path=args.top_domains or "",
         qtiles_path=args.qtiles or "",
+        pre_workers=args.pre_workers,
         lda=LDAConfig(
             num_topics=args.topics,
             alpha_init=args.alpha,
@@ -767,6 +882,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--qtiles", default=None,
         help="precomputed flow quantile cuts file (flow_qtiles format); "
         "skips the in-run ECDF pass and pins word identity across days",
+    )
+    p.add_argument(
+        "--pre-workers", type=int, default=0, metavar="N",
+        help="pre-stage shard workers: day files split into line-aligned "
+        "byte ranges featurized concurrently, with a deterministic "
+        "first-seen merge keeping every output byte-identical to the "
+        "sequential pass (0 = auto from host cores, 1 = legacy "
+        "single-pass)",
     )
     p.add_argument("--topics", type=int, default=20)
     p.add_argument("--alpha", type=float, default=2.5)

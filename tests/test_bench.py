@@ -178,16 +178,26 @@ def test_bench_dns_scoring_smoke():
 def test_bench_pipeline_e2e_smoke():
     import bench
 
-    total, stages, eps = bench.bench_pipeline_e2e(
+    total, stages, eps, pre = bench.bench_pipeline_e2e(
         n_events=3000, n_src=50, n_dst=30, em_max_iters=3
     )
     assert total > 0 and eps > 0
     assert set(stages) == {"pre", "corpus", "lda", "score"}
-    total, stages, eps = bench.bench_pipeline_e2e(
-        n_events=2000, n_src=40, em_max_iters=3, dsource="dns"
+    # The pre record carries the parallel-featurization payload: the
+    # resolved worker count, per-pass walls, the handoff mode, and (on
+    # a multi-core host) the sequential comparison.
+    assert pre["pre_workers"] >= 1
+    assert pre["handoff"] == "direct"
+    assert isinstance(pre["wall"], dict)
+    if pre["pre_workers"] > 1:
+        assert pre["pre_s_workers1"] > 0
+    total, stages, eps, pre = bench.bench_pipeline_e2e(
+        n_events=2000, n_src=40, em_max_iters=3, dsource="dns",
+        compare_pre_workers1=False,
     )
     assert total > 0 and eps > 0
     assert set(stages) == {"pre", "corpus", "lda", "score"}
+    assert "pre_s_workers1" not in pre
 
 
 def test_bench_flow_scoring_smoke():
@@ -229,7 +239,9 @@ def _patch_phases(bench, monkeypatch):
     monkeypatch.setattr(bench, "bench_online_svi", lambda *a, **k: 2000.0)
     monkeypatch.setattr(
         bench, "bench_pipeline_e2e",
-        lambda *a, **k: (60.0, {"pre": 10.0, "lda": 40.0}, 80000.0),
+        lambda *a, **k: (60.0, {"pre": 10.0, "lda": 40.0}, 80000.0,
+                         {"pre_workers": 2, "wall": {}, "handoff": "direct",
+                          "pre_s_workers1": 18.0}),
     )
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: True)
     monkeypatch.setattr(

@@ -1,0 +1,104 @@
+"""Pre-stage worker-count sweep — the featurization twin of
+tools/score_probe.py, so a new host can size `pre_workers` in one
+command:
+
+    python tools/pre_probe.py [n_events] [workers [workers ...]]
+
+(defaults: a synthetic 2M-event flow day AND a 1M-event DNS day, swept
+over workers 1/2/4/8/auto).  Each measurement prints one JSON line:
+events/sec through the full featurize path (parse + cuts + word build
++ word-count aggregation), the per-pass wall breakdown, and the
+deterministic-merge overhead (`merge_s` — the sequential term the shard
+fan-out pays; native_src/common.h shard_bounds design).  Output parity
+across worker counts is pinned by tests/test_pre_parallel.py, so the
+sweep is free to chase throughput only.
+
+Reading the result: the flat point is where shard parallelism is
+amortized against the merge — on a W-core host that is usually
+workers=W (= `pre_workers=0` auto); past it, extra shards only grow
+merge work.  A host where workers=1 wins (single-core containers,
+heavily contended VMs) should pin `pre_workers=1` in config — that is
+the exact legacy single-pass path, zero new code in the loop."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_WORKERS = (1, 2, 4, 8, 0)   # 0 = auto (host cores)
+
+
+def _day_files(tmp, n_events):
+    from bench import _write_dns_day, _write_flow_day
+
+    flow = os.path.join(tmp, "flow_day.csv")
+    with open(flow, "w") as f:
+        _write_flow_day(f, n_events)
+    dns = os.path.join(tmp, "dns_day.csv")
+    with open(dns, "w") as f:
+        _write_dns_day(f, max(n_events // 2, 1))
+    return flow, dns
+
+
+def sweep(n_events: int, workers, reps: int = 2) -> None:
+    import tempfile
+
+    from oni_ml_tpu.features import native_dns, native_flow
+    from oni_ml_tpu.features.shards import resolve_pre_workers
+
+    native = native_flow.available()
+    tmp = tempfile.mkdtemp(prefix="oni_pre_probe_")
+    try:
+        flow_path, dns_path = _day_files(tmp, n_events)
+        days = [
+            ("flow", n_events,
+             lambda w, t: native_flow.featurize_flow_file(
+                 flow_path, workers=w, timings=t)),
+            ("dns", max(n_events // 2, 1),
+             lambda w, t: native_dns.featurize_dns_sources(
+                 [dns_path], workers=w, timings=t)),
+        ]
+        for dsource, n, fn in days:
+            for w in workers:
+                resolved = resolve_pre_workers(w)
+                best, best_t = float("inf"), {}
+                for _ in range(reps):
+                    timings: dict = {}
+                    t0 = time.perf_counter()
+                    feats = fn(w, timings)
+                    dt = time.perf_counter() - t0
+                    if dt < best:
+                        best, best_t = dt, timings
+                print(json.dumps({
+                    "probe": "pre_worker_sweep", "dsource": dsource,
+                    "native": native, "workers": w,
+                    "resolved_workers": resolved, "n_events": n,
+                    "events_per_sec": round(n / best),
+                    "wall_s": round(best, 3),
+                    "merge_s": best_t.get("merge_s", 0.0),
+                    "passes": {
+                        k: v for k, v in best_t.items() if k != "merge_s"
+                    },
+                    "word_count_rows": (
+                        len(feats.wc_ip) if hasattr(feats, "wc_ip")
+                        else len(feats.word_counts())
+                    ),
+                }), flush=True)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    args = [int(a) for a in sys.argv[1:]]
+    n_events = args[0] if args else 2_000_000
+    workers = tuple(args[1:]) or DEFAULT_WORKERS
+    sweep(n_events, workers)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
