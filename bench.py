@@ -27,7 +27,15 @@ backend probe retries with backoff under a BOUNDED gate (BENCH_GATE_S,
 default 10 min — it must lose the race to the driver's own timeout),
 and every failure path prints a final structured JSON line
 ({"value": null, "error": ..., "last_good": ...}) so the driver's
-last-line parse always finds SOMETHING; every phase then runs
+last-line parse always finds SOMETHING; since round 6 the bench is
+journal-backed (oni_ml_tpu/telemetry): every completed phase lands in
+a ledger that rides EVERY failure payload as "phases" (plus a
+"backend_lost" annotation on dead-backend exits — the exact r05 loss
+mode, where value=null dropped all host-phase data), and
+BENCH_JOURNAL=path additionally appends each outcome to a crash-safe
+JSONL journal that survives a SIGKILL of the orchestrator itself
+(BENCH_HEARTBEAT_S=interval adds a journaled grant-liveness
+heartbeat between phases); every phase then runs
 in its OWN subprocess (`python bench.py --phase NAME`) under a
 per-phase timeout, so a grant that wedges inside one phase costs only
 that phase — the orchestrator re-probes the backend (with a recovery
@@ -974,19 +982,66 @@ def _driver_verified_record() -> "dict | None":
     return prev
 
 
-def _failure_payload(error: str, host_phases: "dict | None" = None) -> dict:
+# ---------------------------------------------------------------------------
+# Flight recorder (oni_ml_tpu/telemetry): completed-phase ledger +
+# optional crash-safe journal.  The r05 loss mode was a dead backend
+# producing `rc=1 value=null` with every host-phase measurement gone —
+# now EVERY phase that completes is (a) kept in the in-process ledger
+# that rides every failure payload, and (b) with BENCH_JOURNAL=path,
+# appended to a crash-safe JSONL journal that survives even a SIGKILL
+# of the orchestrator itself (tools/trace_view.py summarizes it).
+# ---------------------------------------------------------------------------
+
+_COMPLETED_PHASES: dict = {}
+_BENCH_JOURNAL = None
+
+
+def _open_bench_journal() -> None:
+    global _BENCH_JOURNAL
+    _BENCH_JOURNAL = None
+    path = os.environ.get("BENCH_JOURNAL")
+    if not path:
+        return
+    try:
+        from oni_ml_tpu.telemetry import Journal, RunJournal
+
+        _BENCH_JOURNAL = RunJournal(Journal(path))
+        _BENCH_JOURNAL.run_start(app="bench")
+    except Exception as e:  # journal trouble must never cost the bench
+        print(f"bench: journal unavailable: {e!r}", file=sys.stderr)
+        _BENCH_JOURNAL = None
+
+
+def _note_phase(name: str, payload: "dict | None" = None,
+                error: "str | None" = None) -> None:
+    """Record a phase outcome in the ledger (+ journal when open)."""
+    if payload is not None:
+        _COMPLETED_PHASES[name] = payload
+    if _BENCH_JOURNAL is not None:
+        if error is None:
+            _BENCH_JOURNAL.phase(name, ok=True, payload=payload)
+        else:
+            _BENCH_JOURNAL.phase(name, ok=False, error=error)
+
+
+def _failure_payload(error: str, host_phases: "dict | None" = None,
+                     backend_lost: bool = False) -> dict:
     """The structured failure record shared by every no-measurement
     exit path (gate failure, watchdog, SIGTERM salvage).
 
-    `host_phases` carries phases measured fresh THIS run on the host
-    while the device backend was unavailable (the scoring stages need
-    no chip) — first-class current measurements, kept separate from
-    the provenance-marked `last_good` history."""
+    `phases` carries EVERY phase that completed before the failure
+    (the journal-backed ledger — the exact r05 loss mode: a dead
+    backend used to null the whole round).  `host_phases` additionally
+    marks the ones measured host-only while the device backend was
+    unavailable.  `backend_lost` is the explicit dead-backend
+    annotation consumers branch on."""
     payload = {
         "metric": "lda_em_throughput",
         "value": None,
         "unit": "docs/sec",
         "error": error,
+        "backend_lost": bool(backend_lost),
+        "phases": dict(_COMPLETED_PHASES),
         "last_good": _last_good_record(),
         "last_driver_verified": _driver_verified_record(),
     }
@@ -995,13 +1050,21 @@ def _failure_payload(error: str, host_phases: "dict | None" = None) -> dict:
     return payload
 
 
-def _emit_failure(error: str, host_phases: "dict | None" = None) -> None:
+def _emit_failure(error: str, host_phases: "dict | None" = None,
+                  backend_lost: bool = False) -> None:
     """Final parseable stdout line for a run that produced no fresh
     measurement: rc=1 WITH structure instead of rc=124 with nothing
     (rounds 2 and 3 each lost their whole record to that shape).  The
-    driver parses the last line, so value=null + error + last_good is
-    what BENCH_r*.json carries for a dead-backend round."""
-    print(json.dumps(_failure_payload(error, host_phases)), flush=True)
+    driver parses the last line, so value=null + error + the completed
+    phases + last_good is what BENCH_r*.json carries for a dead-backend
+    round."""
+    payload = _failure_payload(error, host_phases,
+                               backend_lost=backend_lost)
+    if _BENCH_JOURNAL is not None:
+        if backend_lost:
+            _BENCH_JOURNAL.backend_lost(error=error)
+        _BENCH_JOURNAL.run_end(ok=False, error=error)
+    print(json.dumps(payload), flush=True)
 
 
 def _run_host_only_phases(inproc: bool) -> dict:
@@ -1047,6 +1110,16 @@ class _Record:
             if self.data is None:
                 return
             self.data.setdefault("secondary", {})[name] = payload
+        self.emit()
+
+    def annotate(self, key, value):
+        """Top-level annotation on the grown record (e.g. backend_lost
+        when the grant dies AFTER the headline: the round still has a
+        real value, and the consumer can see why secondaries stop)."""
+        with self.lock:
+            if self.data is None:
+                return
+            self.data[key] = value
         self.emit()
 
     def emit(self):
@@ -1465,6 +1538,9 @@ def _run_phase(name: str, fn, timeout: float, inproc: bool):
     wall = round(time.perf_counter() - t0, 1)
     if isinstance(payload, dict):
         payload["phase_wall_s"] = wall
+        _note_phase(name, payload)
+    else:
+        _note_phase(name, error=err)
     return payload, err, wall
 
 
@@ -1484,7 +1560,44 @@ def main() -> int:
         return run_phase(sys.argv[2])
 
     record = _Record()
+    _COMPLETED_PHASES.clear()   # tests drive main() repeatedly in-process
+    _open_bench_journal()
     _install_sigterm_salvage(record)
+    # Optional journaled liveness heartbeat (BENCH_HEARTBEAT_S=interval):
+    # probes via the same subprocess-isolated device-count probe the
+    # grant watcher trusts — the orchestrator itself never touches the
+    # device — and once lost, remaining device phases are skipped just
+    # like a failed mid-run re-probe.
+    hb = None
+    hb_interval = float(os.environ.get("BENCH_HEARTBEAT_S", 0) or 0)
+    if hb_interval > 0:
+        from oni_ml_tpu.telemetry.heartbeat import (
+            HeartbeatMonitor,
+            subprocess_probe,
+        )
+
+        hb = HeartbeatMonitor(
+            interval_s=hb_interval, timeout_s=PROBE_S, max_misses=2,
+            journal=_BENCH_JOURNAL,
+            # > 0: PROBE_UNAVAILABLE (-1, no graft entry) is truthy and
+            # must read as a miss, not a healthy backend.
+            probe=lambda t: (
+                1.0 if (subprocess_probe(t) or 0) > 0 else None
+            ),
+            deep_probe=None,
+        ).start()
+
+    def run_phase_gated(*args):
+        # Probes pause while a phase subprocess holds the backend: a
+        # busy healthy grant must never be probed into backend_lost
+        # (liveness is judged BETWEEN phases only).
+        if hb is not None:
+            hb.pause()
+        try:
+            return _run_phase(*args)
+        finally:
+            if hb is not None:
+                hb.resume()
     # Readiness marker: tells a supervising process (and the SIGTERM
     # test) that the salvage handler is live — a TERM from here on
     # always leaves a parseable last line.
@@ -1511,6 +1624,7 @@ def main() -> int:
             f"{float(os.environ.get('BENCH_GATE_S', GATE_BUDGET_S)):.0f}s "
             "probe gate",
             host_phases=host,
+            backend_lost=True,
         )
         return 1
 
@@ -1525,8 +1639,8 @@ def main() -> int:
     head_name, head_fn, head_timeout, _ = PHASES[0]
     payload = None
     for attempt in range(3):
-        payload, err, wall = _run_phase(head_name, head_fn, head_timeout,
-                                        inproc)
+        payload, err, wall = run_phase_gated(head_name, head_fn,
+                                             head_timeout, inproc)
         if payload is not None:
             break
         print(f"bench: headline attempt {attempt + 1} failed after "
@@ -1544,7 +1658,8 @@ def main() -> int:
 
             shutil.rmtree(_RUN_E2E_DIR, ignore_errors=True)
         _emit_failure(f"headline unrecoverable after 3 attempts: {err}",
-                      host_phases=host)
+                      host_phases=host,
+                      backend_lost="timeout" in str(err))
         return 1
     record.set_headline(
         metric="lda_em_throughput",
@@ -1560,6 +1675,15 @@ def main() -> int:
 
     backend_dead = False
     for name, fn, timeout, touches_device in PHASES[1:]:
+        if hb is not None and hb.lost.is_set() and not backend_dead:
+            # The journaled heartbeat noticed the grant die between
+            # phases — same consequence as a failed mid-run re-probe,
+            # but detected without burning a phase timeout first.
+            print(f"bench: heartbeat declared backend lost "
+                  f"({hb.lost_reason}) — skipping remaining device "
+                  "phases", file=sys.stderr)
+            backend_dead = True
+            record.annotate("backend_lost", hb.lost_reason or True)
         if backend_dead and touches_device:
             # Don't burn this phase's whole timeout hanging in backend
             # init against a grant already proven dead; host-only
@@ -1569,7 +1693,7 @@ def main() -> int:
                        "phase_wall_s": 0.0}
             )
             continue
-        payload, err, wall = _run_phase(name, fn, timeout, inproc)
+        payload, err, wall = run_phase_gated(name, fn, timeout, inproc)
         if payload is not None:
             record.add_secondary(name, payload)
             continue
@@ -1588,13 +1712,24 @@ def main() -> int:
             backend_dead = not _backend_responsive(
                 attempt_timeouts=(RECOVERY_PROBE,), backoffs=()
             )
+            if backend_dead:
+                record.annotate(
+                    "backend_lost", f"wedged during phase {name}"
+                )
+                if _BENCH_JOURNAL is not None:
+                    _BENCH_JOURNAL.backend_lost(phase=name)
 
     watchdog.cancel()
+    if hb is not None:
+        hb.stop()
     if _RUN_E2E_DIR:
         import shutil
 
         shutil.rmtree(_RUN_E2E_DIR, ignore_errors=True)
     record.emit()
+    if _BENCH_JOURNAL is not None:
+        _BENCH_JOURNAL.run_end(ok=True)
+        _BENCH_JOURNAL.close()
     return 0
 
 

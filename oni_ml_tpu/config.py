@@ -70,25 +70,36 @@ class LDAConfig:
     # the r05 chunk sweep), so chunk=8 spent ~8 ms of glue per EM
     # iteration where chunk=128 spends ~0.5 ms — and the device
     # while_loop exits the moment |dll/ll| < em_tol, so a chunk larger
-    # than the iterations-to-convergence costs THROUGHPUT nothing.  What
-    # it does cost is crash-safety granularity: likelihood.dat
-    # streaming, progress callbacks, and the authoritative float64
-    # convergence check all live at chunk boundaries, so with
-    # checkpoint_every=0 a whole fit can be ONE dispatch and a crash
-    # loses every likelihood line.  host_sync_every below bounds that
-    # interval independently of the chunk size.
+    # than the iterations-to-convergence costs THROUGHPUT nothing.
+    #
+    # The OBSERVABILITY tradeoff (ADVICE r5): everything host-visible —
+    # likelihood.dat streaming, progress callbacks, the run journal's
+    # em_ll points, checkpointing, and the authoritative float64
+    # convergence check — lives at dispatch boundaries.  With
+    # em_max_iters=100 and checkpoint_every=0, chunk=128 makes an
+    # ENTIRE production fit one device dispatch: a crash loses every
+    # likelihood line and a multi-hour run is opaque until it returns.
+    # That is why host_sync_every below now DEFAULTS ON (16): the sync
+    # cadence is bounded independently of the chunk size, so raising
+    # fused_em_chunk can never again silently collapse crash-safety and
+    # progress to end-of-run.  Raise fused_em_chunk freely; lower
+    # host_sync_every only with the glue price in mind.
     fused_em_chunk: int = 128
     # Upper bound on EM iterations between HOST syncs in the fused
     # driver, independent of fused_em_chunk: each dispatch runs at most
     # min(fused_em_chunk, host_sync_every) iterations, so likelihood.dat
-    # lines stream (and progress fires) at least that often even when
-    # checkpointing is off.  The chunk program is compiled once at
-    # fused_em_chunk and driven with a dynamic step count, so tightening
-    # this costs only the extra dispatch glue (~65 ms/dispatch under the
-    # tunneled backend, ~none locally), no recompiles.  0 = sync every
-    # fused_em_chunk iterations (maximum throughput, coarsest
-    # observability).
-    host_sync_every: int = 0
+    # lines stream, progress fires, and the telemetry journal gets its
+    # em_ll points at least that often even when checkpointing is off.
+    # The chunk program is compiled once at fused_em_chunk and driven
+    # with a dynamic step count, so tightening this costs only the
+    # extra dispatch glue (~65 ms/dispatch under the tunneled backend,
+    # ~none locally), no recompiles.  Default 16 (ADVICE r5): ~1 s of
+    # tunnel glue per 16 EM iterations — <2% at the measured ~65 ms
+    # glue / ~0.94 ms device iteration — buys a bounded-loss likelihood
+    # stream; 0 = sync every fused_em_chunk iterations (maximum
+    # throughput, coarsest observability — a whole fit can be one
+    # dispatch).
+    host_sync_every: int = 16
     # Dense-corpus E-step (ops/dense_estep.py): "auto" densifies the corpus
     # once and runs the gather/scatter-free MXU kernel when the device is a
     # TPU, the doc blocks fit VMEM, and the dense corpus fits the HBM
@@ -255,6 +266,33 @@ class ServingConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Flight recorder (oni_ml_tpu/telemetry/, docs/observability.md):
+    the crash-safe run journal, span tracing, and the background
+    device-liveness heartbeat.  Journaling is ON by default — it is the
+    resume/post-mortem contract, and its cost is one buffered line per
+    recorded event with a bounded fsync cadence."""
+
+    # Append a crash-safe JSONL run journal (run_journal.jsonl in the
+    # day directory): stage spans, EM likelihood points, scoring
+    # DispatchStats, heartbeats.  The runner resumes against it.
+    journal: bool = True
+    # fsync after this many appends (stage boundaries always fsync);
+    # a SIGKILL loses at most this many records.
+    journal_fsync_every: int = 16
+    # Background device-liveness probe interval; 0 disables.  When on,
+    # a backend that stops answering becomes a clean BackendLost at the
+    # next stage boundary (journaled as backend_lost) instead of a
+    # silent hang.
+    heartbeat_s: float = 0.0
+    # One in-process probe round trip must answer within this long.
+    heartbeat_timeout_s: float = 60.0
+    # Consecutive misses before the subprocess-probe escalation and,
+    # failing that too, the loss declaration.
+    heartbeat_max_misses: int = 2
+
+
+@dataclass(frozen=True)
 class PipelineConfig:
     """End-to-end run configuration (replaces /etc/duxbay.conf + env vars)."""
 
@@ -279,6 +317,7 @@ class PipelineConfig:
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     # Mesh shape: (data, model). data shards documents, model shards the
     # vocabulary axis of beta.  (1, 1) = single device.
     mesh_shape: tuple = (1, 1)

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..io import Batch
 from ..ops import estep
+from ..telemetry.spans import current_recorder
 
 
 # Which chunk impl the most recent run_chunk TRACE selected ("fast" |
@@ -620,15 +621,35 @@ def make_chunk_runner(
             gammas_in=gammas_in, have_prev=have_prev,
         )
 
-    runner = jax.jit(run_chunk_dispatch, compiler_options=compiler_options)
+    jitted = jax.jit(run_chunk_dispatch, compiler_options=compiler_options)
+
+    def runner(log_beta, alpha, ll_prev, groups, n_steps, *args, **kw):
+        """Host-side dispatch wrapper: when a telemetry Recorder is
+        active (telemetry/spans.py), each chunk dispatch records an
+        `em.run_chunk` span and counter.  JAX dispatch is asynchronous,
+        so the span measures ENQUEUE (trace/lower on first call, then
+        the per-dispatch glue the r05 sweep priced at ~65 ms under the
+        tunneled backend) — the quantity the chunked driver exists to
+        amortize — not device compute; the driver's host-sync span
+        covers the blocking side.  No recorder -> straight through."""
+        rec = current_recorder()
+        if rec is None:
+            return jitted(log_beta, alpha, ll_prev, groups, n_steps,
+                          *args, **kw)
+        with rec.span("em.run_chunk", chunk=chunk,
+                      n_steps=int(n_steps)
+                      if isinstance(n_steps, int) else None):
+            out = jitted(log_beta, alpha, ll_prev, groups, n_steps,
+                         *args, **kw)
+        rec.counter("em.chunk_dispatches").add(1)
+        return out
+
     # The EFFECTIVE dispatch settings ride on the runner so callers that
     # report them (bench.py's phase records) read what this runner was
     # actually built with — a monkeypatched maker (tools/tpu_probes.py
     # alpha_ab overrides alpha_max_iters inside its wrapper) would
     # otherwise desync the payload from the measurement.
-    try:
-        runner.alpha_max_iters = alpha_max_iters
-        runner.chunk = chunk
-    except AttributeError:  # a jit wrapper that rejects attributes
-        pass
+    runner.alpha_max_iters = alpha_max_iters
+    runner.chunk = chunk
+    runner.jitted = jitted  # AOT access (tools/config4_hbm_probe.lower)
     return runner

@@ -33,6 +33,7 @@ from jax.scipy.special import digamma, polygamma
 from ..config import LDAConfig
 from ..io import Batch, Corpus, formats, make_batches
 from ..ops import estep
+from ..telemetry.spans import maybe_span
 from . import fused
 
 
@@ -942,18 +943,27 @@ class LDATrainer:
             # the host sync at chunk boundaries.
             gammas_prev, have_prev = res.gammas, res.steps_done > 0
             log_beta, alpha, ll_prev_dev = res.log_beta, res.alpha, res.ll_prev
-            steps = int(res.steps_done)
-            host_conv = None
-            for ll in np.asarray(res.lls[:steps], np.float64):
-                it += 1
-                ll = float(ll)
-                host_conv = self._log_iteration(
-                    it, ll, ll_prev, likelihoods, ll_file, progress
+            # The host sync: int()/np.asarray block on the device here,
+            # then likelihood.dat lines stream, progress fires (the
+            # runner's journal em_ll points ride it), and checkpoints
+            # land — the flight-recorder span that, with fused.py's
+            # em.run_chunk dispatch span, decomposes an EM wall into
+            # enqueue glue vs blocking sync (telemetry/spans.py).
+            with maybe_span("em.host_sync", it=it) as sp:
+                steps = int(res.steps_done)
+                if sp is not None and hasattr(sp, "annotate"):
+                    sp.annotate(steps=steps)
+                host_conv = None
+                for ll in np.asarray(res.lls[:steps], np.float64):
+                    it += 1
+                    ll = float(ll)
+                    host_conv = self._log_iteration(
+                        it, ll, ll_prev, likelihoods, ll_file, progress
+                    )
+                    ll_prev = ll
+                self._maybe_checkpoint(
+                    checkpoint_path, log_beta, alpha, it, likelihoods
                 )
-                ll_prev = ll
-            self._maybe_checkpoint(
-                checkpoint_path, log_beta, alpha, it, likelihoods
-            )
             if steps == 0:
                 break
             # float64 conv (what likelihood.dat records) decides the stop;

@@ -28,6 +28,7 @@ metrics.json — the structured observability the reference lacked
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import pickle
@@ -42,6 +43,7 @@ from ..config import (
     OnlineLDAConfig,
     PipelineConfig,
     ScoringConfig,
+    TelemetryConfig,
 )
 from ..features import (
     load_top_domains,
@@ -97,6 +99,15 @@ class RunContext:
     # run_pipeline returns.
     wc_writer: object = None
     wc_writer_err: list = field(default_factory=list)
+    # Telemetry flight recorder (oni_ml_tpu/telemetry/): the crash-safe
+    # run journal (RunJournal; None on non-coordinator ranks and when
+    # disabled), the stages this run may skip because a replayed
+    # journal marked them complete, the span recorder, and the optional
+    # device heartbeat whose check() gates each stage entry.
+    journal: object = None
+    journal_done: set = field(default_factory=set)
+    recorder: object = None
+    heartbeat: object = None
 
     def path(self, name: str) -> str:
         return os.path.join(self.day_dir, name)
@@ -110,9 +121,20 @@ class RunContext:
         self.metrics.append(record)
 
 
-def _stage_done(ctx: RunContext, stage: Stage) -> bool:
+def _stage_done(ctx: RunContext, stage: Stage) -> "str | None":
+    """Why this stage can be skipped, or None if it must run.
+
+    The file contract is necessary either way (a journal that says
+    "done" about artifacts someone deleted must not win); the journal
+    upgrades the evidence — replayed `stage end` records from a prior
+    run of this day mean the resume is journal-driven, which the skip
+    record names so post-mortems can tell the two apart."""
     names = _STAGE_OUTPUTS[stage] or [ctx.results_name()]
-    return all(os.path.exists(ctx.path(n)) for n in names)
+    if not all(os.path.exists(ctx.path(n)) for n in names):
+        return None
+    if stage.value in ctx.journal_done:
+        return "journal: stage completed in a prior run"
+    return "outputs exist"
 
 
 def _coord_decision(value: bool) -> bool:
@@ -153,11 +175,33 @@ def _all_ranks_ok(ok: bool) -> bool:
 
 
 def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
+    from ..telemetry.spans import maybe_span  # jax-free fast import
+
+    if ctx.heartbeat is not None:
+        # Fail CLEANLY at the stage boundary once the backend is gone —
+        # entering the stage would hang in its first device call.
+        ctx.heartbeat.check()
+    if ctx.journal is not None:
+        ctx.journal.stage_begin(stage.value)
     t0 = time.perf_counter()
-    info = fn()
-    ctx.emit(
-        {"stage": stage.value, "wall_s": round(time.perf_counter() - t0, 3), **info}
-    )
+    try:
+        with maybe_span(f"stage.{stage.value}", fdate=ctx.fdate,
+                        dsource=ctx.dsource):
+            info = fn()
+    except BaseException as e:
+        if ctx.journal is not None:
+            ctx.journal.stage_end(
+                stage.value, ok=False,
+                wall_s=round(time.perf_counter() - t0, 3),
+                error=repr(e)[:300],
+            )
+        raise
+    wall_s = round(time.perf_counter() - t0, 3)
+    ctx.emit({"stage": stage.value, "wall_s": wall_s, **info})
+    if ctx.journal is not None:
+        # sync=True inside stage_end: the resume contract is durable
+        # the moment the stage's outputs are.
+        ctx.journal.stage_end(stage.value, ok=True, wall_s=wall_s, **info)
 
 
 # ---------------------------------------------------------------------------
@@ -366,6 +410,21 @@ def stage_corpus(ctx: RunContext) -> dict:
     }
 
 
+def _em_progress(ctx: RunContext):
+    """Progress callback streaming EM likelihood points into the run
+    journal — fired at the fused driver's host-sync cadence
+    (LDAConfig.host_sync_every), so a killed fit leaves its sub-run
+    likelihood trajectory on disk, not just likelihood.dat's possibly
+    unflushed tail."""
+    if ctx.journal is None:
+        return None
+
+    def progress(it: int, ll: float, conv: float) -> None:
+        ctx.journal.em_likelihood(it, ll, conv)
+
+    return progress
+
+
 def stage_lda(ctx: RunContext) -> dict:
     corpus = Corpus.from_model_dat(
         ctx.path("model.dat"), ctx.path("words.dat"), ctx.path("doc.dat")
@@ -379,8 +438,19 @@ def stage_lda(ctx: RunContext) -> dict:
             )
         if ctx.eval_holdout:
             raise ValueError("--eval-holdout is batch-mode only")
+        online_progress = None
+        if ctx.journal is not None:
+            def online_progress(info, _ctx=ctx):
+                # StreamStepInfo: step/likelihood map onto the same
+                # em_ll stream batch EM writes (conv has no online
+                # analogue; rho is the useful third column).
+                _ctx.journal.append({
+                    "kind": "em_ll", "iter": int(info.step),
+                    "ll": float(info.likelihood), "rho": float(info.rho),
+                })
         result = train_corpus_online(
-            corpus, ctx.config.online_lda, out_dir=ctx.day_dir, mesh=ctx.mesh
+            corpus, ctx.config.online_lda, out_dir=ctx.day_dir,
+            mesh=ctx.mesh, progress=online_progress,
         )
     elif ctx.eval_holdout:
         result, held_metrics = _train_with_holdout(ctx, corpus)
@@ -391,6 +461,7 @@ def stage_lda(ctx: RunContext) -> dict:
             out_dir=ctx.day_dir,
             mesh=ctx.mesh,
             vocab_sharded=ctx.vocab_sharded,
+            progress=_em_progress(ctx),
         )
     from ..models.lda import _is_coordinator
 
@@ -456,6 +527,7 @@ def _train_with_holdout(ctx: RunContext, corpus):
         out_dir=ctx.day_dir,
         mesh=ctx.mesh,
         vocab_sharded=ctx.vocab_sharded,
+        progress=_em_progress(ctx),
     )
 
     held_batches = make_batches(
@@ -615,6 +687,8 @@ def stage_score(ctx: RunContext) -> dict:
     }
     if stats is not None:
         out["score_dispatch"] = stats.as_record()
+        if ctx.journal is not None:
+            ctx.journal.dispatch_stats(stats.as_record(), stage="score")
     return out
 
 
@@ -709,8 +783,57 @@ def run_pipeline(
     multiproc = jax.process_count() > 1
     is_coord = jax.process_index() == 0
     wanted = stages or STAGE_ORDER
+
+    # Telemetry flight recorder (docs/observability.md).  Coordinator
+    # only: the shared day dir has exactly one journal writer, like
+    # metrics.json.  The existing journal is replayed FIRST (tolerating
+    # a killed run's truncated tail) so `--stages` resume can pick up
+    # from it; then this run appends behind a run_start marker.
+    tel = config.telemetry
+    hb = None
+    from ..telemetry.spans import use_recorder
+
+    if tel.journal and is_coord:
+        from ..telemetry import (
+            HeartbeatMonitor,
+            Journal,
+            Recorder,
+            RunJournal,
+        )
+
+        jpath = ctx.path("run_journal.jsonl")
+        replayed = Journal.replay(jpath)
+        prior_done = RunJournal.completed_stages(replayed)
+        ctx.journal = RunJournal(
+            Journal(jpath, fsync_every=tel.journal_fsync_every)
+        )
+        ctx.journal_done = set() if force else prior_done
+        ctx.journal.run_start(
+            force=force, fdate=fdate, dsource=dsource,
+            stages=[Stage(s).value for s in wanted],
+            replayed_records=len(replayed),
+            journal_done=sorted(prior_done),
+        )
+        ctx.recorder = Recorder(journal=ctx.journal.journal)
+        if tel.heartbeat_s > 0:
+            hb = HeartbeatMonitor(
+                interval_s=tel.heartbeat_s,
+                timeout_s=tel.heartbeat_timeout_s,
+                max_misses=tel.heartbeat_max_misses,
+                journal=ctx.journal,
+            ).start()
+            ctx.heartbeat = hb
+
+    run_ok = False
+    run_err: "BaseException | None" = None
     try:
-        _run_stages(ctx, wanted, force, multiproc, is_coord)
+        with (use_recorder(ctx.recorder) if ctx.recorder is not None
+              else contextlib.nullcontext()):
+            _run_stages(ctx, wanted, force, multiproc, is_coord)
+        run_ok = True
+    except BaseException as e:
+        run_err = e
+        raise
     finally:
         # The background word_counts.dat writer (stage_pre) must finish
         # before this process hands the day dir to anyone — it is the
@@ -721,6 +844,21 @@ def run_pipeline(
         if th is not None:
             th.join()
             ctx.wc_writer = None
+        if hb is not None:
+            hb.stop()
+        if ctx.journal is not None:
+            # A failed background word_counts.dat write fails the RUN
+            # (the RuntimeError below) — the journal's run_end must not
+            # record ok=True for an invocation whose caller saw an
+            # exception and whose pre-stage contract file is missing.
+            err = run_err if run_err is not None else (
+                ctx.wc_writer_err[0] if ctx.wc_writer_err else None
+            )
+            ctx.journal.run_end(
+                ok=run_ok and not ctx.wc_writer_err,
+                **({} if err is None else {"error": repr(err)[:300]}),
+            )
+            ctx.journal.close()
     if ctx.wc_writer_err:
         raise RuntimeError(
             "background word_counts.dat write failed"
@@ -756,16 +894,18 @@ def _run_stages(ctx: RunContext, wanted, force: bool, multiproc: bool,
                 ctx.features = None
             continue
         done = (
-            _stage_done(ctx, stage) if (is_coord or not multiproc) else False
+            _stage_done(ctx, stage) if (is_coord or not multiproc) else None
         )
-        skip = not force and done
+        skip = bool(done) and not force
         if multiproc:
             skip = _coord_decision(skip)
         if skip:
             if stage is Stage.CORPUS:
                 ctx.features = None  # see above
             if is_coord:
-                record = {"stage": stage.value, "skipped": "outputs exist"}
+                record = {"stage": stage.value, "skipped": done}
+                if ctx.journal is not None:
+                    ctx.journal.stage_skipped(stage.value, done)
                 if stage is Stage.LDA and ctx.eval_quality:
                     # The eval only needs the saved model; a resumed run
                     # still gets its day-quality number.
@@ -846,6 +986,10 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
             )
         ),
         scoring=ScoringConfig(threshold=args.tol),
+        telemetry=TelemetryConfig(
+            journal=not args.no_journal,
+            heartbeat_s=args.heartbeat,
+        ),
     )
 
 
@@ -976,6 +1120,20 @@ def build_parser() -> argparse.ArgumentParser:
         "collectives and read the shared stage outputs",
     )
     p.add_argument(
+        "--no-journal", action="store_true",
+        help="disable the crash-safe run journal "
+        "(run_journal.jsonl in the day dir: stage spans, EM likelihood "
+        "points, scoring dispatch stats — the resume/post-mortem "
+        "contract; docs/observability.md)",
+    )
+    p.add_argument(
+        "--heartbeat", type=float, default=0.0, metavar="SECS",
+        help="probe device liveness every SECS seconds on a background "
+        "thread (tiny jitted add + transfer, journaled); a backend that "
+        "stops answering becomes a clean BackendLost failure at the "
+        "next stage boundary instead of a silent hang (0 = off)",
+    )
+    p.add_argument(
         "--profile", default=None, metavar="DIR",
         help="capture a jax.profiler trace of the whole run into DIR "
         "(view with TensorBoard); replaces the reference's bash `time` "
@@ -1028,20 +1186,36 @@ def main(argv: list[str] | None = None) -> int:
         profile_ctx = jax.profiler.trace(
             args.profile, create_perfetto_trace=True
         )
-    with profile_ctx:
-        run_pipeline(
-            _build_config(args),
-            args.fdate,
-            args.dsource,
-            force=args.force,
-            stages=stages,
-            mesh=mesh,
-            vocab_sharded=vocab_sharded,
-            online=args.online,
-            publish=args.publish,
-            eval_quality=args.eval_quality,
-            eval_holdout=args.eval_holdout,
+    from ..telemetry import BackendLost
+
+    try:
+        with profile_ctx:
+            run_pipeline(
+                _build_config(args),
+                args.fdate,
+                args.dsource,
+                force=args.force,
+                stages=stages,
+                mesh=mesh,
+                vocab_sharded=vocab_sharded,
+                online=args.online,
+                publish=args.publish,
+                eval_quality=args.eval_quality,
+                eval_holdout=args.eval_holdout,
+            )
+    except BackendLost as e:
+        # The heartbeat's whole point: a dead backend exits as a
+        # structured, journaled failure, not a hang or a bare
+        # traceback.  The journal already carries the backend_lost
+        # record and every completed stage.
+        print(
+            json.dumps({
+                "fdate": args.fdate, "dsource": args.dsource,
+                "error": "backend_lost", "detail": str(e),
+            }),
+            flush=True,
         )
+        return 3
     return 0
 
 

@@ -69,6 +69,12 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    "step and hot-swap the refreshed model in (0=off)")
     p.add_argument("--metrics", default="", metavar="PATH",
                    help="also append per-batch metrics JSON lines here")
+    p.add_argument("--journal", default="", metavar="PATH",
+                   help="append every serving event to a crash-safe "
+                   "telemetry journal (telemetry/journal.py JSONL: "
+                   "atomic line writes, fsync cadence) — the serving "
+                   "analogue of the pipeline's run_journal.jsonl; "
+                   "tools/trace_view.py summarizes it")
     p.add_argument("--top-domains", default=None,
                    help="top-1m.csv whitelist for DNS featurization")
     p.add_argument("--dry-run", action="store_true",
@@ -140,7 +146,12 @@ def serve_stream(args) -> int:
             f"--dsource {args.dsource} but {args.day_dir} holds "
             f"{featurizer.dsource} features"
         )
-    metrics = MetricsEmitter(path=cfg.metrics_path)
+    journal = None
+    if getattr(args, "journal", ""):
+        from ..telemetry import Journal
+
+        journal = Journal(args.journal)
+    metrics = MetricsEmitter(path=cfg.metrics_path, journal=journal)
     metrics.emit({
         "stage": "serve", "event": "model_loaded",
         "source": snap.source, "model_version": snap.version,
@@ -219,7 +230,15 @@ def serve_stream(args) -> int:
         "batches": scorer.batches_flushed,
         "final_model_version": registry.version,
     })
+    # Shutdown aggregate from the shared registry: the counters and
+    # latency distributions the per-batch lines fed all along.
+    metrics.emit({
+        "stage": "serve", "event": "registry_snapshot",
+        **metrics.snapshot(),
+    })
     metrics.close()
+    if journal is not None:
+        journal.close()
     return 0 if scorer.events_scored == submitted else 1
 
 

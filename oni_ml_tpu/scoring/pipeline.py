@@ -232,17 +232,26 @@ def _pad_chunk(a: np.ndarray, lo: int, hi: int, chunk: int) -> np.ndarray:
     return out
 
 
-def _run_chunks(n: int, chunk: int, dispatch, collect):
+def _run_chunks(n: int, chunk: int, dispatch, collect,
+                label: str = "score.device.chunks"):
     """The double-buffered dispatch loop shared by every pipeline entry:
     chunk i+1 is enqueued (pad + H2D + compute, all asynchronous under
     JAX dispatch) BEFORE chunk i's results are synced, so host-side
-    collection overlaps device compute and the link never drains."""
+    collection overlaps device compute and the link never drains.
+
+    When a telemetry Recorder is active (telemetry/spans.py) the whole
+    loop records one `label` span (events/chunks in args) — the
+    device-scoring wall the flight recorder correlates against stage
+    spans; per-chunk accounting stays DispatchStats' job."""
+    from ..telemetry.spans import maybe_span
+
     nchunks = -(-n // chunk)
-    pending = [dispatch(0)]
-    for i in range(1, nchunks):
-        pending.append(dispatch(i))
+    with maybe_span(label, events=n, chunk=chunk, chunks=nchunks):
+        pending = [dispatch(0)]
+        for i in range(1, nchunks):
+            pending.append(dispatch(i))
+            collect(*pending.pop(0))
         collect(*pending.pop(0))
-    collect(*pending.pop(0))
     return nchunks
 
 
@@ -295,7 +304,7 @@ def chunked_scores(
         if stats is not None:
             stats.d2h_bytes += 4 * (hi - lo)
 
-    _run_chunks(n, chunk, dispatch, collect)
+    _run_chunks(n, chunk, dispatch, collect, label="score.device.full")
     if stats is not None:
         stats.survivors += n
     return out
@@ -385,7 +394,8 @@ def filtered_scores(
                 stats.d2h_bytes += 8 * cp
                 stats.survivors += c
 
-    _run_chunks(n, chunk, dispatch, collect)
+    _run_chunks(n, chunk, dispatch, collect,
+                label="score.device.filtered")
     if not parts:
         return empty
     return _merge_survivors(parts)
@@ -458,7 +468,8 @@ def filtered_flow_scores(
                 stats.d2h_bytes += 16 * cp
                 stats.survivors += c
 
-    _run_chunks(n, chunk, dispatch, collect)
+    _run_chunks(n, chunk, dispatch, collect,
+                label="score.device.filtered_flow")
     if not parts:
         return empty
     return _merge_survivors(parts)

@@ -1,10 +1,22 @@
-"""Per-batch serving metrics as JSON lines.
+"""Per-batch serving metrics as JSON lines, on the shared telemetry
+registry.
 
 Same convention as runner/ml_ops.py's stage metrics (one json.dumps'd
 dict per line to stdout, records retained for a file dump) so the
 observability surface is uniform across batch and serving: a consumer
 tailing metrics sees {"stage": "serve", ...} lines exactly where it
 already sees {"stage": "lda", ...} ones.
+
+Since the telemetry flight recorder landed (oni_ml_tpu/telemetry/),
+the emitter is a THIN SINK over the shared registry rather than its
+own accounting layer: every emit feeds the bound `Recorder`'s counters
+and histograms (serve.emits / serve.events / serve.flagged /
+serve.errors, latency/score-time distributions), and — when a journal
+is attached — appends a crash-safe {"kind": "serve", ...} line, so a
+killed serve process leaves its batch history on disk and
+tools/trace_view.py can summarize it next to stage spans.  The JSON
+line stream itself is unchanged; test_serving.py's record assertions
+pin that.
 """
 
 from __future__ import annotations
@@ -13,23 +25,50 @@ import json
 import threading
 from collections import deque
 
+from ..telemetry.spans import Recorder, current_recorder
+
+# Numeric record fields accumulated as counters (field -> counter name).
+_COUNT_FIELDS = (
+    ("events", "serve.events"),
+    ("flagged", "serve.flagged"),
+)
+# Numeric record fields observed as histograms (field -> histogram name).
+_HIST_FIELDS = (
+    ("latency_ms", "serve.latency_ms"),
+    ("score_ms", "serve.score_ms"),
+    ("queue_depth", "serve.queue_depth"),
+)
+
 
 class MetricsEmitter:
-    """Thread-safe JSON-lines emitter.  `path` appends each line to a
-    file as it is emitted (crash-safe: flushed per line, nothing held
-    for an exit-time dump); stdout printing can be disabled for
-    library/test embedding.  `records` keeps only the most recent
-    `keep_records` entries — a serve process flushing every 50 ms emits
-    ~1.7M records/day, so unbounded retention (the batch runner's
-    exit-time-dump convention) would be a slow OOM here; the durable
-    history is the file/stdout stream."""
+    """Thread-safe JSON-lines emitter over the shared telemetry
+    registry.  `path` appends each line to a file as it is emitted
+    (crash-safe: flushed per line, nothing held for an exit-time dump);
+    stdout printing can be disabled for library/test embedding.
+    `records` keeps only the most recent `keep_records` entries — a
+    serve process flushing every 50 ms emits ~1.7M records/day, so
+    unbounded retention (the batch runner's exit-time-dump convention)
+    would be a slow OOM here; the durable history is the file/stdout
+    stream (and the journal, when one is attached).
+
+    `recorder` is the telemetry Recorder fed by every emit; it defaults
+    to the recorder active at CONSTRUCTION time (contextvars do not
+    propagate into the scorer's worker thread, so binding happens here)
+    or a private one.  `journal` (telemetry.Journal or RunJournal)
+    additionally makes every record a crash-safe journal line."""
 
     def __init__(self, path: str = "", to_stdout: bool = True,
-                 keep_records: int = 4096) -> None:
+                 keep_records: int = 4096, recorder=None,
+                 journal=None) -> None:
         self._lock = threading.Lock()
         self._to_stdout = to_stdout
         self._file = open(path, "a") if path else None
         self.records: deque[dict] = deque(maxlen=keep_records)
+        self.recorder: Recorder = (
+            recorder or current_recorder() or Recorder()
+        )
+        # Accept either a raw Journal or a RunJournal wrapper.
+        self._journal = getattr(journal, "journal", journal)
 
     def emit(self, record: dict) -> None:
         line = json.dumps(record)
@@ -40,6 +79,29 @@ class MetricsEmitter:
             if self._file is not None:
                 self._file.write(line + "\n")
                 self._file.flush()
+        self._count(record)
+        if self._journal is not None:
+            self._journal.append({"kind": "serve", **record})
+
+    def _count(self, record: dict) -> None:
+        """Fold one record into the shared registry's aggregates."""
+        rec = self.recorder
+        rec.counter("serve.emits").add(1)
+        if "error" in record or "on_batch_error" in record:
+            rec.counter("serve.errors").add(1)
+        for field, name in _COUNT_FIELDS:
+            v = record.get(field)
+            if isinstance(v, (int, float)):
+                rec.counter(name).add(int(v))
+        for field, name in _HIST_FIELDS:
+            v = record.get(field)
+            if isinstance(v, (int, float)):
+                rec.histogram(name).observe(float(v))
+
+    def snapshot(self) -> dict:
+        """The shared registry's aggregate view (counters + histogram
+        summaries) — what `ml_ops serve` prints at shutdown."""
+        return self.recorder.snapshot()
 
     def close(self) -> None:
         with self._lock:

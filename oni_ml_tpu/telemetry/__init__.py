@@ -1,0 +1,34 @@
+"""Telemetry flight recorder: crash-safe run journal, nestable span
+tracing, and device heartbeat — the unified observability subsystem
+every stage records through (docs/observability.md).
+
+    journal.py    append-only JSONL run journal: atomic line writes,
+                  bounded-loss fsync cadence, truncated-tail-tolerant
+                  replay; RunJournal is the pipeline's record
+                  vocabulary and resume contract.
+    spans.py      span/counter/histogram registry on monotonic clocks
+                  with Chrome trace-event export (Perfetto-loadable);
+                  maybe_span() is the zero-cost library hook.
+    heartbeat.py  background device-liveness prober; dead backends
+                  become a clean BackendLost instead of a hang.
+"""
+
+from .heartbeat import BackendLost, HeartbeatMonitor
+from .journal import Journal, RunJournal
+from .spans import (
+    Recorder,
+    current_recorder,
+    maybe_span,
+    use_recorder,
+)
+
+__all__ = [
+    "BackendLost",
+    "HeartbeatMonitor",
+    "Journal",
+    "Recorder",
+    "RunJournal",
+    "current_recorder",
+    "maybe_span",
+    "use_recorder",
+]

@@ -1,0 +1,279 @@
+"""Nestable span / counter / histogram telemetry on monotonic clocks.
+
+Every module used to keep its own bespoke timing dict (`wall` in the
+runner's stage records, ad-hoc `time.perf_counter()` pairs in bench.py,
+`score_ms`/`latency_ms` fields assembled by hand in serving) — numbers
+that could not be correlated, nested, or exported.  This module is the
+one shared vocabulary:
+
+    rec = Recorder(journal=journal)
+    with use_recorder(rec):
+        with rec.span("stage.lda", fdate="20160122"):
+            ...
+            rec.counter("em.chunk_dispatches").add(1)
+            rec.histogram("em.host_sync_s").observe(0.012)
+
+Spans nest (per-thread depth tracking), time exclusively on the
+MONOTONIC clock (`time.monotonic_ns` — the wall clock can step
+backwards under NTP and is banned for interval timing by the telemetry
+lint in tests/test_telemetry.py), and export as Chrome trace-event JSON
+(`chrome_trace()`), loadable in Perfetto / chrome://tracing.  When the
+Recorder is bound to a journal (telemetry/journal.py), every completed
+span also appends a crash-safe `{"kind": "span", ...}` line, so a run
+killed mid-flight still leaves its timeline on disk —
+tools/trace_view.py rebuilds the trace from the journal alone.
+
+Instrumented library code must not pay when nobody is recording:
+`current_recorder()` is a contextvar that defaults to None, and
+`maybe_span(...)` collapses to a no-op context manager when no recorder
+is active, so hot paths (the scoring chunk loop, the fused-EM dispatch)
+carry spans at zero steady-state cost outside an instrumented run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+
+# Monotonic nanosecond clock — the ONLY clock spans use.  time.time()
+# is reserved for wall-clock *timestamps* (journal record `t` fields),
+# never durations.
+now_ns = time.monotonic_ns
+
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "oni_ml_tpu_recorder", default=None
+)
+
+
+def current_recorder():
+    """The Recorder active in this context, or None (the default:
+    nothing records, instrumented code short-circuits)."""
+    return _ACTIVE.get()
+
+
+@contextlib.contextmanager
+def use_recorder(recorder):
+    """Bind `recorder` as the context's active Recorder.  Contextvars
+    do not propagate into threads started inside the block; pass the
+    recorder explicitly to long-lived workers (serving's MetricsEmitter
+    binds it at construction for exactly this reason)."""
+    token = _ACTIVE.set(recorder)
+    try:
+        yield recorder
+    finally:
+        _ACTIVE.reset(token)
+
+
+def maybe_span(name: str, **args):
+    """A span on the active recorder, or a no-op when none is active —
+    what library call sites use so uninstrumented runs pay nothing."""
+    rec = _ACTIVE.get()
+    if rec is None:
+        return contextlib.nullcontext()
+    return rec.span(name, **args)
+
+
+class Counter:
+    """Monotonic event counter (thread-safe via the recorder lock)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str, lock) -> None:
+        self.name = name
+        self.value = 0
+        self._lock = lock
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max) plus power-of-two buckets
+    — enough to see a latency distribution without retaining samples."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "buckets", "_lock")
+
+    def __init__(self, name: str, lock) -> None:
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.buckets: dict[int, int] = {}
+        self._lock = lock
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            # Bucket by exponent: key e covers [2^e, 2^(e+1)).
+            e = 0 if v <= 0 else max(-64, min(64, math.frexp(v)[1] - 1))
+            self.buckets[e] = self.buckets.get(e, 0) + 1
+
+    def summary(self) -> dict:
+        with self._lock:
+            mean = self.total / self.count if self.count else None
+            return {
+                "count": self.count,
+                "sum": self.total,
+                "min": self.min,
+                "max": self.max,
+                "mean": mean,
+            }
+
+
+class _Span:
+    """One in-flight span; created by Recorder.span()."""
+
+    __slots__ = ("_rec", "name", "args", "start_ns", "depth", "tid")
+
+    def __init__(self, rec, name: str, args: dict) -> None:
+        self._rec = rec
+        self.name = name
+        self.args = args
+        self.start_ns = 0
+        self.depth = 0
+        self.tid = 0
+
+    def __enter__(self):
+        self.tid = threading.get_ident()
+        self.depth = self._rec._enter_depth()
+        self.start_ns = now_ns()
+        return self
+
+    def annotate(self, **kw) -> None:
+        """Attach more args mid-span (e.g. a result count discovered
+        after the work)."""
+        self.args.update(kw)
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = now_ns() - self.start_ns
+        self._rec._exit_depth()
+        if exc_type is not None:
+            self.args.setdefault("error", repr(exc)[:200])
+        self._rec._finish(self, dur)
+        return False
+
+
+class Recorder:
+    """The shared registry: spans + counters + histograms, one lock.
+
+    `max_events` bounds span retention (a serve process would otherwise
+    grow without bound — the durable history is the journal); counters
+    and histograms are aggregates and never grow with run length."""
+
+    def __init__(self, journal=None, max_events: int = 65536,
+                 journal_spans: bool = True) -> None:
+        self._lock = threading.RLock()
+        self.events: deque = deque(maxlen=max_events)
+        self.counters: dict[str, Counter] = {}
+        self.histograms: dict[str, Histogram] = {}
+        self._journal = journal
+        self._journal_spans = journal_spans and journal is not None
+        self._tls = threading.local()
+        self._t0_ns = now_ns()
+
+    # -- spans -----------------------------------------------------------
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args)
+
+    def _enter_depth(self) -> int:
+        d = getattr(self._tls, "depth", 0)
+        self._tls.depth = d + 1
+        return d
+
+    def _exit_depth(self) -> None:
+        self._tls.depth = max(0, getattr(self._tls, "depth", 1) - 1)
+
+    def _finish(self, span: _Span, dur_ns: int) -> None:
+        ev = {
+            "name": span.name,
+            "start_ns": span.start_ns,
+            "dur_ns": dur_ns,
+            "tid": span.tid,
+            "depth": span.depth,
+            "args": span.args,
+        }
+        with self._lock:
+            self.events.append(ev)
+        self.histogram(f"span.{span.name}_s").observe(dur_ns / 1e9)
+        if self._journal_spans:
+            self._journal.append({
+                "kind": "span",
+                "name": span.name,
+                "mono_ns": span.start_ns,
+                "dur_ns": dur_ns,
+                "tid": span.tid,
+                "depth": span.depth,
+                "args": span.args,
+            })
+
+    # -- counters / histograms ------------------------------------------
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self.counters.get(name)
+            if c is None:
+                c = self.counters[name] = Counter(name, self._lock)
+            return c
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram(name, self._lock)
+            return h
+
+    def snapshot(self) -> dict:
+        """JSON-safe aggregate view (counters + histogram summaries)."""
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self.counters.items()},
+                "histograms": {
+                    n: h.summary() for n, h in self.histograms.items()
+                },
+            }
+
+    # -- Chrome trace-event export --------------------------------------
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (the object form: {"traceEvents":
+        [...]}) — complete ("X") events in microseconds relative to the
+        recorder's epoch, loadable in Perfetto / chrome://tracing."""
+        with self._lock:
+            events = list(self.events)
+            counters = {n: c.value for n, c in self.counters.items()}
+        pid = os.getpid()
+        t0 = min((e["start_ns"] for e in events), default=self._t0_ns)
+        trace = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "oni_ml_tpu"},
+        }]
+        end_us = 0.0
+        for e in events:
+            ts = (e["start_ns"] - t0) / 1e3
+            dur = e["dur_ns"] / 1e3
+            end_us = max(end_us, ts + dur)
+            trace.append({
+                "name": e["name"], "ph": "X", "cat": "span",
+                "ts": ts, "dur": dur, "pid": pid, "tid": e["tid"],
+                "args": e["args"],
+            })
+        for name, value in counters.items():
+            trace.append({
+                "name": name, "ph": "C", "ts": end_us, "pid": pid,
+                "tid": 0, "args": {"value": value},
+            })
+        return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)

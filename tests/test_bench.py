@@ -579,3 +579,96 @@ def test_bench_em_reports_effective_alpha_max_iters():
     finally:
         fused.make_chunk_runner = orig
     assert em["alpha_max_iters"] == 100
+
+
+def test_bench_dead_backend_payload_carries_completed_phases(
+    capsys, monkeypatch
+):
+    """The r05 acceptance contract: an injected dead backend must emit
+    a payload containing every phase that completed (the host-only
+    scoring phases ran fresh) plus an explicit backend_lost annotation
+    — never a value=null total loss."""
+    import bench
+
+    _patch_phases(bench, monkeypatch)
+    # Gate fails: the backend never answers.
+    monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: False)
+    assert bench.main() == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert rec["backend_lost"] is True
+    # The completed-phase ledger rides the failure payload: the
+    # host-only phases ran (stubbed) and their numbers survive.
+    phases = rec["phases"]
+    assert phases["dns_scoring"]["value"] > 0
+    assert phases["flow_scoring"]["value"] > 0
+    # host_only_phases marks the same measurements as host-context.
+    assert rec["host_only_phases"]["dns_scoring"]["value"] > 0
+
+
+def test_bench_journal_records_phase_outcomes(capsys, monkeypatch, tmp_path):
+    """BENCH_JOURNAL=path: every phase outcome lands in a crash-safe
+    telemetry journal (run_start ... phase* ... run_end ok)."""
+    import bench
+    from oni_ml_tpu.telemetry import Journal
+
+    jpath = str(tmp_path / "bench.jsonl")
+    monkeypatch.setenv("BENCH_JOURNAL", jpath)
+    _patch_phases(bench, monkeypatch)
+    assert bench.main() == 0
+    capsys.readouterr()
+    records = Journal.replay(jpath)
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "run_start"
+    assert kinds[-1] == "run_end" and records[-1]["ok"]
+    phases = {r["name"]: r for r in records if r["kind"] == "phase"}
+    assert set(phases) == {n for n, _, _, _ in bench.PHASES}
+    assert all(p["ok"] for p in phases.values())
+    assert phases["headline"]["payload"]["value"] > 0
+
+
+def test_bench_journal_failure_path_marks_backend_lost(
+    capsys, monkeypatch, tmp_path
+):
+    import bench
+    from oni_ml_tpu.telemetry import Journal
+
+    jpath = str(tmp_path / "bench.jsonl")
+    monkeypatch.setenv("BENCH_JOURNAL", jpath)
+    monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: False)
+    monkeypatch.setattr(
+        bench, "_run_host_only_phases",
+        lambda inproc: {"dns_scoring": {"value": 1.0}},
+    )
+    assert bench.main() == 1
+    capsys.readouterr()
+    records = Journal.replay(jpath)
+    kinds = [r["kind"] for r in records]
+    assert "backend_lost" in kinds
+    ends = [r for r in records if r["kind"] == "run_end"]
+    assert len(ends) == 1 and not ends[0]["ok"]
+
+
+def test_bench_midrun_backend_death_annotates_record(capsys, monkeypatch):
+    """A grant that dies AFTER the headline keeps its real value but
+    the final record carries the backend_lost annotation naming the
+    wedged phase."""
+    import bench
+
+    _patch_phases(bench, monkeypatch)
+    monkeypatch.setattr(
+        bench, "bench_convergence",
+        lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("timeout after 300s (wedged device call?)")
+        ),
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    gate = iter([True])
+    monkeypatch.setattr(
+        bench, "_backend_responsive",
+        lambda *a, **k: next(gate, False),
+    )
+    assert bench.main() == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] > 0                      # headline survived
+    assert "lda_em_convergence" in rec["backend_lost"]

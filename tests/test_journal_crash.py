@@ -1,0 +1,276 @@
+"""Crash recovery through the telemetry flight recorder: SIGKILL a
+pipeline run mid-EM and prove the journal replays to a consistent
+state, tolerates the half-written tail, streams sub-run likelihood
+points, and drives `--stages` resume past the completed stages.
+
+This is the r05 loss mode end-to-end: a multi-hour fit dying mid-run
+used to take every observability record with it; now the day dir's
+run_journal.jsonl carries the completed stages, the EM likelihood
+trajectory up to the kill, and enough structure for the next run to
+pick up where the dead one stopped.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.telemetry import Journal, RunJournal
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write_flow_day(path: str, n: int = 60) -> None:
+    """Tiny 27-column flow day (test_features.flow_row's layout,
+    inlined so the kill subprocess needs no test imports)."""
+    rng = np.random.default_rng(7)
+    lines = ["dummy,header"]
+    for _ in range(n):
+        row = ["##"] * 27
+        row[4] = str(int(rng.integers(0, 24)))
+        row[5] = str(int(rng.integers(0, 60)))
+        row[6] = str(int(rng.integers(0, 60)))
+        row[8] = f"10.0.0.{rng.integers(1, 9)}"
+        row[9] = f"172.16.0.{rng.integers(1, 9)}"
+        row[10] = str(rng.choice([80, 443, 55000, 0]))
+        row[11] = str(rng.choice([80, 6000, 70000]))
+        row[16] = str(int(rng.integers(1, 100)))
+        row[17] = str(int(rng.integers(40, 10000)))
+        lines.append(",".join(row))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+_CHILD_SCRIPT = """
+import sys
+data_dir, raw = sys.argv[1], sys.argv[2]
+from oni_ml_tpu.config import (FeedbackConfig, LDAConfig, PipelineConfig,
+                               ScoringConfig)
+from oni_ml_tpu.runner import run_pipeline
+
+cfg = PipelineConfig(
+    data_dir=data_dir, flow_path=raw,
+    # em_tol=0 never converges and em_max_iters is effectively
+    # unbounded: the child runs EM until the parent kills it.
+    # host_sync_every=1 streams one journal em_ll point per iteration.
+    lda=LDAConfig(num_topics=4, em_max_iters=1000000, em_tol=0.0,
+                  batch_size=32, min_bucket_len=16, seed=3,
+                  fused_em_chunk=4, host_sync_every=1),
+    feedback=FeedbackConfig(dup_factor=5),
+    scoring=ScoringConfig(threshold=1.1),
+)
+run_pipeline(cfg, "20160122", "flow", force=True)
+"""
+
+
+@pytest.fixture()
+def killed_run(tmp_path):
+    """Launch the pipeline in a subprocess, wait for EM likelihood
+    points to appear in the journal (pre + corpus complete, LDA in
+    flight), then SIGKILL it mid-EM."""
+    raw = str(tmp_path / "flow.csv")
+    _write_flow_day(raw)
+    jpath = str(tmp_path / "20160122" / "run_journal.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("ONI_ML_TPU_TESTS_ON_TPU", None)
+    log = open(str(tmp_path / "child.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_SCRIPT, str(tmp_path), raw],
+        stdout=log, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(HERE), env=env,
+    )
+    try:
+        deadline = time.monotonic() + 240.0
+        n_ll = 0
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                log.close()
+                pytest.fail(
+                    "pipeline child exited before the kill (rc="
+                    f"{proc.returncode}):\n"
+                    + open(str(tmp_path / "child.log")).read()[-2000:]
+                )
+            if os.path.exists(jpath):
+                n_ll = sum(
+                    1 for r in Journal.replay(jpath)
+                    if r.get("kind") == "em_ll"
+                )
+                if n_ll >= 5:
+                    break
+            time.sleep(0.05)
+        else:
+            pytest.fail(
+                f"journal never reached 5 em_ll points (saw {n_ll}); "
+                "child log:\n"
+                + open(str(tmp_path / "child.log")).read()[-2000:]
+            )
+        os.kill(proc.pid, signal.SIGKILL)  # hard kill mid-EM
+        proc.wait(timeout=30.0)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30.0)
+        log.close()
+    return tmp_path, raw, jpath
+
+
+def test_sigkill_mid_em_journal_replays_and_resumes(killed_run):
+    tmp_path, raw, jpath = killed_run
+    from oni_ml_tpu.config import (
+        FeedbackConfig,
+        LDAConfig,
+        PipelineConfig,
+        ScoringConfig,
+    )
+    from oni_ml_tpu.runner import run_pipeline
+
+    # -- replay to a consistent state ----------------------------------
+    records, dropped = Journal.replay_report(jpath)
+    assert dropped == 0  # a truncated tail is tolerated, not "damage"
+    assert records, "journal empty after kill"
+    # The dead run's shape: run_start(force) then pre/corpus completed,
+    # LDA began but never ended, no run_end.
+    assert records[0]["kind"] == "run_start" and records[0]["force"]
+    done = RunJournal.completed_stages(records)
+    assert done == {"pre", "corpus"}
+    lda_recs = [r for r in records
+                if r.get("kind") == "stage" and r.get("stage") == "lda"]
+    assert [r["status"] for r in lda_recs] == ["begin"]  # mid-flight
+    assert not any(r.get("kind") == "run_end" for r in records)
+
+    # -- sub-run likelihood stream (host_sync_every=1 cadence) ---------
+    lls = [r for r in records if r.get("kind") == "em_ll"]
+    assert len(lls) >= 5
+    iters = [r["iter"] for r in lls]
+    assert iters == list(range(1, len(lls) + 1))
+    assert all(np.isfinite(r["ll"]) for r in lls)
+
+    # -- deterministic truncated-tail tolerance ------------------------
+    maimed = str(tmp_path / "maimed.jsonl")
+    with open(jpath, "rb") as f:
+        data = f.read()
+    with open(maimed, "wb") as f:
+        f.write(data + b'{"kind":"em_ll","iter":999,"ll":-1')
+    r2, d2 = Journal.replay_report(maimed)
+    assert len(r2) == len(records) and d2 == 0
+
+    # -- resume from the journal ---------------------------------------
+    cfg = PipelineConfig(
+        data_dir=str(tmp_path), flow_path=raw,
+        lda=LDAConfig(num_topics=4, em_max_iters=6, batch_size=32,
+                      min_bucket_len=16, seed=3),
+        feedback=FeedbackConfig(dup_factor=5),
+        scoring=ScoringConfig(threshold=1.1),
+    )
+    metrics = run_pipeline(cfg, "20160122", "flow")
+    by_stage = {m["stage"]: m for m in metrics}
+    # Completed stages skip WITHOUT re-running, attributed to the
+    # journal; the interrupted LDA (and score) run to completion.
+    assert "journal" in by_stage["pre"]["skipped"]
+    assert "journal" in by_stage["corpus"]["skipped"]
+    assert "skipped" not in by_stage["lda"]
+    assert by_stage["lda"]["em_iters"] >= 1
+    assert "skipped" not in by_stage["score"]
+    day = tmp_path / "20160122"
+    for name in ("final.beta", "doc_results.csv", "word_results.csv",
+                 "flow_results.csv"):
+        assert (day / name).exists(), name
+
+    # The resumed run appended behind the dead run's records: one
+    # journal, full history, run_end ok at the tail.
+    records3 = Journal.replay(jpath)
+    assert len(records3) > len(records)
+    starts = [r for r in records3 if r["kind"] == "run_start"]
+    assert len(starts) == 2 and not starts[1]["force"]
+    assert starts[1]["journal_done"] == ["corpus", "pre"]
+    ends = [r for r in records3 if r["kind"] == "run_end"]
+    assert len(ends) == 1 and ends[0]["ok"]
+    done3 = RunJournal.completed_stages(records3)
+    assert done3 == {"pre", "corpus", "lda", "score"}
+
+    # A third run now skips EVERYTHING off the journal.
+    metrics3 = run_pipeline(cfg, "20160122", "flow")
+    assert all("journal" in m.get("skipped", "") for m in metrics3)
+
+
+def test_journal_written_by_normal_run_and_traceable(tmp_path):
+    """A healthy run's journal: stage spans for every stage, em_ll
+    points at the host-sync cadence, run_end ok — and it converts to a
+    valid Chrome trace via tools/trace_view."""
+    from oni_ml_tpu.config import (
+        FeedbackConfig,
+        LDAConfig,
+        PipelineConfig,
+        ScoringConfig,
+    )
+    from oni_ml_tpu.runner import run_pipeline
+
+    raw = str(tmp_path / "flow.csv")
+    _write_flow_day(raw)
+    cfg = PipelineConfig(
+        data_dir=str(tmp_path), flow_path=raw,
+        lda=LDAConfig(num_topics=4, em_max_iters=6, em_tol=0.0,
+                      batch_size=32, min_bucket_len=16, seed=3,
+                      fused_em_chunk=4, host_sync_every=2),
+        feedback=FeedbackConfig(dup_factor=5),
+        scoring=ScoringConfig(threshold=1.1),
+    )
+    run_pipeline(cfg, "20160122", "flow")
+    jpath = str(tmp_path / "20160122" / "run_journal.jsonl")
+    records = Journal.replay(jpath)
+    done = RunJournal.completed_stages(records)
+    assert done == {"pre", "corpus", "lda", "score"}
+    # em_max_iters=6, em_tol=0: exactly 6 journaled points.
+    lls = [r for r in records if r["kind"] == "em_ll"]
+    assert [r["iter"] for r in lls] == [1, 2, 3, 4, 5, 6]
+    # spans recorded through the shared recorder (stage spans at least)
+    span_names = {r["name"] for r in records if r["kind"] == "span"}
+    assert any(n.startswith("stage.") for n in span_names)
+    # em.run_chunk dispatch spans from the fused driver's wrapper, and
+    # the driver's blocking host-sync spans: 6 iters / sync cadence 2
+    # = 3 dispatches.
+    assert "em.run_chunk" in span_names
+    assert "em.host_sync" in span_names
+    ends = [r for r in records if r["kind"] == "run_end"]
+    assert len(ends) == 1 and ends[0]["ok"]
+
+    sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+    import trace_view
+
+    trace = trace_view.journal_to_trace(records)
+    names = [e["name"] for e in trace["traceEvents"]]
+    for stage in ("stage.pre", "stage.corpus", "stage.lda", "stage.score"):
+        assert stage in names, stage
+    json.dumps(trace)
+    rows = trace_view.stage_summary(records)
+    assert {r["stage"] for r in rows} == {"pre", "corpus", "lda", "score"}
+
+
+def test_no_journal_flag_disables_recorder(tmp_path):
+    from oni_ml_tpu.config import (
+        FeedbackConfig,
+        LDAConfig,
+        PipelineConfig,
+        ScoringConfig,
+        TelemetryConfig,
+    )
+    from oni_ml_tpu.runner import run_pipeline
+
+    raw = str(tmp_path / "flow.csv")
+    _write_flow_day(raw)
+    cfg = PipelineConfig(
+        data_dir=str(tmp_path), flow_path=raw,
+        lda=LDAConfig(num_topics=4, em_max_iters=3, batch_size=32,
+                      min_bucket_len=16, seed=3),
+        feedback=FeedbackConfig(dup_factor=5),
+        scoring=ScoringConfig(threshold=1.1),
+        telemetry=TelemetryConfig(journal=False),
+    )
+    run_pipeline(cfg, "20160122", "flow")
+    assert not (tmp_path / "20160122" / "run_journal.jsonl").exists()

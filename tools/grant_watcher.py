@@ -119,11 +119,16 @@ def watch(*, interval_s: float = DEFAULT_INTERVAL_S,
           round_tag: "str | None" = None,
           once: bool = False,
           probe=probe_once, capture=run_capture, sleep=time.sleep,
-          log=None, base_dir: str = HERE) -> int:
+          log=None, base_dir: str = HERE, journal=None) -> int:
     """The watch loop.  probe/capture/sleep are injectable so the
     trigger logic is testable without a backend or real time.
     Returns 0 when a fully-green capture landed, 1 otherwise (budget
-    exhausted, or --once with no grant)."""
+    exhausted, or --once with no grant).
+
+    `journal` (oni_ml_tpu.telemetry.RunJournal) mirrors every probe
+    outcome and capture decision as crash-safe heartbeat/annotation
+    records — the same vocabulary the pipeline heartbeat writes, so
+    tools/trace_view.py shows grant liveness over a whole watch."""
     if log is None:
         def log(msg):
             print(f"grant_watcher[{time.strftime('%F %T')}]: {msg}",
@@ -139,6 +144,9 @@ def watch(*, interval_s: float = DEFAULT_INTERVAL_S,
     while True:
         n = probe(probe_timeout_s)
         probes += 1
+        if journal is not None:
+            journal.heartbeat(bool(n), probe=probes,
+                              devices=int(n) if n else 0)
         if n:
             captures += 1
             out = capture_out_path(round_tag, captures, base_dir)
@@ -147,6 +155,9 @@ def watch(*, interval_s: float = DEFAULT_INTERVAL_S,
             rc = capture(out)
             action, detail = next_action(rc, captures, max_captures)
             log(f"chip_session rc={rc} -> {action} ({detail})")
+            if journal is not None:
+                journal.annotation("watcher_capture", rc=rc, out=out,
+                                   action=action, detail=str(detail))
             if action == "stop":
                 return 0 if rc == 0 else 1
             factor = detail
@@ -173,12 +184,28 @@ def main() -> int:
                          "BENCH_r*.json)")
     ap.add_argument("--once", action="store_true",
                     help="single probe + decision, then exit")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="mirror probe/capture outcomes into a "
+                    "crash-safe telemetry journal "
+                    "(oni_ml_tpu/telemetry/journal.py JSONL; "
+                    "tools/trace_view.py summarizes it)")
     args = ap.parse_args()
-    return watch(interval_s=args.interval,
-                 probe_timeout_s=args.probe_timeout,
-                 max_captures=args.max_captures,
-                 round_tag=args.round_tag,
-                 once=args.once)
+    journal = None
+    if args.journal:
+        from oni_ml_tpu.telemetry import Journal, RunJournal
+
+        journal = RunJournal(Journal(args.journal))
+        journal.run_start(app="grant_watcher")
+    try:
+        return watch(interval_s=args.interval,
+                     probe_timeout_s=args.probe_timeout,
+                     max_captures=args.max_captures,
+                     round_tag=args.round_tag,
+                     once=args.once,
+                     journal=journal)
+    finally:
+        if journal is not None:
+            journal.close()
 
 
 if __name__ == "__main__":

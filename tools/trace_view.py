@@ -1,0 +1,220 @@
+"""Journal -> Chrome trace JSON + per-stage wall summary.
+
+The flight recorder's offline viewer: replay a telemetry journal
+(run_journal.jsonl from a pipeline day dir, a BENCH_JOURNAL file, or a
+serve --journal stream — replay tolerates the truncated tail a killed
+run leaves) and
+
+  1. convert its span / stage records into Chrome trace-event JSON
+     (the {"traceEvents": [...]} object form), loadable in Perfetto or
+     chrome://tracing — EM likelihood points ride along as counter
+     ("C") events and heartbeats as instant ("i") events, so the
+     likelihood trajectory and device liveness line up under the stage
+     spans;
+  2. print a per-stage wall summary (count, total seconds, share) so a
+     terminal gets the answer without a trace viewer.
+
+Usage:
+
+    python tools/trace_view.py DAY_DIR/run_journal.jsonl \
+        [--out trace.json] [--summary-only]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from oni_ml_tpu.telemetry.journal import Journal  # noqa: E402
+
+
+def journal_to_trace(records: "list[dict]") -> dict:
+    """Chrome trace-event JSON from replayed journal records.
+
+    Spans carry their own monotonic start (`mono_ns`) and `dur_ns`;
+    stage records arrive as begin/end pairs (matched by stage name,
+    last-begin-wins) and become "X" complete events; em_ll records
+    become a likelihood counter track; heartbeat / backend_lost become
+    instant events.  All timestamps are microseconds relative to the
+    earliest record so the trace starts at 0."""
+    pid = 1
+    mono = [r["mono_ns"] for r in records if "mono_ns" in r]
+    if not mono:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(mono)
+
+    def us(ns: int) -> float:
+        return (ns - t0) / 1e3
+
+    events = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "oni_ml_tpu journal"},
+    }]
+    open_stages: dict = {}
+    for rec in records:
+        kind = rec.get("kind")
+        ns = rec.get("mono_ns")
+        if ns is None:
+            continue
+        if kind == "span":
+            if str(rec.get("name", "")).startswith("stage."):
+                # The runner journals stages twice: a recorder span AND
+                # the begin/end pair (which carries the stage metrics
+                # and survives a kill as an unfinished marker).  The
+                # pair is authoritative; skip the span twin so stages
+                # don't render as duplicate slices.
+                continue
+            events.append({
+                "name": rec.get("name", "span"), "ph": "X",
+                "cat": "span", "ts": us(ns),
+                "dur": rec.get("dur_ns", 0) / 1e3,
+                "pid": pid, "tid": rec.get("tid", 0),
+                "args": rec.get("args", {}),
+            })
+        elif kind == "stage":
+            stage = rec.get("stage", "?")
+            status = rec.get("status")
+            if status == "begin":
+                open_stages[stage] = ns
+            elif status in ("end", "failed"):
+                begin = open_stages.pop(stage, None)
+                start = begin if begin is not None else ns
+                dur_ns = (ns - begin) if begin is not None else int(
+                    float(rec.get("wall_s", 0)) * 1e9
+                )
+                events.append({
+                    "name": f"stage.{stage}", "ph": "X", "cat": "stage",
+                    "ts": us(start), "dur": dur_ns / 1e3,
+                    "pid": pid, "tid": 0,
+                    "args": {
+                        k: v for k, v in rec.items()
+                        if k not in ("kind", "mono_ns", "seq", "t")
+                    },
+                })
+            elif status == "skipped":
+                events.append({
+                    "name": f"stage.{stage} (skipped)", "ph": "i",
+                    "s": "t", "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"reason": rec.get("reason")},
+                })
+        elif kind == "em_ll":
+            events.append({
+                "name": "em likelihood", "ph": "C", "ts": us(ns),
+                "pid": pid, "tid": 0,
+                "args": {"ll": rec.get("ll")},
+            })
+        elif kind == "heartbeat":
+            events.append({
+                "name": "heartbeat" + ("" if rec.get("ok") else " MISS"),
+                "ph": "i", "s": "g", "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {
+                    k: rec[k] for k in ("ok", "latency_s", "misses")
+                    if k in rec
+                },
+            })
+        elif kind == "backend_lost":
+            events.append({
+                "name": "BACKEND LOST", "ph": "i", "s": "g",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"reason": rec.get("reason")},
+            })
+    # A stage begun but never ended (the killed run's last stage): show
+    # it as an instant so the truncation point is visible in the trace.
+    for stage, ns in open_stages.items():
+        events.append({
+            "name": f"stage.{stage} (unfinished)", "ph": "i", "s": "t",
+            "ts": us(ns), "pid": pid, "tid": 0, "args": {},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def stage_summary(records: "list[dict]") -> "list[dict]":
+    """Per-stage wall rollup from stage end/failed records (wall_s) —
+    what the terminal summary prints."""
+    acc: dict = {}
+    for rec in records:
+        if rec.get("kind") != "stage":
+            continue
+        status = rec.get("status")
+        if status not in ("end", "failed", "skipped"):
+            continue
+        stage = rec.get("stage", "?")
+        row = acc.setdefault(
+            stage, {"stage": stage, "runs": 0, "skips": 0, "fails": 0,
+                    "wall_s": 0.0}
+        )
+        if status == "skipped":
+            row["skips"] += 1
+            continue
+        row["runs"] += 1
+        if status == "failed":
+            row["fails"] += 1
+        row["wall_s"] += float(rec.get("wall_s") or 0.0)
+    total = sum(r["wall_s"] for r in acc.values()) or 1.0
+    out = sorted(acc.values(), key=lambda r: -r["wall_s"])
+    for r in out:
+        r["wall_s"] = round(r["wall_s"], 3)
+        r["share_pct"] = round(100.0 * r["wall_s"] / total, 1)
+    return out
+
+
+def print_summary(records: "list[dict]", dropped: int,
+                  out=sys.stdout) -> None:
+    rows = stage_summary(records)
+    kinds: dict = {}
+    for r in records:
+        k = r.get("kind", "?")
+        kinds[k] = kinds.get(k, 0) + 1
+    print(f"journal: {len(records)} records "
+          f"({', '.join(f'{k}={n}' for k, n in sorted(kinds.items()))})"
+          + (f", {dropped} undecodable line(s) dropped" if dropped else ""),
+          file=out)
+    lls = [r for r in records if r.get("kind") == "em_ll"]
+    if lls:
+        print(f"em likelihood: {len(lls)} points, "
+              f"iter {lls[0].get('iter')} -> {lls[-1].get('iter')}, "
+              f"final ll {lls[-1].get('ll')}", file=out)
+    if not rows:
+        print("no stage records", file=out)
+        return
+    print(f"{'stage':<10} {'runs':>4} {'skips':>5} {'fails':>5} "
+          f"{'wall_s':>10} {'share':>6}", file=out)
+    for r in rows:
+        print(f"{r['stage']:<10} {r['runs']:>4} {r['skips']:>5} "
+              f"{r['fails']:>5} {r['wall_s']:>10.3f} "
+              f"{r['share_pct']:>5.1f}%", file=out)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Convert a telemetry journal to Chrome trace JSON "
+        "and print a per-stage wall summary."
+    )
+    ap.add_argument("journal", help="path to a run_journal.jsonl")
+    ap.add_argument("--out", default=None, metavar="TRACE_JSON",
+                    help="write Chrome trace-event JSON here "
+                    "(default: <journal>.trace.json; load in Perfetto "
+                    "or chrome://tracing)")
+    ap.add_argument("--summary-only", action="store_true",
+                    help="print the per-stage summary only, no trace "
+                    "file")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.journal):
+        print(f"trace_view: no such journal: {args.journal}",
+              file=sys.stderr)
+        return 2
+    records, dropped = Journal.replay_report(args.journal)
+    print_summary(records, dropped)
+    if not args.summary_only:
+        out_path = args.out or (args.journal + ".trace.json")
+        with open(out_path, "w") as f:
+            json.dump(journal_to_trace(records), f)
+        print(f"trace: {out_path} (load in Perfetto / chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
