@@ -28,12 +28,17 @@ class LDAConfig:
     num_topics: int = 20
     alpha_init: float = 2.5
     estimate_alpha: bool = True
-    # Cap on the per-M-step alpha-Newton while_loop (lda-c's
-    # MAX_ALPHA_ITER).  A scalar while_loop is the TPU's worst shape;
-    # warm-started mid-EM Newton converges in a handful of trips, so a
-    # small cap is a candidate throughput knob — measure with
-    # tools/tpu_probes.py alpha_ab before lowering.  Default = lda-c.
-    alpha_max_iters: int = 100
+    # Cap on the per-M-step alpha-Newton (lda-c's MAX_ALPHA_ITER).  A
+    # scalar while_loop is the TPU's worst shape; caps <= 16 take
+    # update_alpha's UNROLLED convergence-masked lowering (one fused
+    # scalar chain — the r05 alpha_ab probe charged ~0.5 ms/EM-iter to
+    # the dynamic-trip loop), and warm mid-EM Newton converges in a
+    # handful of trips so the same |df| exit fires either way.  Default
+    # aligned with the bench cap of 8 (ADVICE r5 close-out) now that
+    # cap-8-vs-cap-100 training equivalence is pinned in
+    # tests/test_lda.py; the lda-c drop-in CLI (runner/lda_cli.py) pins
+    # the reference's 100-trip while_loop for exact lda-c semantics.
+    alpha_max_iters: int = 8
     em_max_iters: int = 100
     em_tol: float = 1e-4
     var_max_iters: int = 20
@@ -84,6 +89,12 @@ class LDAConfig:
     # fused_em_chunk can never again silently collapse crash-safety and
     # progress to end-of-run.  Raise fused_em_chunk freely; lower
     # host_sync_every only with the glue price in mind.
+    #
+    # Both knobs resolve through the measured-plan cache
+    # (oni_ml_tpu/plans) when left at these defaults: a recorded sweep
+    # for this backend+shape — e.g. the checked-in v5e seed of the r05
+    # chunk sweep — wins over the default, and an explicitly-set config
+    # value wins over both (source recorded per run).
     fused_em_chunk: int = 128
     # Upper bound on EM iterations between HOST syncs in the fused
     # driver, independent of fused_em_chunk: each dispatch runs at most
@@ -215,7 +226,9 @@ class ScoringConfig:
     engine: str = ""
     # Events per device dispatch for engine="device"
     # (scoring/pipeline.py DEFAULT_CHUNK; sweep with
-    # tools/score_probe.py on a live grant).
+    # tools/score_probe.py on a live grant — the sweep records its
+    # winner into the plan cache, and runs leaving this at the default
+    # resolve through it: plans knob "score_device_chunk").
     device_chunk: int = 1 << 16
 
 
@@ -227,9 +240,12 @@ class ServingConfig:
     into a ModelRegistry and a BatchScorer serves arriving events
     continuously; none of these knobs affect the batch stages."""
 
-    # Flush an accumulating micro-batch when it reaches this many events...
+    # Flush an accumulating micro-batch when it reaches this many
+    # events...  (plan knob "serve_max_batch": left at the default,
+    # BatchScorer resolves it through the measured-plan cache)
     max_batch: int = 4096
-    # ...or when its oldest event has waited this long, whichever first.
+    # ...or when its oldest event has waited this long, whichever first
+    # (plan knob "serve_max_wait_ms").
     max_wait_ms: float = 50.0
     # Host-vs-device scorer dispatch.  0 (the default) prices the
     # decision from a MEASURED per-dispatch overhead calibration
@@ -293,6 +309,35 @@ class TelemetryConfig:
 
 
 @dataclass(frozen=True)
+class PlansConfig:
+    """Measured execution plans (oni_ml_tpu/plans/, docs/performance.md
+    "Measured execution plans"): the persistent autotune + plan cache
+    that replaces hand-tuned constants with per-(backend, shape)
+    measured values, plus the persistent jax compilation cache that
+    lets traced programs survive process death.
+
+    Precedence is fixed: an explicitly-set config knob always wins over
+    a plan entry, which wins over the shipped default — and every
+    consumer records which source it ran under (`source: "config" |
+    "plan" | "default"` in stage/serve records)."""
+
+    # Plan lookups/records on (--no-plans turns off; ONI_ML_TPU_PLANS=0
+    # is the process-wide kill switch).
+    enabled: bool = True
+    # Live plan-cache file ("" = ONI_ML_TPU_PLAN_CACHE env, else
+    # ~/.cache/oni_ml_tpu/plans.jsonl).  Checked-in seed plans
+    # (plans/seeds/) always load underneath.
+    cache_path: str = ""
+    # Persistent XLA compilation cache (jax_compilation_cache_dir):
+    # every compiled program serializes to disk, so a re-run re-traces
+    # nothing (--no-compilation-cache opts out).
+    compilation_cache: bool = True
+    # "" = JAX_COMPILATION_CACHE_DIR env, else
+    # ~/.cache/oni_ml_tpu/jax_cache.
+    compilation_cache_dir: str = ""
+
+
+@dataclass(frozen=True)
 class PipelineConfig:
     """End-to-end run configuration (replaces /etc/duxbay.conf + env vars)."""
 
@@ -318,6 +363,7 @@ class PipelineConfig:
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    plans: PlansConfig = field(default_factory=PlansConfig)
     # Mesh shape: (data, model). data shards documents, model shards the
     # vocabulary axis of beta.  (1, 1) = single device.
     mesh_shape: tuple = (1, 1)

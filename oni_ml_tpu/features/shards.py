@@ -17,15 +17,39 @@ import os
 from typing import Sequence
 
 
-def resolve_pre_workers(workers: int) -> int:
-    """Config semantics of ``pre_workers``: 0 = auto (one worker per
-    host core), 1 = the exact legacy sequential path, N = that many
-    shard workers."""
+def resolve_pre_workers(workers: int, with_source: bool = False):
+    """Config semantics of ``pre_workers``: 0 = auto, 1 = the exact
+    legacy sequential path, N = that many shard workers.
+
+    Auto consults the plan cache first (oni_ml_tpu/plans, host-scoped
+    knob ``pre_workers`` — tools/pre_probe.py records the measured best
+    for this host), falling back to one worker per host core.  Worker
+    count never changes output bytes (the deterministic first-seen
+    merge), so a plan entry here is a pure throughput decision.
+    ``with_source=True`` additionally returns "config" | "plan" |
+    "default" for the pre-stage record."""
     if workers < 0:
         raise ValueError(f"pre_workers must be >= 0, got {workers}")
-    if workers == 0:
-        return max(1, os.cpu_count() or 1)
-    return workers
+    auto = max(1, os.cpu_count() or 1)
+    if workers:
+        out = (workers, "config")
+    else:
+        planned = None
+        try:
+            from ..plans import lookup_value
+
+            planned = lookup_value("pre_workers")
+        except Exception:
+            planned = None
+        # A plan entry is operator-editable data: accept it only inside
+        # a sane band (a corrupt "1000000" must degrade to untuned, not
+        # plan a million shards / spawn a million threads).  4x cores
+        # covers every oversubscription a probe could legitimately win.
+        if planned and 1 <= int(planned) <= 4 * auto:
+            out = (int(planned), "plan")
+        else:
+            out = (auto, "default")
+    return out if with_source else out[0]
 
 
 def plan_file_shards(

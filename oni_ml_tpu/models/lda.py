@@ -128,6 +128,10 @@ class LDAResult:
     alpha: float
     likelihoods: list = field(default_factory=list)  # [(ll, conv)] per EM iter
     em_iters: int = 0
+    # Dispatch-knob resolution this fit ran under (plans.resolve):
+    # {knob: {"value", "source": "config"|"plan"|"default"}} — surfaced
+    # in the runner's lda stage record.
+    plan: dict = field(default_factory=dict)
 
     def save(
         self,
@@ -362,8 +366,9 @@ class LDATrainer:
             for ll_r, conv_r in likelihoods:
                 formats.append_likelihood(ll_file, ll_r, conv_r)
         ll_prev = likelihoods[-1][0] if likelihoods else None
+        self._em_chunk, self._em_sync = self._resolve_em_plan(batches)
         loop = (
-            self._fused_loop if cfg.fused_em_chunk > 1 else self._stepwise_loop
+            self._fused_loop if self._em_chunk > 1 else self._stepwise_loop
         )
         try:
             log_beta, alpha, it = loop(
@@ -386,7 +391,45 @@ class LDATrainer:
             alpha=float(alpha),
             likelihoods=likelihoods,
             em_iters=it,
+            plan=getattr(self, "plan_record", {}),
         )
+
+    def _resolve_em_plan(self, batches) -> tuple[int, int]:
+        """Resolve the fused driver's dispatch knobs through the plan
+        layer (oni_ml_tpu/plans): an explicitly-set config value always
+        wins, else a measured plan entry for this backend+shape, else
+        the shipped default.  The resolution rides `plan_record` (and
+        LDAResult.plan) so stage records can name the source each run
+        actually trained under."""
+        cfg = self.config
+        if cfg.host_sync_every < 0:
+            # min(chunk, negative) would request negative steps every
+            # dispatch — a silent zero-iteration "fit" writing out the
+            # random init as if trained.
+            raise ValueError(
+                f"host_sync_every must be >= 0, got {cfg.host_sync_every}"
+            )
+        from ..plans import em_shape, resolve
+
+        # Multi-host runs resolve from config/defaults only: every rank
+        # must build the SAME chunk program, and per-host plan caches
+        # (each host's ~/.cache) could legally hold different measured
+        # winners — a rank-divergent while_loop bound would desync the
+        # training collectives.
+        kw = {"store": None} if jax.process_count() > 1 else {}
+        sig = em_shape(cfg.num_topics, self.num_terms, batches)
+        chunk, chunk_src = resolve(
+            "fused_em_chunk", cfg.fused_em_chunk, shape=sig, **kw
+        )
+        sync, sync_src = resolve(
+            "host_sync_every", cfg.host_sync_every, shape=sig, **kw
+        )
+        chunk, sync = int(chunk), max(0, int(sync))
+        self.plan_record = {
+            "fused_em_chunk": {"value": chunk, "source": chunk_src},
+            "host_sync_every": {"value": sync, "source": sync_src},
+        }
+        return chunk, sync
 
     # -- EM drivers ---------------------------------------------------------
     #
@@ -879,7 +922,7 @@ class LDATrainer:
             num_docs=num_docs,
             num_topics=k,
             num_terms=self.num_terms,
-            chunk=cfg.fused_em_chunk,
+            chunk=self._em_chunk,
             var_max_iters=cfg.var_max_iters,
             var_tol=cfg.var_tol,
             em_tol=cfg.em_tol,
@@ -917,17 +960,12 @@ class LDATrainer:
         # often — with chunk=128 and checkpointing off a whole fit is
         # otherwise ONE dispatch and a crash loses every likelihood
         # line.  The chunk program takes its step count dynamically
-        # (like the checkpoint cap below), so no recompile.
-        if cfg.host_sync_every < 0:
-            # min(chunk, negative) would request negative steps every
-            # dispatch — a silent zero-iteration "fit" writing out the
-            # random init as if trained.
-            raise ValueError(
-                f"host_sync_every must be >= 0, got {cfg.host_sync_every}"
-            )
-        sync_chunk = cfg.fused_em_chunk
-        if cfg.host_sync_every:
-            sync_chunk = min(sync_chunk, cfg.host_sync_every)
+        # (like the checkpoint cap below), so no recompile.  Both knobs
+        # arrive plan-resolved (_resolve_em_plan; negative
+        # host_sync_every already rejected there).
+        sync_chunk = self._em_chunk
+        if self._em_sync:
+            sync_chunk = min(sync_chunk, self._em_sync)
         while it < cfg.em_max_iters:
             stop = min(it + sync_chunk, cfg.em_max_iters)
             if checkpoint_path and cfg.checkpoint_every:

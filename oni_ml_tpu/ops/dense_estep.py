@@ -156,10 +156,46 @@ def scoped_vmem_kib(b: int, v: int, k: int, wmajor: bool = False,
     return _vmem_limit(bb, padded_width(v), k, precision) // 1024
 
 
+def _planned_block(knob: str, b: int, v: int, k: int,
+                   precision: str) -> int | None:
+    """Measured doc-block override from the plan cache
+    (oni_ml_tpu/plans): a probe/bench-recorded block for this exact
+    (B, V, K, precision) on this backend.  The analytic VMEM-model pick
+    below stays the prior — a planned block is only a candidate, and
+    the callers re-validate it against the same feasibility rules, so a
+    stale or hand-edited cache entry can never produce an illegal
+    grid.  Multi-host runs skip the lookup entirely: the block pick
+    feeds rank-collective engine decisions, and per-host caches could
+    hold different winners."""
+    try:
+        if jax.process_count() > 1:
+            return None
+        from ..plans import lookup_value
+
+        val = lookup_value(knob, shape=f"b{b}.v{v}.k{k}.{precision}")
+        return int(val) if val else None
+    except Exception:
+        return None
+
+
 def pick_block(b: int, v: int, k: int, precision: str = "f32") -> int | None:
     """Largest power-of-two doc block (<= 256) dividing `b` whose
-    estimated working set fits the VMEM ceiling.  None = infeasible."""
+    estimated working set fits the VMEM ceiling — or the plan cache's
+    measured block for this shape when one exists and passes the same
+    feasibility checks.  None = infeasible."""
     w = padded_width(v)
+    planned = _planned_block("dense_estep_block", b, v, k, precision)
+    if (
+        planned
+        and planned <= b
+        and b % planned == 0
+        # BB is the sublane dimension of the [BB, V] block — the
+        # analytic space only ever emits multiples of 8, and a
+        # hand-edited entry must not hand Mosaic an unaligned tile.
+        and planned % 8 == 0
+        and _vmem_estimate(planned, w, k, precision) <= _VMEM_CEILING
+    ):
+        return planned
     bb = 8
     best = None
     while bb <= min(b, 256) and b % bb == 0:
@@ -177,6 +213,15 @@ def pick_block_w(b: int, v: int, k: int,
     128 — or equal to the full batch (single-block grid).  None =
     infeasible in this layout (callers fall back to row-major)."""
     w = padded_width(v)
+    planned = _planned_block("dense_estep_block_w", b, v, k, precision)
+    if (
+        planned
+        and planned <= b
+        and b % planned == 0
+        and (planned % 128 == 0 or planned == b)
+        and _vmem_estimate(planned, w, k, precision) <= _VMEM_CEILING
+    ):
+        return planned
     best = None
     bb = 128
     while bb <= min(b, 256) and b % bb == 0:

@@ -76,8 +76,13 @@ def config_from_settings(path: str, alpha: float, k: int) -> LDAConfig:
     # every EM iteration — warm start reaches the same optimum but
     # shifts mid-run likelihood.dat values in late decimals, and this
     # surface promises the reference's exact semantics.
+    # alpha_max_iters pinned to lda-c's MAX_ALPHA_ITER=100 (the
+    # production default moved to the unrolled cap of 8 — equivalent
+    # training, pinned in tests/test_lda.py — but THIS surface promises
+    # the reference's exact alpha-Newton loop).
     return LDAConfig(num_topics=k, alpha_init=alpha,
-                     warm_start_gamma=False, **read_settings(path))
+                     warm_start_gamma=False, alpha_max_iters=100,
+                     **read_settings(path))
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -42,6 +42,7 @@ from ..config import (
     LDAConfig,
     OnlineLDAConfig,
     PipelineConfig,
+    PlansConfig,
     ScoringConfig,
     TelemetryConfig,
 )
@@ -254,7 +255,9 @@ def stage_pre(ctx: RunContext) -> dict:
     fb = cfg.feedback
     from ..features.shards import resolve_pre_workers
 
-    workers = resolve_pre_workers(cfg.pre_workers)
+    workers, workers_src = resolve_pre_workers(
+        cfg.pre_workers, with_source=True
+    )
     timings: dict = {}
     if ctx.dsource == "flow":
         fb_rows = read_flow_feedback_rows(
@@ -379,6 +382,9 @@ def stage_pre(ctx: RunContext) -> dict:
         "word_count_rows": n_wc,
         "feedback_rows": len(fb_rows),
         "pre_workers": workers,
+        "plans": {
+            "pre_workers": {"value": workers, "source": workers_src}
+        },
         "wall": timings,
     }
     if merge_wall is not None:
@@ -480,6 +486,12 @@ def stage_lda(ctx: RunContext) -> dict:
         "final_likelihood": lls[-1] if lls else None,
         "alpha": result.alpha,
     }
+    # Dispatch-knob provenance (plans.resolve via the trainer): which
+    # source — config override, measured plan, or shipped default —
+    # each tuned constant came from this run.
+    plan_rec = getattr(result, "plan", None)
+    if plan_rec:
+        out["plans"] = plan_rec
     if ctx.eval_quality and _is_coordinator():
         out.update(_completion_score(ctx, result.log_beta, result.alpha,
                                      corpus))
@@ -670,12 +682,45 @@ def stage_score(ctx: RunContext) -> dict:
     # (scoring/pipeline.py), data-parallel over the run's mesh when one
     # is active — the same mesh the LDA stage trained on.  The default
     # host engine keeps the golden float64 CSV bytes.
+    from ..plans import resolve
     from ..scoring.score import _score_engine
 
-    stats = DispatchStats() if _score_engine(sc.engine) == "device" else None
+    device = _score_engine(sc.engine) == "device"
+    chunk = sc.device_chunk
+    plans_rec = None
+    if device:
+        # Resolve only on the engine that USES the knob: a host run's
+        # record must not attribute a device chunk it never dispatched.
+        chunk, chunk_src = resolve("score_device_chunk", sc.device_chunk)
+        chunk = int(chunk)
+        plans_rec = {
+            "score_device_chunk": {"value": chunk, "source": chunk_src}
+        }
+    stats = DispatchStats() if device else None
+    warm = None
+    if device and ctx.mesh is None:
+        # AOT-compile the plan's entry points before the chunk loop so
+        # the persistent compilation cache holds them (and the first
+        # dispatch doesn't stall on a trace); counters distinguish
+        # cache hits from fresh traces.  Warm at the EFFECTIVE chunk —
+        # the pipeline shrinks it for days smaller than the plan's
+        # chunk, and a warmup at the unshrunk shape would compile a
+        # program the day never dispatches.
+        from ..plans.warmup import warmup_scoring
+        from ..scoring.pipeline import _effective_chunk
+
+        try:
+            warm = warmup_scoring(
+                model.theta.shape[0], model.p.shape[0],
+                model.num_topics,
+                _effective_chunk(features.num_raw_events, chunk, None),
+                dsource=ctx.dsource,
+            )
+        except Exception as e:  # warmup must never fail the stage
+            warm = {"error": repr(e)[:200]}
     blob, scores = score_fn(
         features, model, sc.threshold,
-        engine=sc.engine, chunk=sc.device_chunk, mesh=ctx.mesh,
+        engine=sc.engine, chunk=chunk, mesh=ctx.mesh,
         stats=stats,
     )
     with open(ctx.path(ctx.results_name()), "wb") as f:
@@ -685,6 +730,10 @@ def stage_score(ctx: RunContext) -> dict:
         "flagged": int(len(scores)),
         "min_score": float(scores[0]) if len(scores) else None,
     }
+    if plans_rec is not None:
+        out["plans"] = plans_rec
+    if warm is not None:
+        out["warmup"] = warm
     if stats is not None:
         out["score_dispatch"] = stats.as_record()
         if ctx.journal is not None:
@@ -774,6 +823,29 @@ def run_pipeline(
     )
     import jax
 
+    # Measured-plans layer (oni_ml_tpu/plans): wire the persistent
+    # compilation cache BEFORE the first trace so every compiled
+    # program serializes to disk (a re-run deserializes instead of
+    # re-tracing — the counters below prove it per run), then pin the
+    # run's plan store so every consumer resolves tuned knobs against
+    # the same cache.
+    plc = config.plans
+    from ..plans import NullStore, PlanStore, counters_snapshot, use_store
+    from ..plans import warmup as _plans_warmup
+
+    cc_rec = _plans_warmup.setup_compilation_cache(
+        enabled=plc.compilation_cache,
+        cache_dir=plc.compilation_cache_dir,
+    )
+    if not plc.enabled:
+        plan_store: "PlanStore | NullStore | None" = NullStore()
+    elif plc.cache_path:
+        plan_store = PlanStore(plc.cache_path)
+    else:
+        plan_store = None        # the default store (seeds + user cache)
+    plans_cc0 = _plans_warmup.compile_counts()
+    plans_ctr0 = counters_snapshot()
+
     # Multi-host contract (--multihost): every rank runs run_pipeline
     # against a SHARED day dir.  Host-only stages (pre/corpus/score) and
     # all file writes execute on the coordinator alone; stage_lda runs
@@ -828,6 +900,8 @@ def run_pipeline(
     run_err: "BaseException | None" = None
     try:
         with (use_recorder(ctx.recorder) if ctx.recorder is not None
+              else contextlib.nullcontext()), \
+             (use_store(plan_store) if plan_store is not None
               else contextlib.nullcontext()):
             _run_stages(ctx, wanted, force, multiproc, is_coord)
         run_ok = True
@@ -859,10 +933,38 @@ def run_pipeline(
                 **({} if err is None else {"error": repr(err)[:300]}),
             )
             ctx.journal.close()
+        if plc.cache_path and plan_store is not None:
+            # Run-scoped store (--plan-cache): close its journal fd on
+            # every exit path; the process-wide default store stays
+            # open.
+            plan_store.close()
     if ctx.wc_writer_err:
         raise RuntimeError(
             "background word_counts.dat write failed"
         ) from ctx.wc_writer_err[0]
+    if is_coord:
+        # The run's plans/compile accounting: how many XLA compile
+        # requests the persistent cache served (a fully warmed re-run
+        # shows traces == 0) and how many autotune sweeps actually ran
+        # (a tuned backend shows 0) — the acceptance counters, in
+        # metrics.json where tests can assert them.
+        cc_end = dict(cc_rec)
+        if cc_rec.get("enabled"):
+            cc_end["entries_end"] = _plans_warmup.cache_entries(
+                cc_rec["dir"]
+            )
+        ctr = counters_snapshot()
+        ctx.emit({
+            "stage": "plans",
+            "enabled": plc.enabled,
+            "store": getattr(
+                plan_store, "path", None
+            ) if plan_store is not None else "default",
+            "compilation_cache": cc_end,
+            **_plans_warmup.counts_delta(plans_cc0),
+            **{k: ctr[k] - plans_ctr0.get(k, 0) for k in ctr},
+        })
+
     def _dump_metrics() -> None:
         with open(ctx.path("metrics.json"), "w") as f:
             json.dump(ctx.metrics, f, indent=1)
@@ -989,6 +1091,11 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
         telemetry=TelemetryConfig(
             journal=not args.no_journal,
             heartbeat_s=args.heartbeat,
+        ),
+        plans=PlansConfig(
+            enabled=not args.no_plans,
+            cache_path=args.plan_cache or "",
+            compilation_cache=not args.no_compilation_cache,
         ),
     )
 
@@ -1132,6 +1239,26 @@ def build_parser() -> argparse.ArgumentParser:
         "thread (tiny jitted add + transfer, journaled); a backend that "
         "stops answering becomes a clean BackendLost failure at the "
         "next stage boundary instead of a silent hang (0 = off)",
+    )
+    p.add_argument(
+        "--no-plans", action="store_true",
+        help="disable measured-plan lookups (oni_ml_tpu/plans): every "
+        "tuned knob falls back to config/default exactly as before the "
+        "plan cache existed; nothing is read from or written to the "
+        "cache",
+    )
+    p.add_argument(
+        "--plan-cache", default=None, metavar="PATH",
+        help="plan-cache JSONL file for this run (default: "
+        "ONI_ML_TPU_PLAN_CACHE env, else ~/.cache/oni_ml_tpu/"
+        "plans.jsonl; checked-in seed plans always load underneath)",
+    )
+    p.add_argument(
+        "--no-compilation-cache", action="store_true",
+        help="do not wire jax_compilation_cache_dir (by default every "
+        "compiled program persists to ~/.cache/oni_ml_tpu/jax_cache — "
+        "or JAX_COMPILATION_CACHE_DIR — so a re-run re-traces nothing; "
+        "the run's metrics record compile requests vs cache hits)",
     )
     p.add_argument(
         "--profile", default=None, metavar="DIR",

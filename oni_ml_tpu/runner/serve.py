@@ -77,6 +77,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    "tools/trace_view.py summarizes it")
     p.add_argument("--top-domains", default=None,
                    help="top-1m.csv whitelist for DNS featurization")
+    p.add_argument("--no-plans", action="store_true",
+                   help="disable measured-plan lookups "
+                   "(oni_ml_tpu/plans): max_batch/max_wait_ms and the "
+                   "dispatch calibration fall back to config/defaults")
+    p.add_argument("--no-compilation-cache", action="store_true",
+                   help="do not wire jax_compilation_cache_dir (by "
+                   "default compiled scoring programs persist across "
+                   "restarts, and startup AOT-warms the device scorer "
+                   "at the plan's shapes before the first event)")
     p.add_argument("--dry-run", action="store_true",
                    help="exercise the full serving stack on a synthetic "
                    "in-memory day (no --day-dir needed) and exit")
@@ -132,9 +141,17 @@ def _looks_like_header(line: str, dsource: str) -> bool:
 
 def serve_stream(args) -> int:
     from ..config import ScoringConfig as SC
+    from ..plans import warmup as plans_warmup
 
     if not args.day_dir:
         raise SystemExit("serve needs --day-dir (or --dry-run)")
+    # Persistent compilation cache BEFORE the first trace: a restarted
+    # service deserializes yesterday's compiled scorers instead of
+    # re-tracing them while events queue.  (--no-plans scoping is
+    # main()'s job — it binds both this path and --dry-run.)
+    cc_rec = plans_warmup.setup_compilation_cache(
+        enabled=not args.no_compilation_cache
+    )
     cfg = _serving_config(args)
     sc = SC()
     fallback = sc.flow_fallback if args.dsource == "flow" else sc.dns_fallback
@@ -191,6 +208,26 @@ def serve_stream(args) -> int:
     scorer = BatchScorer(
         registry, featurizer, cfg, metrics=metrics, on_batch=on_batch
     )
+    # AOT warmup at the PLAN's shapes: the padded micro-batch device
+    # programs (break-even .. max_batch, powers of two) compile NOW —
+    # into the persistent cache — instead of stalling the first
+    # over-break-even flush mid-stream.  The emitted record names every
+    # resolved knob's source and the cache-hit vs trace counts, so a
+    # restarted service can be ASSERTED warm, not assumed.
+    try:
+        warm = plans_warmup.warmup_serving(
+            snap.model.theta.shape[0], snap.model.p.shape[0],
+            snap.model.num_topics, scorer.max_batch,
+            cfg.device_score_min,
+        )
+    except Exception as e:  # warmup must never block serving
+        warm = {"error": repr(e)[:200]}
+    metrics.emit({
+        "stage": "serve", "event": "plans",
+        "knobs": scorer.plan,
+        "compilation_cache": cc_rec,
+        "warmup": warm,
+    })
     stream = sys.stdin if args.input == "-" else open(args.input)
     submitted = rejected = header_skipped = 0
     header = None
@@ -351,9 +388,22 @@ def dry_run(args) -> int:
 
 def main(argv: "list[str] | None" = None) -> int:
     args = build_serve_parser().parse_args(argv)
-    if args.dry_run:
-        return dry_run(args)
-    return serve_stream(args)
+    # --no-plans binds BOTH entry paths here, once: a BatchScorer
+    # (serve or dry run) would otherwise resolve flush triggers from —
+    # and record its dispatch calibration into — the live user cache;
+    # a smoke run must not tune production.
+    import contextlib
+
+    from ..plans import NullStore, use_store
+
+    ctx = (
+        use_store(NullStore()) if args.no_plans
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        if args.dry_run:
+            return dry_run(args)
+        return serve_stream(args)
 
 
 if __name__ == "__main__":

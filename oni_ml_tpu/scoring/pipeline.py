@@ -59,12 +59,17 @@ from dataclasses import dataclass
 
 import numpy as np
 
-# Events per device dispatch.  65536 int32 indices = 256 KiB H2D per
-# array per chunk — big enough to amortize the ~65 ms r05 dispatch glue
-# thousands of events deep, small enough that two in-flight chunks are
-# noise next to the model in HBM.  tools/score_probe.py sweeps this on
-# a live grant.
-DEFAULT_CHUNK = 1 << 16
+from ..config import ScoringConfig
+
+# Events per device dispatch.  The shipped value (ScoringConfig.
+# device_chunk — config.py is the tuned-constant home; 65536 int32
+# indices = 256 KiB H2D per array per chunk, big enough to amortize the
+# ~65 ms r05 dispatch glue thousands of events deep, small enough that
+# two in-flight chunks are noise next to the model in HBM) is the
+# DEFAULT; runs resolve the effective chunk through the plan cache
+# (plans knob "score_device_chunk" — tools/score_probe.py sweeps and
+# records it on a live grant).
+DEFAULT_CHUNK = ScoringConfig.device_chunk
 
 
 @dataclass

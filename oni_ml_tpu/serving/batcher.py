@@ -110,18 +110,40 @@ class BatchScorer:
         self.registry = registry
         self.featurizer = featurizer
         self.config = config or ServingConfig()
-        if self.config.max_batch < 1 or self.config.queue_max < 1:
+        # Flush triggers resolve through the plan layer
+        # (oni_ml_tpu/plans): an explicitly-set config value always
+        # wins, else a measured plan entry for this backend, else the
+        # shipped default.  `self.plan` names the source per knob for
+        # the serve records.
+        from ..plans import resolve
+
+        mb, mb_src = resolve("serve_max_batch", self.config.max_batch)
+        mw, mw_src = resolve("serve_max_wait_ms", self.config.max_wait_ms)
+        if mb_src == "plan" and int(mb) > self.config.queue_max:
+            # A plan flush size above the backpressure bound would make
+            # the max_batch trigger unreachable (submit() blocks at
+            # queue_max first) — every flush silently degrades to the
+            # latency timer.  An operator-editable entry must not do
+            # that; fall back to the shipped default.
+            mb, mb_src = self.config.max_batch, "default"
+        self.max_batch = int(mb)
+        self.max_wait_ms = float(mw)
+        self.plan = {
+            "max_batch": {"value": self.max_batch, "source": mb_src},
+            "max_wait_ms": {"value": self.max_wait_ms, "source": mw_src},
+        }
+        if self.max_batch < 1 or self.config.queue_max < 1:
             # max_batch=0 would make the first flush return an empty
             # batch — which the worker loop reads as shutdown — and
             # queue_max=0 deadlocks the first submit; fail construction
             # instead of hanging every future.
             raise ValueError(
-                f"max_batch ({self.config.max_batch}) and queue_max "
+                f"max_batch ({self.max_batch}) and queue_max "
                 f"({self.config.queue_max}) must both be >= 1"
             )
-        if self.config.max_wait_ms <= 0:
+        if self.max_wait_ms <= 0:
             raise ValueError(
-                f"max_wait_ms must be > 0, got {self.config.max_wait_ms}"
+                f"max_wait_ms must be > 0, got {self.max_wait_ms}"
             )
         self.metrics = metrics
         self.on_batch = on_batch
@@ -141,8 +163,18 @@ class BatchScorer:
         self._force_flush = False
         self._batch_seq = 0
         self._events_scored = 0
+        # The worker runs inside a COPY of the constructing thread's
+        # context: contextvar scopes (the plan store pinned by
+        # plans.use_store — a --no-plans NullStore must bind the worker
+        # too — and telemetry's current_recorder) do not cross thread
+        # starts on their own, and a worker that fell back to the
+        # process defaults would silently bypass the caller's opt-outs.
+        import contextvars
+
+        ctx = contextvars.copy_context()
         self._worker = threading.Thread(
-            target=self._run, name="oni-batch-scorer", daemon=True
+            target=lambda: ctx.run(self._run),
+            name="oni-batch-scorer", daemon=True,
         )
         self._worker.start()
 
@@ -215,8 +247,7 @@ class BatchScorer:
     def _take_batch(self) -> tuple[list[_Pending], str, int]:
         """Block until a flush trigger fires; returns (batch, trigger,
         queue_depth_after).  Empty batch means shutdown."""
-        cfg = self.config
-        max_wait_s = cfg.max_wait_ms / 1e3
+        max_wait_s = self.max_wait_ms / 1e3
         with self._cond:
             while not self._pending and not self._closed:
                 self._cond.wait()
@@ -227,7 +258,7 @@ class BatchScorer:
                 if self._force_flush:
                     trigger = "flush"
                     break
-                if len(self._pending) >= cfg.max_batch:
+                if len(self._pending) >= self.max_batch:
                     trigger = "max_batch"
                     break
                 waited = time.perf_counter() - self._pending[0].t_enqueue
@@ -240,7 +271,7 @@ class BatchScorer:
             self._force_flush = False
             batch = [
                 self._pending.popleft()
-                for _ in range(min(len(self._pending), cfg.max_batch))
+                for _ in range(min(len(self._pending), self.max_batch))
             ]
             self._cond.notify_all()  # release submitters blocked on queue_max
             return batch, trigger, len(self._pending)

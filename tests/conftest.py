@@ -6,6 +6,22 @@ backend with 8 virtual devices, mirroring how the driver's
 """
 
 import os
+import tempfile
+
+# Hermetic measured-plan + compilation caches (oni_ml_tpu/plans): the
+# suite must neither read a developer's ~/.cache plan/compile state nor
+# write test measurements into it — a CPU-measured calibration leaking
+# into the user cache would "tune" real runs from test synthetics.
+# Guarded (not setdefault) so an operator pinning either path doesn't
+# still pay an eagerly-created throwaway tempdir.
+if "ONI_ML_TPU_PLAN_CACHE" not in os.environ:
+    os.environ["ONI_ML_TPU_PLAN_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="oni_plans_test_"), "plans.jsonl"
+    )
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+        prefix="oni_jaxcache_test_"
+    )
 
 # Hard override: the session environment pins JAX_PLATFORMS to the real
 # TPU tunnel and a sitecustomize module imports jax at interpreter start,

@@ -296,6 +296,10 @@ def test_batched_scores_auto_routes_through_calibration(monkeypatch):
 def test_dispatch_calibration_measures_and_caches(monkeypatch):
     monkeypatch.setattr(score_mod, "_CALIBRATION", None)
     monkeypatch.delenv("ONI_ML_TPU_SCORE_BREAK_EVEN", raising=False)
+    # Isolate from the plan cache: an earlier test's measured
+    # calibration persists there (by design), which would make this
+    # process-level measurement test read source "plan".
+    monkeypatch.setenv("ONI_ML_TPU_PLANS", "0")
     cal = dispatch_calibration()
     assert cal["source"] == "measured"
     assert cal["dispatch_s"] > 0 and cal["host_event_s"] > 0

@@ -466,3 +466,61 @@ def test_no_bare_time_time_for_span_timing():
         "time.monotonic_ns / perf_counter for timing, or add a "
         "justified allowlist entry):\n" + "\n".join(offenders)
     )
+
+
+# ---------------------------------------------------------------------------
+# tuned-constant lint (measured execution plans, oni_ml_tpu/plans)
+# ---------------------------------------------------------------------------
+
+# Knob names whose NUMERIC defaults may live only in config.py (the
+# tuned-constant home) and under oni_ml_tpu/plans/ (the registry/seeds).
+# Everywhere else the value must flow through config or a plan lookup —
+# a literal re-hardcoded at a consumer is exactly the drift the plan
+# cache exists to end (the r05 device-chunk / break-even constants were
+# smeared this way before round 6).
+_TUNED_CONSTANT_NAMES = (
+    "fused_em_chunk",
+    "host_sync_every",
+    "device_chunk",
+    "DEFAULT_CHUNK",
+    "device_score_min",
+    "max_batch",
+    "max_wait_ms",
+    "pre_workers",
+    "break_even",
+)
+
+_TUNED_LITERAL_ALLOWED_PREFIXES = ("plans/",)
+_TUNED_LITERAL_ALLOWED_FILES = {"config.py"}
+
+
+def test_no_hardcoded_tuned_constants_outside_plans():
+    """Grep-lint: no module under oni_ml_tpu/ outside plans/ and
+    config.py assigns a tuned-constant name a numeric literal
+    (`name = <digit...>` / `name: type = <digit...>`).  Consumers must
+    read these through config or resolve them through the plan cache."""
+    import re
+
+    pat = re.compile(
+        r"\b(" + "|".join(_TUNED_CONSTANT_NAMES) + r")\s*(?::[^=\n]+)?=\s*[0-9]"
+    )
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "oni_ml_tpu",
+    )
+    offenders = []
+    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
+        rel = os.path.relpath(path, pkg)
+        if rel in _TUNED_LITERAL_ALLOWED_FILES or any(
+            rel.startswith(p) for p in _TUNED_LITERAL_ALLOWED_PREFIXES
+        ):
+            continue
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if pat.search(line.split("#")[0]):
+                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "tuned-constant literal outside config.py / oni_ml_tpu/plans/ "
+        "(route the value through config or a plans.resolve lookup):\n"
+        + "\n".join(offenders)
+    )

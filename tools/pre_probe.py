@@ -46,10 +46,25 @@ def sweep(n_events: int, workers, reps: int = 2) -> None:
     import tempfile
 
     from oni_ml_tpu.features import native_dns, native_flow
-    from oni_ml_tpu.features.shards import resolve_pre_workers
 
     native = native_flow.available()
     tmp = tempfile.mkdtemp(prefix="oni_pre_probe_")
+    # Deduplicate by RESOLVED count before measuring: w=0 resolves to
+    # the host core count — the same value as an explicit leg on most
+    # sweep lists — and (being a sweep, not a consumer) it must resolve
+    # from cpu_count, NOT from the plan cache: a plan-resolved auto leg
+    # would re-measure the previous winner under a second key, double
+    # its aggregate, and make the first recorded winner
+    # self-reinforcing.
+    auto = max(1, os.cpu_count() or 1)
+    legs = []
+    for w in workers:
+        resolved = w if w else auto
+        if all(r != resolved for _, r in legs):
+            legs.append((w, resolved))
+    # events/sec per resolved worker count, summed across the flow and
+    # dns days — the aggregate that picks this host's plan entry.
+    aggregate: dict = {}
     try:
         flow_path, dns_path = _day_files(tmp, n_events)
         days = [
@@ -61,16 +76,22 @@ def sweep(n_events: int, workers, reps: int = 2) -> None:
                  [dns_path], workers=w, timings=t)),
         ]
         for dsource, n, fn in days:
-            for w in workers:
-                resolved = resolve_pre_workers(w)
+            for w, resolved in legs:
                 best, best_t = float("inf"), {}
                 for _ in range(reps):
                     timings: dict = {}
                     t0 = time.perf_counter()
-                    feats = fn(w, timings)
+                    # Always pass the EXPLICIT count: w=0 would
+                    # plan-resolve inside the featurizer — the sweep
+                    # must measure the labeled count, not consume the
+                    # cache it exists to fill.
+                    feats = fn(resolved, timings)
                     dt = time.perf_counter() - t0
                     if dt < best:
                         best, best_t = dt, timings
+                aggregate[resolved] = aggregate.get(resolved, 0) + round(
+                    n / best
+                )
                 print(json.dumps({
                     "probe": "pre_worker_sweep", "dsource": dsource,
                     "native": native, "workers": w,
@@ -90,6 +111,29 @@ def sweep(n_events: int, workers, reps: int = 2) -> None:
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
+
+    # Seed the plan cache with this host's measured best (oni_ml_tpu/
+    # plans, host-scoped knob "pre_workers"): the next run's
+    # pre_workers=0 auto resolves to the measured winner instead of
+    # cpu_count.  Parity across worker counts is pinned by
+    # tests/test_pre_parallel.py, so this is throughput-only.
+    from oni_ml_tpu import plans
+
+    best_workers = max(aggregate, key=aggregate.get)
+    plans.note_sweep("pre_workers")
+    recorded = plans.record_value(
+        "pre_workers", int(best_workers), source="probe",
+        measurements=aggregate, unit="events/sec (flow+dns sum)",
+        n_events=n_events, native=native,
+    )
+    print(json.dumps({
+        "probe": "plan_cache_update",
+        "recorded": recorded,        # False: plans disabled/unwritable
+        "store": plans.default_path(),
+        "host": plans.host_fingerprint(),
+        "pre_workers": int(best_workers),
+        "aggregate_events_per_sec": aggregate,
+    }), flush=True)
 
 
 def main() -> int:

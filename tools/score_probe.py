@@ -63,6 +63,7 @@ def sweep(n_events: int, chunks, reps: int = 3) -> None:
     )
     threshold = float(np.median(mn))
 
+    measurements = {}
     for chunk in chunks:
         # Warm the compiled program for this chunk outside the timing.
         filtered_flow_scores(model, sa, sw, da, dw, threshold, chunk=chunk)
@@ -77,6 +78,7 @@ def sweep(n_events: int, chunks, reps: int = 3) -> None:
             if dt < best:
                 best, stats = dt, st
         assert len(out[0])
+        measurements[chunk] = round(n_events / best)
         print(json.dumps({
             "probe": "score_chunk_sweep", "backend": backend,
             "chunk": chunk, "n_events": n_events,
@@ -88,9 +90,36 @@ def sweep(n_events: int, chunks, reps: int = 3) -> None:
             "survivors": stats.survivors,
         }), flush=True)
 
+    # The sweep's winner seeds the plan cache directly (oni_ml_tpu/
+    # plans knob "score_device_chunk", keyed by this backend's
+    # fingerprint): the next pipeline/serving run on this backend loads
+    # the measured chunk instead of the shipped default, and
+    # `tools/plan_cache.py export` turns the session into a committable
+    # seed file.  Only TPU measurements should retune production — but
+    # the cache is backend-keyed, so a CPU record can never leak onto a
+    # chip.
+    from oni_ml_tpu import plans
+
+    best_chunk = max(measurements, key=measurements.get)
+    plans.note_sweep("score_device_chunk")
+    recorded = plans.record_value(
+        "score_device_chunk", int(best_chunk), source="probe",
+        measurements=measurements, unit="events/sec",
+        n_events=n_events,
+    )
+    # dispatch_calibration(force=True) re-measures AND re-records its
+    # own plan entry (scoring/score.py).
     print(json.dumps({
         "probe": "score_dispatch_calibration", "backend": backend,
         **dispatch_calibration(force=True),
+    }), flush=True)
+    print(json.dumps({
+        "probe": "plan_cache_update",
+        "recorded": recorded,        # False: plans disabled/unwritable
+        "store": plans.default_path(),
+        "backend": plans.device_fingerprint(),
+        "score_device_chunk": int(best_chunk),
+        "knobs_recorded": ["score_device_chunk", "dispatch_calibration"],
     }), flush=True)
 
 

@@ -155,14 +155,46 @@ def chunk_sweep():
     # over the four r05 points gives t_iter ~= 0.94 ms device floor +
     # ~65 ms per-dispatch glue / chunk, so the open question is where
     # 256/512 (predicted ~1.19 / ~1.07 ms) flatten onto that floor.
+    measurements = {}
     for chunk in (32, 64, 128, 256, 512):
         em = bench.bench_em(K, V, B, L, chunk=chunk, rounds=3,
                             warm_start=True, precision="bf16")
+        measurements[chunk] = round(em["docs_per_sec"])
         print(json.dumps({
             "probe": "chunk_sweep", "chunk": chunk,
             "t_iter_ms": round(em["t_iter"] * 1e3, 3),
             "docs_per_sec": round(em["docs_per_sec"]),
         }), flush=True)
+    # Persist the winner as a measured plan (oni_ml_tpu/plans): the
+    # exact capture→cache→seed workflow that turned the r05 sweep into
+    # plans/seeds/v5e.jsonl, now automatic — the next run on this
+    # backend trains at the measured chunk, and `tools/plan_cache.py
+    # export` emits the committable seed.
+    from oni_ml_tpu import plans
+
+    best = max(measurements, key=measurements.get)
+    plans.note_sweep("fused_em_chunk")
+    recorded = plans.record_value(
+        "fused_em_chunk", int(best), shape=f"k{K}.v{V}.b{B}.l{L}",
+        source="probe", measurements=measurements, unit="docs/sec",
+    )
+    # Both records always attempted (no short-circuit): a failed
+    # exact-shape write must not silently skip the wildcard one.
+    recorded_wild = plans.record_value(
+        "fused_em_chunk", int(best), shape="*",
+        source="probe", measurements=measurements, unit="docs/sec",
+        note="wildcard projection: the amortized term is per-dispatch "
+             "glue, shape-independent on this backend",
+    )
+    print(json.dumps({
+        "probe": "plan_cache_update",
+        # False: plans disabled / cache unwritable for that write.
+        "recorded": recorded,
+        "recorded_wildcard": recorded_wild,
+        "store": plans.default_path(),
+        "backend": plans.device_fingerprint(),
+        "fused_em_chunk": int(best),
+    }), flush=True)
 
 
 def batch_amort():
