@@ -279,6 +279,21 @@ class ServingConfig:
     # here ("" = stdout only) — the metrics.json convention of
     # runner/ml_ops.py, one line per micro-batch.
     metrics_path: str = ""
+    # OpenMetrics scrape endpoint (telemetry/exporter.py): serve binds
+    # GET /metrics on this port, exposing the live counters, the
+    # fixed-boundary latency histograms (with correct p50/p99/p999),
+    # and the roofline utilization gauges to any Prometheus-compatible
+    # collector.  0 = no endpoint.
+    metrics_port: int = 0
+    # Bind address for the scrape endpoint.  Loopback by default: the
+    # endpoint exposes backend/model internals, so reaching it from
+    # other hosts (a real Prometheus collector) is an explicit opt-in
+    # ("0.0.0.0"), never the default.
+    metrics_host: str = "127.0.0.1"
+    # Headless-run file sink: the same OpenMetrics text written here at
+    # stream end ("" = off) — CI and piped runs get the scrape bytes
+    # without an HTTP listener.
+    openmetrics_path: str = ""
 
 
 @dataclass(frozen=True)

@@ -642,6 +642,20 @@ def make_chunk_runner(
             out = jitted(log_beta, alpha, ll_prev, groups, n_steps,
                          *args, **kw)
         rec.counter("em.chunk_dispatches").add(1)
+        # Roofline harvest, once per shape, only under an active
+        # recorder — AFTER the live dispatch, so the program is already
+        # traced and in the persistent compilation cache: the AOT
+        # lower+compile that reads XLA's per-dispatch FLOPs/bytes is a
+        # cache hit, never a cold compile delaying first results.
+        # (Safe post-dispatch: this jit donates nothing, so the
+        # operands' shapes are still readable.)  Uninstrumented runs
+        # never pay the extra trace.
+        from ..telemetry import roofline
+
+        roofline.ensure_harvested(
+            "em.run_chunk", jitted, log_beta, alpha, ll_prev, groups,
+            n_steps, *args, shape=f"chunk{chunk}", **kw,
+        )
         return out
 
     # The EFFECTIVE dispatch settings ride on the runner so callers that

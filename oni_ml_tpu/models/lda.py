@@ -33,7 +33,7 @@ from jax.scipy.special import digamma, polygamma
 from ..config import LDAConfig
 from ..io import Batch, Corpus, formats, make_batches
 from ..ops import estep
-from ..telemetry.spans import maybe_span
+from ..telemetry.spans import current_recorder, maybe_span, now_ns
 from . import fused
 
 
@@ -492,6 +492,15 @@ class LDATrainer:
         use_warm = cfg.warm_start_gamma and getattr(
             self._e_base, "_oni_warm_capable", False
         )
+        # Roofline accounting (telemetry/roofline.py) is recorder-gated;
+        # the harvest itself happens AFTER the loop so the programs are
+        # already traced (the AOT cost read is then a compilation-cache
+        # hit, never a cold compile ahead of first results).
+        rl = None
+        if current_recorder() is not None and dev_batches:
+            from ..telemetry import roofline as rl
+        t_loop0 = now_ns()
+        n_e_disp = n_a_disp = n_warm_disp = 0
         gammas = []
         it = start_it
         for it in range(start_it + 1, cfg.em_max_iters + 1):
@@ -506,17 +515,20 @@ class LDATrainer:
                         log_beta, alpha, widx, cnts, mask,
                         prev_gammas[bi], jnp.asarray(1, jnp.int32),
                     )
+                    n_warm_disp += 1
                 else:
                     res = self._e_step(log_beta, alpha, widx, cnts, mask)
                 total_ss = total_ss + res.suff_stats
                 total_ll = total_ll + res.likelihood
                 total_ass = total_ass + res.alpha_ss
                 gammas.append(res.gamma)
+                n_e_disp += 1
 
             log_beta = self._m_step(total_ss)
             if cfg.estimate_alpha:
                 alpha = update_alpha(total_ass, alpha, num_docs, k,
                                      max_iters=cfg.alpha_max_iters)
+                n_a_disp += 1
 
             ll = float(total_ll)
             conv = self._log_iteration(
@@ -528,6 +540,47 @@ class LDATrainer:
             if ll_prev is not None and conv < cfg.em_tol:
                 break
             ll_prev = ll
+
+        if rl is not None:
+            # Harvest the stepwise driver's jitted entry points — the
+            # per-batch E-step and the alpha Newton are the "E-step" and
+            # "alpha update" roofline phases (the fused driver inlines
+            # both into em.run_chunk).  Done post-loop: the programs are
+            # already traced (cache-hit lowering), and with warm starts
+            # the warm variant dominated dispatches (all but the first
+            # iteration), so price against the variant that actually
+            # ran the majority — a mixed run is an approximation the
+            # record's shape suffix names.
+            b0 = batches[0].word_idx.shape[0]
+            widx0, cnts0, mask0 = dev_batches[0]
+            if n_warm_disp * 2 >= n_e_disp and gammas:
+                rl.ensure_harvested(
+                    "em.e_step", self._e_step_warm, log_beta, alpha,
+                    widx0, cnts0, mask0, gammas[0],
+                    jnp.asarray(1, jnp.int32), shape=f"b{b0}.warm",
+                )
+            else:
+                rl.ensure_harvested(
+                    "em.e_step", self._e_step, log_beta, alpha, widx0,
+                    cnts0, mask0, shape=f"b{b0}",
+                )
+            if n_a_disp:
+                rl.ensure_harvested(
+                    "em.update_alpha", update_alpha,
+                    jnp.zeros((), dtype), alpha, num_docs, k,
+                    max_iters=cfg.alpha_max_iters,
+                )
+            # One roofline record per stepwise phase, joined with the
+            # loop wall (the E-step dominates it; the alpha Newton's
+            # record shares the wall and self-describes via
+            # `wall_shared`) — journaled as {"kind": "roofline"} and
+            # published as roofline.* gauges.
+            wall_s = (now_ns() - t_loop0) / 1e9
+            rl.emit("em.e_step", wall_s, dispatches=n_e_disp,
+                    em_iters=it - start_it)
+            if n_a_disp:
+                rl.emit("em.update_alpha", wall_s, dispatches=n_a_disp,
+                        wall_shared="em.e_step")
 
         for g, b in zip(gammas, batches):
             g = to_host(g, self.mesh)
@@ -966,6 +1019,8 @@ class LDATrainer:
         sync_chunk = self._em_chunk
         if self._em_sync:
             sync_chunk = min(sync_chunk, self._em_sync)
+        t_loop0 = now_ns()
+        n_disp = 0
         while it < cfg.em_max_iters:
             stop = min(it + sync_chunk, cfg.em_max_iters)
             if checkpoint_path and cfg.checkpoint_every:
@@ -977,6 +1032,7 @@ class LDATrainer:
                 log_beta, alpha, ll_prev_dev, groups.arrays, stop - it,
                 gammas_prev, have_prev,
             )
+            n_disp += 1
             # Carry the chunk's final posteriors so warm start survives
             # the host sync at chunk boundaries.
             gammas_prev, have_prev = res.gammas, res.steps_done > 0
@@ -1010,6 +1066,22 @@ class LDATrainer:
             # stopped but float64 says not converged, keep iterating.
             if host_conv is not None and host_conv < cfg.em_tol:
                 break
+
+        if current_recorder() is not None and n_disp:
+            # The EM roofline record: the chunk program's harvested
+            # per-dispatch cost (fused.py's runner wrapper registers it
+            # at first instrumented dispatch) joined with the loop's
+            # monotonic wall — enqueue glue AND blocking host syncs, the
+            # whole EM phase.  Journaled as {"kind": "roofline"}; on
+            # backends with registered peaks the record carries
+            # mxu_pct/hbm_pct, elsewhere `utilization: null`.
+            from ..telemetry import roofline
+
+            roofline.emit(
+                "em.run_chunk", (now_ns() - t_loop0) / 1e9,
+                dispatches=n_disp, em_iters=it - start_it,
+                chunk=self._em_chunk,
+            )
 
         if res is not None and int(res.steps_done) > 0:
             for g_arr, slots in zip(res.gammas, groups.batch_slots):

@@ -424,6 +424,19 @@ class OnlineLDATrainer:
         dtype = jnp.dtype(cfg.compute_dtype)
         widx, cnts, mask = self._put_batch(batch)
         update = self._get_update(widx.shape[0], widx.shape[1])
+        from ..telemetry.spans import current_recorder
+
+        if current_recorder() is not None:
+            # Roofline harvest of the refresh-loop's natural-gradient
+            # program, once per process, BEFORE the dispatch below
+            # donates self._lam (lowering only reads shapes).
+            from ..telemetry import roofline
+
+            roofline.ensure_harvested(
+                "serve.refresh_step", update, self._lam,
+                jnp.asarray(rho, dtype), widx, cnts, mask,
+                shape=f"b{widx.shape[0]}.l{widx.shape[1]}",
+            )
         self._lam, ll, _ = update(
             self._lam, jnp.asarray(rho, dtype), widx, cnts, mask
         )

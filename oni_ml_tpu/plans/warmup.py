@@ -141,8 +141,18 @@ def setup_compilation_cache(enabled: bool = True,
 # ---------------------------------------------------------------------------
 
 
-def _aot(fn, *args) -> None:
-    fn.lower(*args).compile()
+def _aot(fn, *args, harvest: str = "", shape: str = ""):
+    compiled = fn.lower(*args).compile()
+    if harvest:
+        # AOT warmup is the cheapest place to read XLA's cost analysis:
+        # the program is already lowered+compiled here, so the roofline
+        # layer's per-dispatch FLOPs/bytes come for free
+        # (telemetry/roofline.py; unavailability degrades, never
+        # raises).
+        from ..telemetry import roofline
+
+        roofline.harvest_compiled(harvest, compiled, shape=shape)
+    return compiled
 
 
 def warmup_scoring(num_ip_rows: int, num_word_rows: int, k: int,
@@ -167,10 +177,13 @@ def warmup_scoring(num_ip_rows: int, num_word_rows: int, k: int,
     idx = jax.ShapeDtypeStruct((chunk,), np.int32)
     thr = jax.ShapeDtypeStruct((), f32)
     valid = jax.ShapeDtypeStruct((), np.int32)
+    sig = f"ip{num_ip_rows}.w{num_word_rows}.k{k}.c{chunk}"
     if dsource == "flow":
-        _aot(_get_fn("filt_flow"), theta, p, idx, idx, idx, idx, thr, valid)
+        _aot(_get_fn("filt_flow"), theta, p, idx, idx, idx, idx, thr, valid,
+             harvest="score.device.filtered_flow", shape=sig)
     else:
-        _aot(_get_fn("filt"), theta, p, idx, idx, thr, valid)
+        _aot(_get_fn("filt"), theta, p, idx, idx, thr, valid,
+             harvest="score.device.filtered", shape=sig)
     out = {"compiled": 1, "chunk": chunk,
            "wall_s": round(time.perf_counter() - t0, 3)}
     out.update(counts_delta(before))
@@ -217,7 +230,11 @@ def warmup_serving(num_ip_rows: int, num_word_rows: int, k: int,
     m = lo
     while m <= hi:
         idx = jax.ShapeDtypeStruct((m,), np.int32)
-        _aot(fn, theta, p, idx, idx)
+        # Harvest every shape; the LAST (largest) program's cost stays
+        # registered under the entry — the full-flush shape the SLO
+        # bench and the serve roofline gauge price against.
+        _aot(fn, theta, p, idx, idx, harvest="serve.micro_batch",
+             shape=f"ip{num_ip_rows}.w{num_word_rows}.k{k}.b{m}")
         compiled += 1
         m <<= 1
     out = {"compiled": compiled, "shapes": f"{lo}..{hi}",

@@ -845,6 +845,9 @@ def run_pipeline(
         plan_store = None        # the default store (seeds + user cache)
     plans_cc0 = _plans_warmup.compile_counts()
     plans_ctr0 = counters_snapshot()
+    from ..telemetry import roofline as _rl0
+
+    roofline0 = _rl0.emit_count()   # scope the rollup to THIS run
 
     # Multi-host contract (--multihost): every rank runs run_pipeline
     # against a SHARED day dir.  Host-only stages (pre/corpus/score) and
@@ -893,6 +896,10 @@ def run_pipeline(
                 timeout_s=tel.heartbeat_timeout_s,
                 max_misses=tel.heartbeat_max_misses,
                 journal=ctx.journal,
+                # Probe round trips feed the run's shared registry
+                # (heartbeat.probe_latency_s histogram): degradation is
+                # on the metrics plane before BackendLost ever fires.
+                recorder=ctx.recorder,
             ).start()
             ctx.heartbeat = hb
 
@@ -964,6 +971,15 @@ def run_pipeline(
             **_plans_warmup.counts_delta(plans_cc0),
             **{k: ctr[k] - plans_ctr0.get(k, 0) for k in ctr},
         })
+        # Roofline rollup (telemetry/roofline.py): every per-phase
+        # record the stages emitted into the journal, surfaced in
+        # metrics.json too, so "how far from the hardware was this
+        # run, per phase?" is greppable without replaying the journal.
+        from ..telemetry import roofline as _roofline
+
+        rl_records = _roofline.emitted_records(since=roofline0)
+        if rl_records:
+            ctx.emit({"stage": "roofline", "records": rl_records})
 
     def _dump_metrics() -> None:
         with open(ctx.path("metrics.json"), "w") as f:

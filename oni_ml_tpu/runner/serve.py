@@ -69,6 +69,21 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    "step and hot-swap the refreshed model in (0=off)")
     p.add_argument("--metrics", default="", metavar="PATH",
                    help="also append per-batch metrics JSON lines here")
+    p.add_argument("--metrics-port", type=int,
+                   default=ServingConfig.metrics_port, metavar="PORT",
+                   help="serve an OpenMetrics scrape endpoint (GET "
+                   "/metrics) on this port: live counters, fixed-"
+                   "boundary latency histograms with p50/p99/p999, and "
+                   "roofline utilization gauges (0 = off)")
+    p.add_argument("--metrics-host", default=ServingConfig.metrics_host,
+                   metavar="ADDR",
+                   help="bind address for --metrics-port (default "
+                   "loopback; pass 0.0.0.0 to let remote collectors "
+                   "scrape)")
+    p.add_argument("--openmetrics", default=ServingConfig.openmetrics_path,
+                   metavar="PATH",
+                   help="write the same OpenMetrics text here at stream "
+                   "end — the headless/CI file sink")
     p.add_argument("--journal", default="", metavar="PATH",
                    help="append every serving event to a crash-safe "
                    "telemetry journal (telemetry/journal.py JSONL: "
@@ -100,6 +115,10 @@ def _serving_config(args) -> ServingConfig:
         refresh_every=args.refresh_every,
         threshold=args.threshold,
         metrics_path=args.metrics,
+        metrics_port=getattr(args, "metrics_port", 0),
+        metrics_host=getattr(args, "metrics_host",
+                             ServingConfig.metrics_host),
+        openmetrics_path=getattr(args, "openmetrics", ""),
     )
 
 
@@ -176,107 +195,177 @@ def serve_stream(args) -> int:
         "vocab": len(snap.model.word_index),
     })
 
-    refresh = (
-        RefreshLoop(
-            registry,
-            OnlineLDAConfig(num_topics=snap.model.num_topics),
-            every=cfg.refresh_every,
-            total_docs=cfg.refresh_total_docs,
+    # Serve roofline gauge, computed at SCRAPE time (and once at
+    # shutdown): the warmed micro-batch program's harvested cost over
+    # the cumulative DEVICE scoring wall (the serve.device_score_ms
+    # histogram — device-path flushes only; pricing host flushes as
+    # device dispatches would inflate the gauge arbitrarily) — achieved
+    # vs peak for the serving phase, utilization null off-TPU.
+    from ..telemetry import roofline as _roofline
+
+    def _serve_roofline(emit_journal: bool = False):
+        rec = metrics.recorder
+        kw = {"journal": journal} if emit_journal else {}
+        hd = rec.histograms.get("serve.device_score_ms")
+        if hd is not None and hd.count:
+            dev_events = rec.counters.get("serve.device_events")
+            return _roofline.emit(
+                "serve.micro_batch", hd.total / 1e3, dispatches=hd.count,
+                recorder=rec, path="device",
+                events=dev_events.value
+                if dev_events is not None else None, **kw,
+            )
+        # Host-path-only session (every flush under break-even): no
+        # device program ran, so there is no cost to join — emit a
+        # wall-time-only record over the full scoring wall (the entry
+        # name is unharvested by construction), never the device
+        # program's cost times host flushes.
+        h = rec.histograms.get("serve.score_ms")
+        if h is None or not h.count:
+            return None
+        return _roofline.emit(
+            "serve.micro_batch", h.total / 1e3, dispatches=h.count,
+            recorder=rec, entry="serve.micro_batch.host", path="host",
+            **kw,
         )
-        if cfg.refresh_every
-        else None
-    )
 
-    def on_batch(snapshot, feats, scores):
-        for i in np.where(scores < cfg.threshold)[0]:
-            print(json.dumps({
-                "flagged": feats.featurized_row(int(i)),
-                "score": float(scores[i]),
-                "model_version": snapshot.version,
-            }), flush=True)
-        if refresh is not None:
-            from ..serving import event_documents
+    mserver = None
+    if cfg.metrics_port:
+        from ..telemetry import MetricsServer
 
-            ips, words = event_documents(feats, featurizer.dsource)
-            new = refresh.observe(snapshot, ips, words)
-            if new is not None:
-                metrics.emit({
-                    "stage": "serve", "event": "model_refresh",
-                    "model_version": new.version, "source": new.source,
-                })
-
-    scorer = BatchScorer(
-        registry, featurizer, cfg, metrics=metrics, on_batch=on_batch
-    )
-    # AOT warmup at the PLAN's shapes: the padded micro-batch device
-    # programs (break-even .. max_batch, powers of two) compile NOW —
-    # into the persistent cache — instead of stalling the first
-    # over-break-even flush mid-stream.  The emitted record names every
-    # resolved knob's source and the cache-hit vs trace counts, so a
-    # restarted service can be ASSERTED warm, not assumed.
-    try:
-        warm = plans_warmup.warmup_serving(
-            snap.model.theta.shape[0], snap.model.p.shape[0],
-            snap.model.num_topics, scorer.max_batch,
-            cfg.device_score_min,
+        mserver = MetricsServer(
+            metrics.recorder, port=cfg.metrics_port,
+            host=cfg.metrics_host, refresh=_serve_roofline,
         )
-    except Exception as e:  # warmup must never block serving
-        warm = {"error": repr(e)[:200]}
-    metrics.emit({
-        "stage": "serve", "event": "plans",
-        "knobs": scorer.plan,
-        "compilation_cache": cc_rec,
-        "warmup": warm,
-    })
-    stream = sys.stdin if args.input == "-" else open(args.input)
-    submitted = rejected = header_skipped = 0
-    header = None
-    first = True
+        metrics.emit({
+            "stage": "serve", "event": "metrics_endpoint",
+            "port": mserver.port, "path": "/metrics",
+        })
+
+    # Everything below runs under one finally that releases the HTTP
+    # endpoint, the metrics file, and the journal: a mid-stream
+    # exception must not leave the ThreadingHTTPServer bound (an
+    # in-process restart on the same port would EADDRINUSE) or the
+    # sinks open.
     try:
-        for line in stream:
-            if not line.strip():
-                continue
-            # The batch pre stage drops the CSV header and its
-            # duplicates (featurize_flow's removeHeader); serving must
-            # match, or a piped raw day file scores one phantom event
-            # (header numerics parse NaN, word lands in the max bins).
-            # Mid-stream garbage rows still score — batch parity.
-            if first:
-                first = False
-                if _looks_like_header(line, args.dsource):
-                    header = line
+        refresh = (
+            RefreshLoop(
+                registry,
+                OnlineLDAConfig(num_topics=snap.model.num_topics),
+                every=cfg.refresh_every,
+                total_docs=cfg.refresh_total_docs,
+            )
+            if cfg.refresh_every
+            else None
+        )
+
+        def on_batch(snapshot, feats, scores):
+            for i in np.where(scores < cfg.threshold)[0]:
+                print(json.dumps({
+                    "flagged": feats.featurized_row(int(i)),
+                    "score": float(scores[i]),
+                    "model_version": snapshot.version,
+                }), flush=True)
+            if refresh is not None:
+                from ..serving import event_documents
+
+                ips, words = event_documents(feats, featurizer.dsource)
+                new = refresh.observe(snapshot, ips, words)
+                if new is not None:
+                    metrics.emit({
+                        "stage": "serve", "event": "model_refresh",
+                        "model_version": new.version,
+                        "source": new.source,
+                    })
+
+        scorer = BatchScorer(
+            registry, featurizer, cfg, metrics=metrics, on_batch=on_batch
+        )
+        # AOT warmup at the PLAN's shapes: the padded micro-batch device
+        # programs (break-even .. max_batch, powers of two) compile NOW
+        # — into the persistent cache — instead of stalling the first
+        # over-break-even flush mid-stream.  The emitted record names
+        # every resolved knob's source and the cache-hit vs trace
+        # counts, so a restarted service can be ASSERTED warm, not
+        # assumed.
+        try:
+            warm = plans_warmup.warmup_serving(
+                snap.model.theta.shape[0], snap.model.p.shape[0],
+                snap.model.num_topics, scorer.max_batch,
+                cfg.device_score_min,
+            )
+        except Exception as e:  # warmup must never block serving
+            warm = {"error": repr(e)[:200]}
+        metrics.emit({
+            "stage": "serve", "event": "plans",
+            "knobs": scorer.plan,
+            "compilation_cache": cc_rec,
+            "warmup": warm,
+        })
+        stream = sys.stdin if args.input == "-" else open(args.input)
+        submitted = rejected = header_skipped = 0
+        header = None
+        first = True
+        try:
+            for line in stream:
+                if not line.strip():
+                    continue
+                # The batch pre stage drops the CSV header and its
+                # duplicates (featurize_flow's removeHeader); serving
+                # must match, or a piped raw day file scores one phantom
+                # event (header numerics parse NaN, word lands in the
+                # max bins).  Mid-stream garbage rows still score —
+                # batch parity.
+                if first:
+                    first = False
+                    if _looks_like_header(line, args.dsource):
+                        header = line
+                        header_skipped += 1
+                        continue
+                if header is not None and line == header:
                     header_skipped += 1
                     continue
-            if header is not None and line == header:
-                header_skipped += 1
-                continue
+                try:
+                    scorer.submit(line)
+                    submitted += 1
+                except ValueError:
+                    rejected += 1
+        finally:
+            if stream is not sys.stdin:
+                stream.close()
+            scorer.close()
+        metrics.emit({
+            "stage": "serve", "event": "stream_end",
+            "submitted": submitted, "rejected": rejected,
+            "header_skipped": header_skipped,
+            "events_scored": scorer.events_scored,
+            "batches": scorer.batches_flushed,
+            "final_model_version": registry.version,
+        })
+        # Final roofline record (journaled) + OpenMetrics file sink,
+        # then the shutdown aggregate from the shared registry: the
+        # counters and latency distributions — now with true
+        # p50/p99/p999 — the per-batch lines fed all along.
+        _serve_roofline(emit_journal=True)
+        if cfg.openmetrics_path:
+            from ..telemetry import write_openmetrics
+
             try:
-                scorer.submit(line)
-                submitted += 1
-            except ValueError:
-                rejected += 1
+                write_openmetrics(cfg.openmetrics_path, metrics.recorder)
+            except OSError as e:
+                print(f"serve: openmetrics sink failed: {e!r}",
+                      file=sys.stderr)
+        metrics.emit({
+            "stage": "serve", "event": "registry_snapshot",
+            **metrics.snapshot(),
+        })
+        return 0 if scorer.events_scored == submitted else 1
     finally:
-        if stream is not sys.stdin:
-            stream.close()
-        scorer.close()
-    metrics.emit({
-        "stage": "serve", "event": "stream_end",
-        "submitted": submitted, "rejected": rejected,
-        "header_skipped": header_skipped,
-        "events_scored": scorer.events_scored,
-        "batches": scorer.batches_flushed,
-        "final_model_version": registry.version,
-    })
-    # Shutdown aggregate from the shared registry: the counters and
-    # latency distributions the per-batch lines fed all along.
-    metrics.emit({
-        "stage": "serve", "event": "registry_snapshot",
-        **metrics.snapshot(),
-    })
-    metrics.close()
-    if journal is not None:
-        journal.close()
-    return 0 if scorer.events_scored == submitted else 1
+        if mserver is not None:
+            mserver.close()
+        metrics.close()
+        if journal is not None:
+            journal.close()
 
 
 # ---------------------------------------------------------------------------

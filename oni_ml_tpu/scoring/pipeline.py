@@ -238,7 +238,7 @@ def _pad_chunk(a: np.ndarray, lo: int, hi: int, chunk: int) -> np.ndarray:
 
 
 def _run_chunks(n: int, chunk: int, dispatch, collect,
-                label: str = "score.device.chunks"):
+                label: str = "score.device.chunks", harvest=None):
     """The double-buffered dispatch loop shared by every pipeline entry:
     chunk i+1 is enqueued (pad + H2D + compute, all asynchronous under
     JAX dispatch) BEFORE chunk i's results are synced, so host-side
@@ -247,16 +247,36 @@ def _run_chunks(n: int, chunk: int, dispatch, collect,
     When a telemetry Recorder is active (telemetry/spans.py) the whole
     loop records one `label` span (events/chunks in args) — the
     device-scoring wall the flight recorder correlates against stage
-    spans; per-chunk accounting stays DispatchStats' job."""
-    from ..telemetry.spans import maybe_span
+    spans; per-chunk accounting stays DispatchStats' job.  `harvest`
+    (optional callable) registers the dispatched program's XLA cost
+    analysis under `label` AFTER the loop — the live dispatches have
+    already traced the program, so the AOT lower+compile behind the
+    harvest is a compilation-cache hit rather than a cold compile
+    ahead of first results — and the loop wall then joins it into a
+    journaled {"kind": "roofline"} record (telemetry/roofline.py) —
+    the scoring-dispatch utilization lane.  Both are recorder-gated:
+    uninstrumented runs pay nothing."""
+    from ..telemetry.spans import current_recorder, maybe_span, now_ns
 
     nchunks = -(-n // chunk)
+    instrumented = current_recorder() is not None
+    t0 = now_ns()
     with maybe_span(label, events=n, chunk=chunk, chunks=nchunks):
         pending = [dispatch(0)]
         for i in range(1, nchunks):
             pending.append(dispatch(i))
             collect(*pending.pop(0))
         collect(*pending.pop(0))
+    if instrumented:
+        if harvest is not None:
+            try:
+                harvest()
+            except Exception:
+                pass  # cost harvest must never fail a scoring run
+        from ..telemetry import roofline
+
+        roofline.emit(label, (now_ns() - t0) / 1e9, dispatches=nchunks,
+                      events=n, chunk=chunk)
     return nchunks
 
 
@@ -309,10 +329,36 @@ def chunked_scores(
         if stats is not None:
             stats.d2h_bytes += 4 * (hi - lo)
 
-    _run_chunks(n, chunk, dispatch, collect, label="score.device.full")
+    _run_chunks(n, chunk, dispatch, collect, label="score.device.full",
+                harvest=None if mesh is not None else lambda:
+                _harvest_entry("score.device.full", "score", chunk,
+                               theta, p))
     if stats is not None:
         stats.survivors += n
     return out
+
+
+def _harvest_entry(entry: str, fn_name: str, chunk: int, theta, p,
+                   threshold=None) -> None:
+    """Register `fn_name`'s per-dispatch XLA cost under `entry` (once
+    per shape) at this call's shapes — the hook _run_chunks fires under
+    an active recorder.  Index operands are zeros: lowering only reads
+    shapes/dtypes.  The shape signature matches warmup_scoring's
+    exactly, so an AOT-warmed entry is already registered and this is a
+    no-op — a mismatched key would discard the free warmup harvest and
+    re-lower the program on the scoring path."""
+    from ..telemetry import roofline
+
+    idx = np.zeros(chunk, np.int32)
+    if fn_name == "score":
+        args = (theta, p, idx, idx)
+    elif fn_name == "filt":
+        args = (theta, p, idx, idx, np.float32(threshold), np.int32(chunk))
+    else:  # filt_flow
+        args = (theta, p, idx, idx, idx, idx, np.float32(threshold),
+                np.int32(chunk))
+    sig = f"ip{theta.shape[0]}.w{p.shape[0]}.k{theta.shape[1]}.c{chunk}"
+    roofline.ensure_harvested(entry, _get_fn(fn_name), *args, shape=sig)
 
 
 def _survivor_slice(c: int, m: int) -> int:
@@ -400,7 +446,10 @@ def filtered_scores(
                 stats.survivors += c
 
     _run_chunks(n, chunk, dispatch, collect,
-                label="score.device.filtered")
+                label="score.device.filtered",
+                harvest=None if mesh is not None else lambda:
+                _harvest_entry("score.device.filtered", "filt", chunk,
+                               theta, p, threshold))
     if not parts:
         return empty
     return _merge_survivors(parts)
@@ -474,7 +523,10 @@ def filtered_flow_scores(
                 stats.survivors += c
 
     _run_chunks(n, chunk, dispatch, collect,
-                label="score.device.filtered_flow")
+                label="score.device.filtered_flow",
+                harvest=None if mesh is not None else lambda:
+                _harvest_entry("score.device.filtered_flow", "filt_flow",
+                               chunk, theta, p, threshold))
     if not parts:
         return empty
     return _merge_survivors(parts)

@@ -323,6 +323,7 @@ class BatchScorer:
         t1 = time.perf_counter()
         for p, s in zip(batch, scores):
             p.future._resolve(float(s), snap.version)
+        t2 = time.perf_counter()   # demux: every future delivered
         self._events_scored += len(batch)
         seq = self._batch_seq
         self._batch_seq += 1
@@ -360,10 +361,17 @@ class BatchScorer:
                 else "host"
             ),
             # Latency of the oldest event, enqueue -> scored (the
-            # number max_wait_ms bounds the left edge of), plus the
-            # pure scoring cost and the resulting throughput.
+            # number max_wait_ms bounds the left edge of), decomposed
+            # along the path the event walked: queue wait (enqueue ->
+            # flush start), score (featurize + device/host dispatch),
+            # demux (scores -> every future delivered).  The fields
+            # feed the shared serve.* histograms (serving/metrics.py),
+            # whose bucket quantiles the SLO bench and the OpenMetrics
+            # endpoint report.
             "latency_ms": round((t1 - batch[0].t_enqueue) * 1e3, 3),
+            "queue_wait_ms": round((t0 - batch[0].t_enqueue) * 1e3, 3),
             "score_ms": round(score_s * 1e3, 3),
+            "demux_ms": round((t2 - t1) * 1e3, 3),
             "events_per_sec": round(n / score_s, 1) if score_s else None,
             "queue_depth": depth,
             "flagged": int(np.sum(scores < cfg.threshold)),

@@ -33,9 +33,18 @@ _COUNT_FIELDS = (
     ("flagged", "serve.flagged"),
 )
 # Numeric record fields observed as histograms (field -> histogram name).
+# The histograms are the shared fixed-boundary log-bucket kind
+# (telemetry/spans.py), so snapshot() reports TRUE p50/p99/p999
+# estimates from the bucket boundaries — the numbers the OpenMetrics
+# exporter serves live and `bench.py serving_slo` reports.  The
+# per-stage latency fields (queue_wait/score/demux) decompose the
+# end-to-end latency along the enqueue -> flush -> device -> demux
+# path the batcher walks.
 _HIST_FIELDS = (
     ("latency_ms", "serve.latency_ms"),
+    ("queue_wait_ms", "serve.queue_wait_ms"),
     ("score_ms", "serve.score_ms"),
+    ("demux_ms", "serve.demux_ms"),
     ("queue_depth", "serve.queue_depth"),
 )
 
@@ -97,10 +106,25 @@ class MetricsEmitter:
             v = record.get(field)
             if isinstance(v, (int, float)):
                 rec.histogram(name).observe(float(v))
+        if record.get("scorer") == "device":
+            # Device-dispatch flushes only: the serve roofline joins the
+            # warmed device program's cost with THIS histogram's
+            # count/sum — host-path flushes observing into it would
+            # price host scoring as device dispatches and inflate the
+            # utilization gauge arbitrarily.
+            v = record.get("score_ms")
+            if isinstance(v, (int, float)):
+                rec.histogram("serve.device_score_ms").observe(float(v))
+            ev = record.get("events")
+            if isinstance(ev, (int, float)):
+                rec.counter("serve.device_events").add(int(ev))
 
     def snapshot(self) -> dict:
-        """The shared registry's aggregate view (counters + histogram
-        summaries) — what `ml_ops serve` prints at shutdown."""
+        """The shared registry's aggregate view — what `ml_ops serve`
+        prints at shutdown.  Histogram summaries carry true
+        p50/p99/p999 quantile estimates read off the fixed log-bucket
+        boundaries (spans.Histogram.quantile), not naive interpolation
+        over min/max."""
         return self.recorder.snapshot()
 
     def close(self) -> None:

@@ -11,8 +11,14 @@ every stage records through (docs/observability.md).
                   maybe_span() is the zero-cost library hook.
     heartbeat.py  background device-liveness prober; dead backends
                   become a clean BackendLost instead of a hang.
+    roofline.py   XLA cost-analysis harvest + peak-spec registry:
+                  achieved-vs-peak MXU/HBM per phase, journaled as
+                  {"kind": "roofline"} records.
+    exporter.py   OpenMetrics text exporter (HTTP endpoint + file
+                  sink) over the shared registry.
 """
 
+from .exporter import MetricsServer, render_openmetrics, write_openmetrics
 from .heartbeat import BackendLost, HeartbeatMonitor
 from .journal import Journal, RunJournal
 from .spans import (
@@ -26,9 +32,12 @@ __all__ = [
     "BackendLost",
     "HeartbeatMonitor",
     "Journal",
+    "MetricsServer",
     "Recorder",
     "RunJournal",
     "current_recorder",
     "maybe_span",
+    "render_openmetrics",
     "use_recorder",
+    "write_openmetrics",
 ]

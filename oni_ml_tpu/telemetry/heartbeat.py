@@ -120,7 +120,8 @@ class HeartbeatMonitor:
     def __init__(self, interval_s: float = 30.0, timeout_s: float = 60.0,
                  max_misses: int = 2, journal=None,
                  probe=device_add_probe, deep_probe=subprocess_probe,
-                 deep_timeout_s: float = 120.0, on_lost=None) -> None:
+                 deep_timeout_s: float = 120.0, on_lost=None,
+                 recorder=None) -> None:
         if interval_s <= 0:
             raise ValueError(f"interval_s must be > 0, got {interval_s}")
         self.interval_s = float(interval_s)
@@ -131,6 +132,16 @@ class HeartbeatMonitor:
         self.deep_probe = deep_probe
         self.deep_timeout_s = float(deep_timeout_s)
         self.on_lost = on_lost
+        # Probe round-trip times route into the shared registry
+        # (`heartbeat.probe_latency_s` histogram, `heartbeat.misses`
+        # counter) so backend DEGRADATION — rising probe latency — is
+        # visible on the metrics plane before BackendLost ever fires.
+        # Bound at construction: the probe loop runs on a worker thread,
+        # where the current_recorder contextvar would not propagate.
+        from .spans import current_recorder
+
+        self.recorder = recorder if recorder is not None \
+            else current_recorder()
         self.lost = threading.Event()
         self.lost_reason: "str | None" = None
         self.beats = 0
@@ -191,10 +202,16 @@ class HeartbeatMonitor:
         self.beats += 1
         if latency is not None:
             self.misses = 0
+            if self.recorder is not None:
+                self.recorder.histogram(
+                    "heartbeat.probe_latency_s"
+                ).observe(latency)
             if self.journal is not None:
                 self.journal.heartbeat(True, latency_s=round(latency, 6))
             return True
         self.misses += 1
+        if self.recorder is not None:
+            self.recorder.counter("heartbeat.misses").add(1)
         if self.journal is not None:
             self.journal.heartbeat(
                 False, misses=self.misses, timeout_s=self.timeout_s

@@ -1,0 +1,389 @@
+"""Roofline accounting: XLA cost analysis per compiled entry point,
+joined with measured wall time, against a per-backend peak-spec
+registry.
+
+The r03 capture measured 10.5% MXU / 3.1% HBM utilization on the EM
+headline — numbers that existed only as a hand-derived note in a bench
+capture.  This module makes "how far from the hardware are we, per
+phase?" a first-class, journaled, regression-trackable record:
+
+1. **Harvest** — every jitted entry point the runner stages dispatch is
+   harvested at AOT-warmup/first-trace time: `compiled.cost_analysis()`
+   yields the program's FLOPs and bytes accessed (per dispatch), which
+   land in a process-wide cost registry keyed by entry name.  Harvest
+   NEVER raises: a backend/jax version without cost analysis records
+   `source: "unavailable"` and every downstream record degrades to
+   wall-time-only.
+2. **Peaks** — `peaks_for()` maps the plans-layer backend fingerprint
+   to published peak FLOP/s and HBM bytes/s (`PEAK_SPECS`, provenance
+   carried per entry).  CPU and unknown backends have NO peaks, so
+   tier-1 degrades to achieved-FLOPs-only (`utilization: null`), never
+   an exception.
+3. **Join** — `emit(phase, wall_s, dispatches)` multiplies the entry's
+   per-dispatch cost by the dispatch count, divides by the measured
+   wall (span wall times — the monotonic clocks of telemetry/spans.py),
+   and appends a `{"kind": "roofline", ...}` record to the active
+   journal plus `roofline.<phase>.*` gauges on the active Recorder, so
+   `tools/trace_view.py` renders utilization counter lanes and the
+   OpenMetrics exporter serves the gauges live.
+
+Caveat worth stating once: cost analysis prices the program XLA
+compiled, per dispatch.  For chunked programs whose trip count is a
+runtime operand (the fused-EM while_loop), XLA's static count covers
+one body execution — the emitted record carries `dispatches` and the
+raw per-dispatch cost so the reader can see exactly what was counted;
+`bench.py`'s analytic `em_utilization` model remains the cross-check.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+from .spans import current_recorder
+
+
+# ---------------------------------------------------------------------------
+# Peak-spec registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PeakSpec:
+    """Published per-chip peaks for one accelerator generation."""
+
+    flops_per_s: float       # matmul peak the MXU path can reach
+    hbm_bytes_per_s: float   # HBM bandwidth peak
+    provenance: str
+
+
+# Matched as substrings against the plans-layer device fingerprint
+# ("backend:device_kind:count", lowercase, spaces -> _).  First match
+# wins.  CPU and unrecognized backends deliberately have NO entry:
+# peaks_for() returns None and every record degrades to
+# `utilization: null` (the tier-1 contract) instead of inventing a
+# denominator.
+PEAK_SPECS: "tuple[tuple[tuple[str, ...], PeakSpec], ...]" = (
+    (
+        ("v5e", "v5_lite", "v5litepod"),
+        PeakSpec(
+            flops_per_s=197e12,
+            hbm_bytes_per_s=819e9,
+            provenance=(
+                "TPU v5e public spec: 197 TFLOP/s bf16 matmul (the MXU "
+                "path XLA feeds f32 inputs at DEFAULT precision), "
+                "819 GB/s HBM — the denominators of the r03 capture's "
+                "10.5% MXU / 3.1% HBM headline "
+                "(docs/bench_captures/r03_session_capture.json)"
+            ),
+        ),
+    ),
+)
+
+
+def peaks_for(fingerprint: "str | None") -> "PeakSpec | None":
+    """PeakSpec for a plans-layer backend fingerprint, or None when the
+    backend has no registered peaks (CPU, unknown)."""
+    if not fingerprint:
+        return None
+    fp = fingerprint.lower()
+    if fp.startswith(("cpu", "host", "nodevice")):
+        return None
+    for patterns, spec in PEAK_SPECS:
+        if any(p in fp for p in patterns):
+            return spec
+    return None
+
+
+def _backend_fingerprint() -> str:
+    """The plans-layer device fingerprint, without ever letting a
+    fingerprint probe take the caller down."""
+    try:
+        from ..plans import device_fingerprint
+
+        return device_fingerprint()
+    except Exception:
+        return "nodevice"
+
+
+# ---------------------------------------------------------------------------
+# Cost harvest — one registry per process
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_COSTS: "dict[str, dict]" = {}
+# Roofline records emitted this process (bounded) — what the runner
+# folds into metrics.json and bench payloads lift their sections from.
+_EMITTED: deque = deque(maxlen=256)
+_EMIT_COUNT = 0
+
+
+def _pick(analysis: dict, *keys: str) -> "float | None":
+    for k in keys:
+        v = analysis.get(k)
+        if isinstance(v, (int, float)) and v >= 0:
+            return float(v)
+    return None
+
+
+def harvest_compiled(name: str, compiled, *, shape: str = "") -> dict:
+    """Read `compiled.cost_analysis()` off an AOT-compiled/lowered
+    program and register its per-dispatch cost under `name`.  Never
+    raises: unavailability (older jax, backends without cost models)
+    registers `source: "unavailable"` so emit() degrades to
+    wall-time-only records."""
+    flops = bytes_accessed = None
+    source = "unavailable"
+    try:
+        analysis = compiled.cost_analysis()
+        # jax has returned both a bare dict and a one-element list of
+        # dicts across versions.
+        if isinstance(analysis, (list, tuple)) and analysis:
+            analysis = analysis[0]
+        if isinstance(analysis, dict):
+            flops = _pick(analysis, "flops")
+            bytes_accessed = _pick(analysis, "bytes accessed",
+                                   "bytes_accessed")
+            if flops is not None or bytes_accessed is not None:
+                source = "cost_analysis"
+    except Exception:
+        pass
+    entry = {
+        "flops": flops,
+        "bytes": bytes_accessed,
+        "shape": shape,
+        "backend": _backend_fingerprint(),
+        "source": source,
+    }
+    with _LOCK:
+        _COSTS[name] = entry
+    return entry
+
+
+def harvest_jitted(name: str, fn, *args, shape: str = "", **kw):
+    """Harvest a `jax.jit` entry point by AOT-lowering it at the call's
+    shapes (`fn.lower(*args).compile()` — abstract or concrete args
+    both work; no data is moved).  The persistent compilation cache
+    (plans/warmup.py) makes the compile a disk hit when the live
+    dispatch already traced this program.  Returns the registered entry
+    or None; never raises."""
+    try:
+        compiled = fn.lower(*args, **kw).compile()
+    except Exception:
+        with _LOCK:
+            cur = _COSTS.get(name)
+            if cur is None or cur.get("shape") != shape:
+                # No usable cost for THIS shape: a stale entry harvested
+                # at a different shape would mis-price every dispatch,
+                # so replace it — emit() degrades to wall-time-only.
+                _COSTS[name] = {
+                    "flops": None, "bytes": None, "shape": shape,
+                    "backend": _backend_fingerprint(),
+                    "source": "unavailable",
+                }
+        return None
+    return harvest_compiled(name, compiled, shape=shape)
+
+
+def ensure_harvested(name: str, fn, *args, shape: str = "", **kw) -> None:
+    """harvest_jitted, once per entry name AND shape — the hook hot
+    dispatch paths call under an active recorder.  A repeat at the same
+    shape is free; a shape change (a different chunk plan, a resized
+    micro-batch) re-harvests so the per-dispatch cost joined with wall
+    times is always the cost of the program actually dispatched."""
+    with _LOCK:
+        cur = _COSTS.get(name)
+        if cur is not None and cur.get("shape") == shape:
+            return
+    harvest_jitted(name, fn, *args, shape=shape, **kw)
+
+
+def cost_for(name: str) -> "dict | None":
+    with _LOCK:
+        return dict(_COSTS[name]) if name in _COSTS else None
+
+
+def costs_snapshot() -> dict:
+    with _LOCK:
+        return {k: dict(v) for k, v in _COSTS.items()}
+
+
+def reset() -> None:
+    """Clear the process registries (tests)."""
+    with _LOCK:
+        _COSTS.clear()
+        _EMITTED.clear()
+
+
+# ---------------------------------------------------------------------------
+# Record construction + emission
+# ---------------------------------------------------------------------------
+
+
+def roofline_record(phase: str, wall_s: float, *, entry: "str | None" = None,
+                    dispatches: int = 1, **extra) -> dict:
+    """Build one roofline record: the entry's per-dispatch cost times
+    `dispatches`, over the measured wall, against the backend's peaks.
+
+    Always returns a record.  Without harvested cost: wall-time-only
+    (`flops`/`bytes`/`utilization` null).  With cost but no peaks (CPU):
+    achieved FLOP/s / bytes/s, `utilization` null."""
+    cost = cost_for(entry or phase)
+    backend = (cost or {}).get("backend") or _backend_fingerprint()
+    rec = {
+        "kind": "roofline",
+        "phase": phase,
+        "entry": entry or phase,
+        "backend": backend,
+        "wall_s": round(float(wall_s), 6),
+        "dispatches": int(dispatches),
+        "cost_source": (cost or {}).get("source", "unharvested"),
+        "flops": None,
+        "bytes": None,
+        "flops_per_s": None,
+        "bytes_per_s": None,
+        "peaks": None,
+        "utilization": None,
+        **extra,
+    }
+    if cost is None or wall_s <= 0:
+        return rec
+    flops = cost.get("flops")
+    nbytes = cost.get("bytes")
+    if flops is not None:
+        rec["flops"] = flops * dispatches
+        rec["flops_per_s"] = rec["flops"] / wall_s
+    if nbytes is not None:
+        rec["bytes"] = nbytes * dispatches
+        rec["bytes_per_s"] = rec["bytes"] / wall_s
+    spec = peaks_for(backend)
+    if spec is not None:
+        rec["peaks"] = {
+            "flops_per_s": spec.flops_per_s,
+            "hbm_bytes_per_s": spec.hbm_bytes_per_s,
+            "provenance": spec.provenance,
+        }
+        util = {}
+        if rec["flops_per_s"] is not None:
+            util["mxu_pct"] = round(
+                100.0 * rec["flops_per_s"] / spec.flops_per_s, 2
+            )
+        if rec["bytes_per_s"] is not None:
+            util["hbm_pct"] = round(
+                100.0 * rec["bytes_per_s"] / spec.hbm_bytes_per_s, 2
+            )
+        rec["utilization"] = util or None
+    return rec
+
+
+def emit(phase: str, wall_s: float, *, entry: "str | None" = None,
+         dispatches: int = 1, recorder=None, journal=None, **extra) -> dict:
+    """Build and publish one roofline record: append to the journal
+    (explicit `journal`/RunJournal, else the active Recorder's bound
+    journal), set `roofline.<phase>.*` gauges on the Recorder, and keep
+    it in the process ledger (`emitted_records()`) for the runner's
+    metrics.json / bench payload sections.  Never raises."""
+    rec = roofline_record(phase, wall_s, entry=entry,
+                          dispatches=dispatches, **extra)
+    try:
+        r = recorder if recorder is not None else current_recorder()
+        if r is not None:
+            if rec["flops_per_s"] is not None:
+                r.gauge(f"roofline.{phase}.flops_per_s", rec["flops_per_s"])
+            if rec["bytes_per_s"] is not None:
+                r.gauge(f"roofline.{phase}.bytes_per_s", rec["bytes_per_s"])
+            util = rec.get("utilization") or {}
+            for k, v in util.items():
+                r.gauge(f"roofline.{phase}.{k}", v)
+        j = journal
+        if j is None and r is not None:
+            r.journal_record(rec)
+        elif j is not None:
+            # Accept a RunJournal or a raw Journal.
+            append = getattr(j, "append", None)
+            if append is not None:
+                append(dict(rec))
+        global _EMIT_COUNT
+        with _LOCK:
+            _EMITTED.append(rec)
+            _EMIT_COUNT += 1
+    except Exception:
+        pass
+    return rec
+
+
+def emit_count() -> int:
+    """Total emits this process — callers snapshot it to scope
+    emitted_records() to their own run (tests drive several pipelines
+    per process)."""
+    with _LOCK:
+        return _EMIT_COUNT
+
+
+def emitted_records(since: int = 0) -> "list[dict]":
+    """Records emitted after the `since` count (bounded by the ledger's
+    retention)."""
+    with _LOCK:
+        new = _EMIT_COUNT - since
+        recs = list(_EMITTED)[-new:] if new > 0 else []
+        return [dict(r) for r in recs]
+
+
+# ---------------------------------------------------------------------------
+# Entry-point coverage — the contract the telemetry lint enforces
+# ---------------------------------------------------------------------------
+
+# Every file under oni_ml_tpu/ that creates a `jax.jit(` entry point
+# must appear here, naming how its programs are harvested for cost
+# analysis (or why they are exempt).  tests/test_telemetry.py's
+# jit-coverage lint fails the suite when a new jit site lands in a file
+# not accounted for — the drift guard that keeps the roofline's phase
+# coverage honest as kernels are added.
+HARVEST_COVERAGE: "dict[str, str]" = {
+    "models/fused.py": (
+        "em.run_chunk — harvested at first instrumented dispatch via "
+        "roofline.ensure_harvested in the chunk runner wrapper"
+    ),
+    "models/lda.py": (
+        "em.update_alpha + em.e_step — harvested in the stepwise "
+        "driver (fused runs inline them into em.run_chunk)"
+    ),
+    "models/online_lda.py": (
+        "serve.refresh_step — the online-LDA update dispatched by the "
+        "serving refresh loop; harvested opportunistically at step time "
+        "(scan-shaped programs re-lower per chunk length)"
+    ),
+    "models/evaluate.py": (
+        "exempt: holdout likelihood evaluation — an offline quality "
+        "metric outside the runner's dispatch path"
+    ),
+    "ops/dense_estep.py": (
+        "kernel bodies inlined into the jitted chunk/E-step programs — "
+        "cost harvested at their callers' entries (em.run_chunk, "
+        "em.e_step)"
+    ),
+    "scoring/pipeline.py": (
+        "score.device.{full,filtered,filtered_flow} — harvested by "
+        "plans.warmup.warmup_scoring AOT and ensure_harvested at "
+        "dispatch"
+    ),
+    "scoring/score.py": (
+        "serve.micro_batch — harvested by plans.warmup.warmup_serving "
+        "over the padded power-of-two batch family"
+    ),
+    "parallel/sharded.py": (
+        "sharded twins of the scoring/EM entry points — cost harvested "
+        "through their single-device callers' entries; per-shard cost "
+        "equals the caller's divided by the data axis"
+    ),
+    "telemetry/heartbeat.py": (
+        "exempt: the liveness probe (x + 1) — a round-trip timer, not "
+        "a compute phase; its latency routes into the "
+        "heartbeat.probe_latency_s histogram instead"
+    ),
+    "plans/warmup.py": (
+        "the AOT harvest hook itself: _aot() reads cost_analysis off "
+        "every program it compiles"
+    ),
+}

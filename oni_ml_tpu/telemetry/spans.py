@@ -95,10 +95,31 @@ class Counter:
 
 
 class Histogram:
-    """Streaming summary (count/sum/min/max) plus power-of-two buckets
-    — enough to see a latency distribution without retaining samples."""
+    """Streaming summary (count/sum/min/max) plus FIXED log-boundary
+    buckets — enough to see a latency distribution, and to estimate its
+    quantiles correctly, without retaining samples.
 
-    __slots__ = ("name", "count", "total", "min", "max", "buckets", "_lock")
+    Bucket i covers (2^((i-1)/GRID), 2^(i/GRID)]: four buckets per
+    octave (~19% relative width), so a quantile read off the bucket
+    boundaries carries at most ~±9% relative error — tight enough for
+    p50/p99/p999 SLO reporting, wide enough that a serve process's
+    histogram stays a few hundred ints across any latency range.
+    Non-positive observations land in a dedicated zero bucket (they
+    have no log position).  The boundaries are FIXED (value-independent)
+    so histograms merge/export consistently across processes and the
+    OpenMetrics exporter (telemetry/exporter.py) can emit cumulative
+    `le` buckets without re-binning.
+
+    This is the one quantile implementation in the package: the
+    telemetry lint (tests/test_telemetry.py) forbids ad-hoc percentile
+    math outside telemetry/ — consumers observe into a shared histogram
+    and read `quantile()` / `summary()["p99"]` back."""
+
+    GRID = 4                       # buckets per octave (2^(1/4) spacing)
+    _IDX_MIN, _IDX_MAX = -480, 480  # clamp: 2^-120 .. 2^120
+
+    __slots__ = ("name", "count", "total", "min", "max", "buckets",
+                 "zero_count", "_lock")
 
     def __init__(self, name: str, lock) -> None:
         self.name = name
@@ -106,19 +127,74 @@ class Histogram:
         self.total = 0.0
         self.min = None
         self.max = None
+        # bucket index -> count; index i covers (2^((i-1)/GRID), 2^(i/GRID)]
         self.buckets: dict[int, int] = {}
+        self.zero_count = 0        # observations <= 0
         self._lock = lock
+
+    @classmethod
+    def bucket_bound(cls, i: int) -> float:
+        """Upper boundary of bucket i (inclusive)."""
+        return 2.0 ** (i / cls.GRID)
+
+    @classmethod
+    def _bucket_index(cls, v: float) -> int:
+        i = math.ceil(cls.GRID * math.log2(v))
+        # A value sitting exactly ON a boundary must land in the bucket
+        # it bounds (le semantics); float log jitter can push it one up.
+        if cls.bucket_bound(i - 1) >= v:
+            i -= 1
+        return max(cls._IDX_MIN, min(cls._IDX_MAX, i))
 
     def observe(self, value: float) -> None:
         v = float(value)
+        if not math.isfinite(v):
+            # A single NaN folded into total would poison sum/mean for
+            # the life of the process (and render an invalid OpenMetrics
+            # `_sum`); +/-inf has no bucket.  Drop non-finite
+            # observations entirely — count and the +Inf bucket stay
+            # equal, the exposition stays parseable.
+            return
         with self._lock:
             self.count += 1
             self.total += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
-            # Bucket by exponent: key e covers [2^e, 2^(e+1)).
-            e = 0 if v <= 0 else max(-64, min(64, math.frexp(v)[1] - 1))
-            self.buckets[e] = self.buckets.get(e, 0) + 1
+            if v <= 0:
+                self.zero_count += 1
+                return
+            i = self._bucket_index(v)
+            self.buckets[i] = self.buckets.get(i, 0) + 1
+
+    def _quantile_locked(self, q: float) -> "float | None":
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        cum = self.zero_count
+        if self.zero_count and rank <= cum:
+            # All we know about the zero bucket is (min, 0]; report the
+            # conservative edge.  (Guarded on a non-empty zero bucket:
+            # q=0 on an all-positive histogram must clamp to the
+            # observed min below, not fabricate a 0.)
+            return min(self.min, 0.0)
+        for i in sorted(self.buckets):
+            n = self.buckets[i]
+            if rank <= cum + n:
+                # Log-linear interpolation inside (lo, hi]: the fixed
+                # boundaries bound the error at half a bucket width.
+                lo, hi = self.bucket_bound(i - 1), self.bucket_bound(i)
+                frac = (rank - cum) / n
+                est = lo * (hi / lo) ** frac
+                # Never report outside the observed range.
+                return min(max(est, self.min), self.max)
+            cum += n
+        return self.max
+
+    def quantile(self, q: float) -> "float | None":
+        """Quantile estimate from the fixed bucket boundaries (None when
+        empty).  q in [0, 1]."""
+        with self._lock:
+            return self._quantile_locked(q)
 
     def summary(self) -> dict:
         with self._lock:
@@ -129,7 +205,34 @@ class Histogram:
                 "min": self.min,
                 "max": self.max,
                 "mean": mean,
+                "p50": self._quantile_locked(0.50),
+                "p99": self._quantile_locked(0.99),
+                "p999": self._quantile_locked(0.999),
             }
+
+    def openmetrics_buckets(self) -> "list[tuple[float, int]]":
+        """Cumulative (le_boundary, count) pairs over the non-empty
+        bucket range, ending with (inf, count) — what the OpenMetrics
+        exporter renders as `_bucket{le=...}` lines."""
+        with self._lock:
+            out: list[tuple[float, int]] = []
+            cum = 0
+            if self.zero_count:
+                cum += self.zero_count
+                out.append((0.0, cum))
+            for i in sorted(self.buckets):
+                cum += self.buckets[i]
+                out.append((self.bucket_bound(i), cum))
+            out.append((math.inf, self.count))
+            return out
+
+    def openmetrics_snapshot(self) -> "tuple[dict, list[tuple[float, int]]]":
+        """(summary, cumulative buckets) read under ONE lock
+        acquisition, so `_count` and the `+Inf` bucket cannot disagree
+        when an observe lands mid-scrape — the OpenMetrics invariant the
+        exporter's exposition must hold."""
+        with self._lock:          # RLock: the nested reads re-enter
+            return self.summary(), self.openmetrics_buckets()
 
 
 class _Span:
@@ -178,6 +281,7 @@ class Recorder:
         self.events: deque = deque(maxlen=max_events)
         self.counters: dict[str, Counter] = {}
         self.histograms: dict[str, Histogram] = {}
+        self.gauges: dict[str, float] = {}
         self._journal = journal
         self._journal_spans = journal_spans and journal is not None
         self._tls = threading.local()
@@ -233,14 +337,30 @@ class Recorder:
                 h = self.histograms[name] = Histogram(name, self._lock)
             return h
 
+    def gauge(self, name: str, value: float) -> None:
+        """Set a point-in-time gauge (last write wins) — what the
+        roofline layer publishes utilization through and the OpenMetrics
+        exporter renders as `gauge` metrics."""
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def journal_record(self, record: dict, sync: bool = False) -> None:
+        """Append an arbitrary record to the bound journal (no-op when
+        none is bound) — the hook telemetry layers (roofline) use to
+        land their own record kinds next to spans."""
+        if self._journal is not None:
+            self._journal.append(record, sync=sync)
+
     def snapshot(self) -> dict:
-        """JSON-safe aggregate view (counters + histogram summaries)."""
+        """JSON-safe aggregate view (counters + histogram summaries +
+        gauges)."""
         with self._lock:
             return {
                 "counters": {n: c.value for n, c in self.counters.items()},
                 "histograms": {
                     n: h.summary() for n, h in self.histograms.items()
                 },
+                "gauges": dict(self.gauges),
             }
 
     # -- Chrome trace-event export --------------------------------------

@@ -258,6 +258,16 @@ def _patch_phases(bench, monkeypatch):
                          "projected_dispatches_400k": 7,
                          "calibration": {"break_even": 4096}},
     )
+    monkeypatch.setattr(
+        bench, "bench_serving_slo",
+        lambda *a, **k: {
+            "n_events": 4096, "offered_eps": 4000.0,
+            "poisson": {"sustained_eps": 3900.0, "p50_ms": 6.0,
+                        "p99_ms": 18.0, "p999_ms": 25.0},
+            "bursty": {"sustained_eps": 3800.0, "p50_ms": 4.0,
+                       "p99_ms": 30.0, "p999_ms": 55.0},
+        },
+    )
 
 
 def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
@@ -286,6 +296,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "dns_scoring",
         "flow_scoring",
         "scoring_e2e",
+        "serving_slo",
         "pipeline_e2e",
         "pipeline_e2e_dns",
     }

@@ -21,9 +21,11 @@ from test_features import dns_row, flow_row
 
 
 def _stages(metrics):
-    """Pipeline-stage names in order, without the run-level `plans`
-    accounting record run_pipeline appends after the stages."""
-    return [m["stage"] for m in metrics if m["stage"] != "plans"]
+    """Pipeline-stage names in order, without the run-level `plans` /
+    `roofline` accounting records run_pipeline appends after the
+    stages."""
+    return [m["stage"] for m in metrics
+            if m["stage"] not in ("plans", "roofline")]
 
 
 def test_dns_parquet_source(tmp_path):

@@ -524,3 +524,86 @@ def test_no_hardcoded_tuned_constants_outside_plans():
         "(route the value through config or a plans.resolve lookup):\n"
         + "\n".join(offenders)
     )
+
+
+# ---------------------------------------------------------------------------
+# quantile lint (roofline/SLO plane: one histogram, one estimator)
+# ---------------------------------------------------------------------------
+
+
+def _pkg_root() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "oni_ml_tpu",
+    )
+
+
+def test_no_adhoc_percentile_math_outside_telemetry():
+    """Grep-lint: no module under oni_ml_tpu/ outside telemetry/ does
+    its own quantile math (np.percentile / np.quantile /
+    statistics.quantiles).  Latency quantiles must come from the shared
+    fixed-boundary histogram (telemetry/spans.Histogram.quantile) so
+    p50/p99/p999 mean the same thing in every record, bench payload,
+    and OpenMetrics scrape."""
+    needles = ("np.percentile", "numpy.percentile", "np.quantile",
+               "numpy.quantile", "statistics.quantiles")
+    pkg = _pkg_root()
+    offenders = []
+    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
+        rel = os.path.relpath(path, pkg)
+        if rel.startswith("telemetry/"):
+            continue
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                code = line.split("#")[0]
+                if any(n in code for n in needles):
+                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "ad-hoc percentile math outside telemetry/ (observe into a "
+        "shared Histogram and read .quantile()/summary() back):\n"
+        + "\n".join(offenders)
+    )
+
+
+# ---------------------------------------------------------------------------
+# roofline jit-coverage lint
+# ---------------------------------------------------------------------------
+
+
+def test_every_jit_entry_point_file_is_harvest_covered():
+    """Grep-lint: every file under oni_ml_tpu/ that creates a
+    `jax.jit(` entry point must be registered in
+    telemetry.roofline.HARVEST_COVERAGE — either naming how its
+    programs are cost-analysis-harvested or why they are exempt.  A new
+    jit site in an unregistered file fails here, so the roofline's
+    phase coverage cannot silently rot as kernels are added."""
+    from oni_ml_tpu.telemetry.roofline import HARVEST_COVERAGE
+
+    pkg = _pkg_root()
+    uncovered = []
+    jit_files = set()
+    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
+        rel = os.path.relpath(path, pkg)
+        if rel.startswith("telemetry/roofline"):
+            continue  # the registry itself
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                # Both call form (`jax.jit(...)`) and decorator form
+                # (`@partial(jax.jit, ...)`); docstring mentions count
+                # too — coverage notes are cheap, silent gaps are not.
+                if "jax.jit" in line.split("#")[0]:
+                    jit_files.add(rel)
+                    if rel not in HARVEST_COVERAGE:
+                        uncovered.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not uncovered, (
+        "jax.jit entry point in a file not registered for cost-analysis "
+        "harvest (add the file to telemetry/roofline.py "
+        "HARVEST_COVERAGE, naming the harvest hook or the exemption):\n"
+        + "\n".join(uncovered)
+    )
+    # ...and the registry carries no stale entries for files that no
+    # longer hold a jit site (drift cuts both ways).
+    stale = set(HARVEST_COVERAGE) - jit_files
+    assert not stale, (
+        f"HARVEST_COVERAGE names files with no jax.jit( site: {stale}"
+    )

@@ -1,0 +1,275 @@
+"""Poisson + bursty load generator for the serving SLO plane.
+
+Two uses:
+
+1. **In-process harness** (`run_slo`, what `bench.py serving_slo`
+   calls): build a synthetic day, stand up the real serving stack
+   (ModelRegistry -> BatchScorer), replay a timed arrival schedule
+   against it, and measure per-event enqueue->resolved latency into a
+   shared telemetry histogram — sustained events/s and true
+   p50/p99/p999 come back off the fixed bucket boundaries
+   (telemetry/spans.Histogram), the same estimator the OpenMetrics
+   endpoint serves.
+2. **Stream mode** (`--emit-lines`): pace raw CSV event lines to
+   stdout under the chosen arrival pattern, for piping into a real
+   `ml_ops serve --metrics-port PORT` and scraping the endpoint live.
+
+Arrival patterns:
+
+- `poisson` — exponential inter-arrival gaps at the offered rate; the
+  memoryless open-loop model of independent event sources.
+- `bursty`  — on/off bursts: `burst_len` events arrive back-to-back,
+  burst heads spaced so the LONG-RUN average equals the offered rate.
+  Same throughput, pathological queue spikes — the pattern that
+  separates a p50-tuned batcher from one with a p999.
+
+Latency is measured enqueue -> future-resolved by a FIFO collector
+thread (flushes resolve in order, so waiting in submit order wakes
+promptly after each resolution).  A submit that falls behind schedule
+is NOT dropped — the backlog shows up as latency, exactly like a real
+overloaded ingest.
+
+Usage:
+
+    python tools/load_gen.py --pattern both --events 4096 --rate 2000
+    python tools/load_gen.py --pattern bursty --emit-lines --events 10000 \
+        --rate 500 | python -m oni_ml_tpu.runner.ml_ops serve ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+PATTERNS = ("poisson", "bursty")
+
+
+def arrival_offsets(pattern: str, n: int, rate_eps: float, *,
+                    seed: int = 0, burst_len: int = 64) -> np.ndarray:
+    """Arrival times in seconds from stream start, length n,
+    long-run-averaging `rate_eps` events/s under either pattern."""
+    if rate_eps <= 0:
+        raise ValueError(f"rate_eps must be > 0, got {rate_eps}")
+    if pattern == "poisson":
+        rng = np.random.default_rng(seed)
+        return np.cumsum(rng.exponential(1.0 / rate_eps, size=n))
+    if pattern == "bursty":
+        # Burst heads at burst_len/rate intervals; every event in a
+        # burst arrives at its head (zero intra-burst gap).
+        bl = max(1, int(burst_len))
+        heads = np.arange(-(-n // bl), dtype=np.float64) * (bl / rate_eps)
+        return np.repeat(heads, bl)[:n]
+    raise ValueError(f"unknown pattern {pattern!r} (want {PATTERNS})")
+
+
+def run_load(scorer, raws, offsets: np.ndarray, *, recorder=None,
+             pattern: str = "load", timeout_s: float = 120.0) -> dict:
+    """Replay `raws` against a BatchScorer at `offsets`' schedule and
+    return the measured SLO numbers.  Latencies observe into the shared
+    histogram `loadgen.<pattern>.latency_ms` on `recorder` (a private
+    Recorder when none given) — quantiles come off its fixed bucket
+    boundaries, per the telemetry lint."""
+    from oni_ml_tpu.telemetry.spans import Recorder
+
+    rec = recorder or Recorder()
+    hist = rec.histogram(f"loadgen.{pattern}.latency_ms")
+    n = len(raws)
+    fifo: list = [None] * n
+    done = threading.Event()
+    state = {"resolved": 0, "errors": 0, "t_last": None}
+
+    def collect():
+        for i in range(n):
+            while fifo[i] is None:           # producer not there yet
+                if done.wait(0.0005):
+                    if fifo[i] is None:      # producer gave up
+                        return
+                    break
+            fut, t_submit = fifo[i]
+            try:
+                fut.result(timeout=timeout_s)
+                t_now = time.perf_counter()
+                state["t_last"] = t_now
+                hist.observe((t_now - t_submit) * 1e3)
+                state["resolved"] += 1
+            except Exception:
+                state["errors"] += 1
+
+    collector = threading.Thread(target=collect, name="loadgen-collect",
+                                 daemon=True)
+    collector.start()
+    t0 = time.perf_counter()
+    behind_s = 0.0
+    try:
+        for i, raw in enumerate(raws):
+            target = t0 + offsets[i]
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            else:
+                behind_s = max(behind_s, now - target)
+            t_submit = time.perf_counter()
+            fut = scorer.submit(raw)
+            fifo[i] = (fut, t_submit)
+        scorer.flush()
+    finally:
+        # Unconditionally release the collector: a submit that raises
+        # mid-replay (scorer closed underneath us, featurizer error)
+        # must not leave the daemon thread spinning on an unfilled slot
+        # for the life of the process.
+        done.set()
+        collector.join(timeout=timeout_s + 30.0)
+    wall = (state["t_last"] or time.perf_counter()) - t0
+    s = hist.summary()
+    # A single-burst schedule has every offset at 0 (span 0): the
+    # offered rate is then unmeasurable from the schedule, not a
+    # nonsense n/epsilon number.
+    span = float(offsets[-1]) if n else 0.0
+    return {
+        "pattern": pattern,
+        "events": n,
+        "offered_eps": round(n / span, 1) if span > 0 else None,
+        "sustained_eps": round(state["resolved"] / wall, 1) if wall > 0
+        else None,
+        "wall_s": round(wall, 3),
+        "resolved": state["resolved"],
+        "errors": state["errors"],
+        "max_sched_lag_s": round(behind_s, 3),
+        "p50_ms": s["p50"] and round(s["p50"], 3),
+        "p99_ms": s["p99"] and round(s["p99"], 3),
+        "p999_ms": s["p999"] and round(s["p999"], 3),
+        "mean_ms": s["mean"] and round(s["mean"], 3),
+        "max_ms": s["max"] and round(s["max"], 3),
+    }
+
+
+def _stack(n_events: int, *, max_batch: int, max_wait_ms: float,
+           device_score_min):
+    """Synthetic day + the real serving stack over it (the dry-run
+    day generator of runner/serve.py at load-test size; the day is
+    deterministic — `--seed` varies the arrival schedule only)."""
+    from oni_ml_tpu.config import ServingConfig
+    from oni_ml_tpu.runner.serve import _synthetic_day
+    from oni_ml_tpu.serving import (
+        BatchScorer,
+        DnsEventFeaturizer,
+        ModelRegistry,
+    )
+
+    rows, model, cuts = _synthetic_day(
+        n_events=n_events, n_clients=64, n_doms=16
+    )
+    registry = ModelRegistry()
+    registry.publish(model, source="load-gen-synthetic")
+    cfg = ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        device_score_min=device_score_min,
+    )
+    scorer = BatchScorer(registry, DnsEventFeaturizer(cuts), cfg)
+    return rows, scorer
+
+
+def run_slo(patterns=PATTERNS, *, n_events: int = 4096,
+            rate_eps: float = 4000.0, burst_len: int = 64,
+            max_batch: int = 256, max_wait_ms: float = 10.0,
+            device_score_min=0, seed: int = 0, recorder=None) -> dict:
+    """The serving_slo measurement: one fresh BatchScorer per arrival
+    pattern (a clean queue — pattern B must not inherit pattern A's
+    backlog), same synthetic day, same offered rate."""
+    out: dict = {
+        "n_events": n_events,
+        "offered_eps": rate_eps,
+        "burst_len": burst_len,
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+    }
+    for pattern in patterns:
+        rows, scorer = _stack(
+            n_events, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            device_score_min=device_score_min,
+        )
+        offsets = arrival_offsets(pattern, len(rows), rate_eps,
+                                  seed=seed, burst_len=burst_len)
+        try:
+            out[pattern] = run_load(scorer, rows, offsets,
+                                    pattern=pattern, recorder=recorder)
+        finally:
+            scorer.close()
+    return out
+
+
+def emit_lines(pattern: str, n_events: int, rate_eps: float, *,
+               burst_len: int = 64, seed: int = 0, out=sys.stdout) -> int:
+    """Stream mode: pace raw CSV lines to `out` under the pattern —
+    feedstock for a real `ml_ops serve` behind a pipe."""
+    from oni_ml_tpu.runner.serve import _synthetic_day
+
+    rows, _, _ = _synthetic_day(n_events=n_events, n_clients=64,
+                                n_doms=16)
+    offsets = arrival_offsets(pattern, len(rows), rate_eps, seed=seed,
+                              burst_len=burst_len)
+    t0 = time.perf_counter()
+    for i, row in enumerate(rows):
+        target = t0 + offsets[i]
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        out.write(",".join(row) + "\n")
+        out.flush()
+    return len(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Poisson/bursty load generator for the serving SLO "
+        "bench (in-process harness or paced stdout stream)."
+    )
+    ap.add_argument("--pattern", choices=PATTERNS + ("both",),
+                    default="both")
+    ap.add_argument("--events", type=int, default=4096)
+    ap.add_argument("--rate", type=float, default=4000.0,
+                    metavar="EVENTS_PER_SEC")
+    ap.add_argument("--burst-len", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=10.0)
+    ap.add_argument("--host-only", action="store_true",
+                    help="pin the host scorer (skip the device "
+                    "dispatch calibration)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--emit-lines", action="store_true",
+                    help="pace raw CSV lines to stdout instead of "
+                    "running the in-process harness (pipe into "
+                    "`ml_ops serve`); requires a single --pattern")
+    args = ap.parse_args(argv)
+    if args.emit_lines:
+        if args.pattern == "both":
+            print("load_gen: --emit-lines needs a single --pattern",
+                  file=sys.stderr)
+            return 2
+        n = emit_lines(args.pattern, args.events, args.rate,
+                       burst_len=args.burst_len, seed=args.seed)
+        print(f"load_gen: emitted {n} events", file=sys.stderr)
+        return 0
+    patterns = PATTERNS if args.pattern == "both" else (args.pattern,)
+    res = run_slo(
+        patterns, n_events=args.events, rate_eps=args.rate,
+        burst_len=args.burst_len, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        device_score_min=None if args.host_only else 0,
+        seed=args.seed,
+    )
+    print(json.dumps(res), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

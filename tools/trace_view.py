@@ -115,6 +115,36 @@ def journal_to_trace(records: "list[dict]") -> dict:
                     if k in rec
                 },
             })
+            # Liveness as a counter LANE too: probe latency plotted over
+            # time makes backend degradation visible as a rising curve
+            # long before the MISS instants start.
+            if rec.get("ok") and isinstance(
+                rec.get("latency_s"), (int, float)
+            ):
+                events.append({
+                    "name": "heartbeat latency_ms", "ph": "C",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"latency_ms": rec["latency_s"] * 1e3},
+                })
+        elif kind == "roofline":
+            # Utilization counter lanes: one track per roofline phase,
+            # mxu/hbm percent (TPU) or achieved GFLOP/s (no-peaks
+            # backends) — rendered alongside the stage spans so "how
+            # far from the hardware" lines up with "where the time
+            # went".
+            phase = rec.get("phase", "?")
+            util = rec.get("utilization") or {}
+            args = {k: util[k] for k in ("mxu_pct", "hbm_pct")
+                    if isinstance(util.get(k), (int, float))}
+            if not args and isinstance(
+                rec.get("flops_per_s"), (int, float)
+            ):
+                args = {"gflops_per_s": rec["flops_per_s"] / 1e9}
+            if args:
+                events.append({
+                    "name": f"roofline {phase}", "ph": "C",
+                    "ts": us(ns), "pid": pid, "tid": 0, "args": args,
+                })
         elif kind == "backend_lost":
             events.append({
                 "name": "BACKEND LOST", "ph": "i", "s": "g",
@@ -177,6 +207,25 @@ def print_summary(records: "list[dict]", dropped: int,
         print(f"em likelihood: {len(lls)} points, "
               f"iter {lls[0].get('iter')} -> {lls[-1].get('iter')}, "
               f"final ll {lls[-1].get('ll')}", file=out)
+    rl = [r for r in records if r.get("kind") == "roofline"]
+    if rl:
+        print("roofline (last record per phase):", file=out)
+        last = {r.get("phase", "?"): r for r in rl}
+        for phase in sorted(last):
+            r = last[phase]
+            util = r.get("utilization") or {}
+            if util:
+                detail = ", ".join(
+                    f"{k}={util[k]}" for k in ("mxu_pct", "hbm_pct")
+                    if k in util
+                )
+            elif isinstance(r.get("flops_per_s"), (int, float)):
+                detail = (f"{r['flops_per_s'] / 1e9:.2f} GFLOP/s "
+                          "(no peaks for backend)")
+            else:
+                detail = "wall-time only (no cost analysis)"
+            print(f"  {phase:<28} wall {r.get('wall_s', 0):>8.3f}s  "
+                  f"x{r.get('dispatches', 1):<5} {detail}", file=out)
     if not rows:
         print("no stage records", file=out)
         return
