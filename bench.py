@@ -1710,6 +1710,29 @@ def main() -> int:
     _COMPLETED_PHASES.clear()   # tests drive main() repeatedly in-process
     _open_bench_journal()
     _install_sigterm_salvage(record)
+    # Lint preflight: a bench round on a tree that fails the static
+    # gate (oni_ml_tpu/analysis — retrace hazards, unlocked shared
+    # state, schema drift) measures code CI would reject; abort before
+    # spending a second of grant time.  BENCH_LINT=0 opts out (e.g.
+    # measuring a deliberately dirty work-in-progress tree).
+    if os.environ.get("BENCH_LINT", "1") != "0":
+        from oni_ml_tpu.analysis import run_analysis
+
+        lint = run_analysis()
+        if not lint.ok:
+            for f in lint.findings:
+                print(f"bench: lint: {f.format()}", file=sys.stderr)
+            for path, msg in lint.parse_errors:
+                print(f"bench: lint: {path}: parse error: {msg}",
+                      file=sys.stderr)
+            _emit_failure(
+                f"lint preflight failed: {sum(lint.counts().values())} "
+                f"finding(s) {lint.counts()}, "
+                f"{len(lint.parse_errors)} parse error(s) — run "
+                "`python tools/graftlint.py`, or BENCH_LINT=0 to "
+                "measure anyway"
+            )
+            return 1
     # Optional journaled liveness heartbeat (BENCH_HEARTBEAT_S=interval):
     # probes via the same subprocess-isolated device-count probe the
     # grant watcher trusts — the orchestrator itself never touches the

@@ -1,0 +1,38 @@
+"""AST-based static analysis for TPU-hostile patterns, lock discipline,
+and journal-schema drift.
+
+Before this package the repo's correctness lints were four ad-hoc
+grep passes buried in tests/test_telemetry.py — line-oriented, blind to
+syntax (a docstring mention of `jax.jit` counted as an entry point),
+and each with its own hand-rolled allowlist mechanism.  This package is
+the one enforcement path:
+
+- `engine.py` — parses every source file once (`ast`), runs a registry
+  of typed rules over the parsed modules, honors per-line
+  `# lint: ok(rule-id, reason)` suppressions and the committed
+  `baseline.json`, and reports findings with file:line, rule id, and a
+  one-line fix hint.
+- `rules.py` — the rule catalog (docs/analysis.md documents each):
+  the four migrated grep-lints (monotonic-clock, tuned-constant,
+  quantile, harvest-coverage — now AST-accurate) plus retrace-hazard,
+  hidden-host-sync, lock-discipline, journal-schema, journal-docs.
+- `schema.py` — static extraction of every journal record kind and its
+  field set from the package source; `schema/journal_schema.json` is
+  the committed contract the journal-schema rule diffs against.
+- `cli.py` — `ml_ops lint` / `tools/graftlint.py` / the
+  `oni-graftlint` console script: human output or `--json`, exit 1 on
+  findings, `--update-schema` / `--update-baseline` regeneration.
+
+Nothing here imports jax or numpy: the lint must run on any box CI
+gives it, in a few seconds at most.
+"""
+
+from .engine import (  # noqa: F401
+    AnalysisContext,
+    Finding,
+    ParsedModule,
+    Report,
+    Rule,
+    run_analysis,
+)
+from .rules import default_rules  # noqa: F401

@@ -1,0 +1,182 @@
+"""graftlint — the command-line face of the analysis engine.
+
+One implementation behind three equivalent launchers (so the lint runs
+identically in and out of pytest, in CI, and on an operator box):
+
+    python tools/graftlint.py [...]     # source checkout
+    oni-ml-ops lint [...]               # the runner CLI
+    oni-graftlint [...]                 # pyproject console script
+
+Exit codes: 0 clean, 1 findings (or unparseable files), 2 usage.
+`--json` emits the Report dict for CI; `--update-schema` and
+`--update-baseline` regenerate the two committed artifacts after an
+intentional change, and exit 0 without linting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (
+    baseline_path,
+    parse_modules,
+    repo_root,
+    run_analysis,
+)
+from .rules import default_rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "AST lint for TPU-hostile patterns, lock discipline, and "
+            "journal-schema drift (oni_ml_tpu.analysis)"
+        ),
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="repo root to scan (default: the checkout this package "
+             "is imported from)",
+    )
+    p.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full report as JSON (CI mode)",
+    )
+    p.add_argument(
+        "--rule", action="append", default=None, metavar="RULE_ID",
+        help="run only the named rule(s); repeatable",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog (id + description) and exit",
+    )
+    p.add_argument(
+        "--update-schema", action="store_true",
+        help="regenerate analysis/schema/journal_schema.json from the "
+             "source and exit (after an INTENTIONAL vocabulary change; "
+             "update docs/observability.md's table too)",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite analysis/baseline.json to grandfather every "
+             "current finding (adoption aid — the baseline should only "
+             "shrink afterwards)",
+    )
+    return p
+
+
+def _selected_rules(names: "list[str] | None"):
+    rules = default_rules()
+    if not names:
+        return rules
+    by_id = {r.id: r for r in rules}
+    unknown = [n for n in names if n not in by_id]
+    if unknown:
+        raise SystemExit(
+            f"graftlint: unknown rule id(s) {unknown}; "
+            f"known: {sorted(by_id)}"
+        )
+    return [by_id[n] for n in names]
+
+
+def _update_schema(root: str) -> int:
+    import os
+
+    from . import schema as schema_mod
+    from .rules import JournalSchemaRule
+
+    modules, errors = parse_modules(root)
+    if errors:
+        for rel, msg in errors:
+            print(f"graftlint: cannot parse {rel}: {msg}",
+                  file=sys.stderr)
+        return 1
+    path = schema_mod.write_schema(
+        schema_mod.extract_schema(modules),
+        os.path.join(root, JournalSchemaRule.SCHEMA_REL),
+    )
+    print(f"graftlint: wrote {path}")
+    print("graftlint: if kinds or fields changed, sync the record "
+          "table in docs/observability.md (the journal-docs rule "
+          "checks kinds; the table is the narrative copy)")
+    return 0
+
+
+def _update_baseline(root: str) -> int:
+    import os
+
+    # Run WITHOUT the existing baseline so current entries are
+    # re-derived, not stacked.  suppression-format is never
+    # grandfathered: a reasonless suppression must be fixed, or the
+    # escape hatch becomes a blanket off switch.
+    report = run_analysis(root=root, baseline=[])
+    counts: dict = {}
+    for f in report.findings:
+        if f.rule in ("stale-baseline", "suppression-format"):
+            continue
+        counts[(f.rule, f.path)] = counts.get((f.rule, f.path), 0) + 1
+    entries = [
+        {"rule": rule, "path": path, "count": n}
+        for (rule, path), n in sorted(counts.items())
+    ]
+    payload = {
+        "_comment": (
+            "Grandfathered findings (rule x path x count) the lint "
+            "tolerates while adoption catches up.  Entries matching "
+            "nothing are themselves flagged stale, so this file can "
+            "only shrink.  Regenerate with "
+            "`python tools/graftlint.py --update-baseline`."
+        ),
+        "entries": entries,
+    }
+    path = baseline_path(root)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    print(f"graftlint: wrote {path} ({len(entries)} entries)")
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = args.root or repo_root()
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.id:20s} {rule.description}")
+        return 0
+    if args.update_schema:
+        return _update_schema(root)
+    if args.update_baseline:
+        return _update_baseline(root)
+
+    rules = _selected_rules(args.rule)
+    report = run_analysis(root=root, rules=rules)
+    if args.as_json:
+        print(json.dumps(report.as_dict(), indent=2))
+        return 0 if report.ok else 1
+
+    for rel, msg in report.parse_errors:
+        print(f"{rel}:0: [parse-error] {msg}")
+    for f in report.findings:
+        print(f.format())
+    tail = (
+        f"graftlint: {len(report.findings)} finding(s) across "
+        f"{report.files_scanned} files"
+        f" ({report.suppressed} suppressed, {report.baselined} "
+        "baselined)"
+    )
+    print(tail if not report.ok else
+          f"graftlint: clean — {report.files_scanned} files, "
+          f"{len(rules)} rules"
+          f" ({report.suppressed} suppressed, {report.baselined} "
+          "baselined)")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

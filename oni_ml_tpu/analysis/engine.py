@@ -1,0 +1,395 @@
+"""The rule engine: parse once, run every rule, filter suppressions
+and the committed baseline, report findings.
+
+Design contract (what every rule can rely on):
+
+- Each scanned file becomes ONE `ParsedModule` (source text, ast tree
+  with parent links, per-line suppressions) — rules never re-read or
+  re-parse files, so the whole run is one parse pass over ~100 files.
+- `Rule.check(mod, ctx)` yields per-module findings;
+  `Rule.finalize(ctx)` yields whole-program findings after every
+  module has been seen (coverage diffs, schema drift).
+- Suppression is per-line and must carry a reason:
+  `# lint: ok(rule-id, reason)` on the offending line (or on its own
+  line directly above it).  A reasonless suppression does not
+  suppress — it is itself reported (`suppression-format`), so the
+  escape hatch cannot silently become a blanket off switch.
+- The baseline (`baseline.json` next to this module) grandfathers
+  pre-existing findings as {"rule", "path", "count"} entries so
+  adoption is incremental; entries matching nothing are reported as
+  stale (`stale-baseline`) — the baseline can only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# Scan roots, relative to the repo root.  A pip-installed package has
+# no tools/bench.py; missing roots are skipped, the package root is
+# required.
+SCAN_ROOTS = ("oni_ml_tpu", "tools", "bench.py")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*ok\(\s*([A-Za-z0-9_*-]+)\s*(?:,\s*([^)#]*?))?\s*\)"
+)
+
+
+def repo_root() -> str:
+    """The checkout root: two levels above this file's package dir."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def baseline_path(root: "str | None" = None) -> str:
+    """The committed baseline for `root` (default: this checkout)."""
+    if root is not None:
+        return os.path.join(root, "oni_ml_tpu", "analysis",
+                            "baseline.json")
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One reported violation."""
+
+    rule: str
+    path: str        # repo-root-relative, posix separators
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        hint = f"  (fix: {self.hint})" if self.hint else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{hint}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint}
+
+
+class ParsedModule:
+    """One parsed source file: tree with parent links, raw lines, and
+    the suppression map {line_number: {rule_id_or_*: reason}}."""
+
+    def __init__(self, path: str, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._parent = node  # type: ignore[attr-defined]
+        self.suppressions: dict[int, dict[str, str]] = {}
+        self.bad_suppressions: list[int] = []
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        # Only real COMMENT tokens can suppress: scanning raw line text
+        # would let a string literal containing the marker (a hint
+        # message, a doc example) silently mask findings on its line.
+        comments: list[tuple[int, int, str]] = []
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((*tok.start, tok.string))
+        except (tokenize.TokenError, IndentationError):
+            return  # ast parsed it; a tokenize hiccup just means
+            #         no suppressions in this file
+        for lineno, col, text in comments:
+            matches = list(_SUPPRESS_RE.finditer(text))
+            if not matches:
+                continue
+            # A suppression on its own comment line covers the next
+            # CODE line (for statements too long to carry a trailing
+            # comment) — skipping over further comment lines so two
+            # stacked own-line suppressions land on the same statement;
+            # a trailing comment covers its own line.
+            if not self.lines[lineno - 1][:col].strip():
+                target = lineno + 1
+                while (target <= len(self.lines)
+                       and self.lines[target - 1].lstrip().startswith("#")):
+                    target += 1
+            else:
+                target = lineno
+            for m in matches:
+                rule_id, reason = m.group(1), (m.group(2) or "").strip()
+                if not reason:
+                    self.bad_suppressions.append(lineno)
+                    continue
+                self.suppressions.setdefault(target, {})[rule_id] = reason
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        entry = self.suppressions.get(line)
+        return bool(entry) and (rule_id in entry or "*" in entry)
+
+
+class Rule:
+    """Base rule.  Subclasses set `id`/`description`/`hint` and
+    implement `check` (per module) and/or `finalize` (whole program)."""
+
+    id: str = ""
+    description: str = ""
+    hint: str = ""
+
+    def check(self, mod: ParsedModule, ctx: "AnalysisContext"):
+        return ()
+
+    def finalize(self, ctx: "AnalysisContext"):
+        return ()
+
+    def finding(self, mod_or_rel, line: int, message: str,
+                hint: str = "") -> Finding:
+        rel = mod_or_rel.rel if isinstance(mod_or_rel, ParsedModule) \
+            else mod_or_rel
+        return Finding(self.id, rel, line, message, hint or self.hint)
+
+
+@dataclass
+class AnalysisContext:
+    root: str
+    modules: list = field(default_factory=list)
+    # Scratch space rules share within one run (e.g. the extracted
+    # journal schema, so the two journal rules walk the ASTs once).
+    cache: dict = field(default_factory=dict)
+
+    def module(self, rel: str) -> "ParsedModule | None":
+        for m in self.modules:
+            if m.rel == rel:
+                return m
+        return None
+
+
+@dataclass
+class Report:
+    findings: list          # surviving findings, sorted
+    suppressed: int         # findings silenced by inline suppressions
+    baselined: int          # findings silenced by baseline entries
+    files_scanned: int
+    parse_errors: list      # [(rel, message)] — unparseable files
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "parse_errors": [
+                {"path": p, "message": m} for p, m in self.parse_errors
+            ],
+            "counts": self.counts(),
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+    def counts(self) -> dict:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+
+def iter_source_files(root: str):
+    """(abs_path, rel) for every scanned .py file, sorted for stable
+    output."""
+    out = []
+    for entry in SCAN_ROOTS:
+        top = os.path.join(root, entry)
+        if os.path.isfile(top):
+            out.append((top, entry))
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                out.append((path, rel))
+    return sorted(out, key=lambda t: t[1])
+
+
+def parse_modules(root: str):
+    """(modules, parse_errors) over every scanned file."""
+    modules: list[ParsedModule] = []
+    errors: list[tuple[str, str]] = []
+    for path, rel in iter_source_files(root):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            modules.append(ParsedModule(path, rel, source))
+        except (SyntaxError, ValueError, UnicodeDecodeError,
+                OSError) as e:
+            # ValueError: ast.parse raises it (not SyntaxError) for
+            # e.g. null bytes in the source — still a parse error, not
+            # a reason to crash the gate.
+            errors.append((rel, f"{type(e).__name__}: {e}"))
+    return modules, errors
+
+
+def load_baseline(path: "str | None" = None,
+                  root: "str | None" = None) -> list:
+    path = path or baseline_path(root)
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("entries", []))
+
+
+def run_analysis(root: "str | None" = None, rules=None,
+                 baseline: "list | None" = None) -> Report:
+    """Parse the repo, run every rule, apply suppressions + baseline."""
+    from .rules import default_rules
+
+    root = root or repo_root()
+    rules = rules if rules is not None else default_rules()
+    if baseline is None:
+        baseline = load_baseline(root=root)
+    modules, parse_errors = parse_modules(root)
+    # A gate that scans nothing must not report clean: a bad --root /
+    # wrong cwd / renamed checkout would otherwise pass CI while
+    # linting zero files.  The package root is required.
+    if not any(m.rel.startswith("oni_ml_tpu/") for m in modules):
+        parse_errors.append((
+            "oni_ml_tpu",
+            f"scan root {root!r} contains no oni_ml_tpu/ package "
+            "files — nothing was linted (wrong --root or cwd?)",
+        ))
+    ctx = AnalysisContext(root=root, modules=modules)
+
+    raw: list[Finding] = []
+    for rule in rules:
+        for mod in modules:
+            raw.extend(rule.check(mod, ctx))
+        raw.extend(rule.finalize(ctx))
+    for mod in modules:
+        for lineno in mod.bad_suppressions:
+            raw.append(Finding(
+                "suppression-format", mod.rel, lineno,
+                "suppression without a reason does not suppress",
+                "write `# lint: ok(rule-id, why this line is fine)`",
+            ))
+
+    survivors: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        mod = ctx.module(f.path)
+        if mod is not None and f.rule != "suppression-format" \
+                and mod.suppressed(f.rule, f.line):
+            suppressed += 1
+            continue
+        survivors.append(f)
+
+    # Baseline: each entry absorbs up to `count` findings of (rule,
+    # path); entries that absorb nothing are stale and reported.
+    # Entries for rules NOT in this run (a `--rule` subset) are left
+    # alone: they had no chance to match, so they are neither budget
+    # nor stale.
+    ran_rules = {r.id for r in rules} | {"suppression-format"}
+    baselined = 0
+    remaining: list[Finding] = []
+    budget = {(e["rule"], e["path"]): int(e.get("count", 1))
+              for e in baseline if e["rule"] in ran_rules}
+    used = {k: 0 for k in budget}
+    for f in sorted(survivors, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.rule, f.path)
+        if budget.get(key, 0) > used.get(key, 0):
+            used[key] += 1
+            baselined += 1
+            continue
+        remaining.append(f)
+    for (rule, path), allowed in budget.items():
+        if used[(rule, path)] == 0:
+            remaining.append(Finding(
+                "stale-baseline", path, 0,
+                f"baseline entry for rule {rule!r} matched no finding",
+                "delete the entry from analysis/baseline.json",
+            ))
+
+    remaining.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(
+        findings=remaining,
+        suppressed=suppressed,
+        baselined=baselined,
+        files_scanned=len(modules),
+        parse_errors=parse_errors,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers rules lean on
+# ---------------------------------------------------------------------------
+
+
+def parent(node: ast.AST) -> "ast.AST | None":
+    return getattr(node, "_parent", None)
+
+
+def ancestors(node: ast.AST):
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """`a.b.c` for Name/Attribute chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_function(node: ast.AST) -> "ast.AST | None":
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return a
+    return None
+
+
+def in_loop(node: ast.AST) -> bool:
+    """True when `node` sits inside a For/While statement body (without
+    crossing a nested function boundary — a closure defined in a loop
+    runs later, not per-iteration here)."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False
+        if isinstance(a, (ast.For, ast.While, ast.AsyncFor)):
+            return True
+    return False
+
+
+def under_span_with(node: ast.AST) -> bool:
+    """True when `node` is inside a `with` whose context manager is a
+    span (`maybe_span(...)` / `<recorder>.span(...)`) — the marker that
+    a host sync is deliberate and flight-recorder-visible."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(a, (ast.With, ast.AsyncWith)):
+            for item in a.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    name = dotted_name(expr.func)
+                    if name == "maybe_span" or name.endswith(".span"):
+                        return True
+    return False

@@ -1,0 +1,208 @@
+"""Static extraction of the journal record vocabulary.
+
+Every journal line the package can emit originates in a dict literal
+carrying a constant `"kind"` key — the `RunJournal` vocabulary methods
+(telemetry/journal.py), the span journal append (telemetry/spans.py),
+the roofline record builder (telemetry/roofline.py), the serving
+metrics sink (serving/metrics.py), and the runner's online-EM progress
+callback (runner/ml_ops.py) — or in a `.annotation("kind", **fields)`
+call (the heartbeat's deep-probe marker).  This module harvests all of
+them from the AST, so the schema the journal-schema rule enforces is
+derived from the code, never hand-listed.
+
+Per kind the extracted entry is:
+
+    {"fields": sorted field names, "open": bool}
+
+`fields` is the union over every emitting site (em_ll carries `conv`
+from batch EM and `rho` from the online driver — both are schema);
+`open` records whether any site splats extra fields (`**info`), i.e.
+whether consumers may see keys beyond the listed set.  The stamp
+fields every `Journal.append` adds (seq / t / mono_ns) are implicit
+and not repeated per kind.
+
+The committed contract lives at `schema/journal_schema.json`; diffing
+extracted-vs-committed is the journal-schema rule's job, and
+`graftlint --update-schema` regenerates the file after an intentional
+vocabulary change.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+# Modules whose dict literals participate in the harvest: the package,
+# minus this analysis layer itself (its fixtures and docs talk ABOUT
+# kinds without emitting them).
+HARVEST_PREFIX = "oni_ml_tpu/"
+HARVEST_EXCLUDE = ("oni_ml_tpu/analysis/",)
+
+
+def schema_file_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "schema", "journal_schema.json")
+
+
+def _const_str(node) -> "str | None":
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _dict_kind_fields(node: ast.Dict):
+    """(kind, fields, open) for a dict literal with a constant "kind"
+    key, else None."""
+    kind = None
+    fields: set[str] = set()
+    open_ = False
+    for key, value in zip(node.keys, node.values):
+        if key is None:          # {**info, ...}
+            open_ = True
+            continue
+        name = _const_str(key)
+        if name is None:
+            return None          # computed key: not a record literal
+        if name == "kind":
+            kind = _const_str(value)
+        else:
+            fields.add(name)
+    if kind is None:
+        return None
+    return kind, fields, open_
+
+
+def _augment_from_local_uses(dict_node: ast.Dict, fields: set,
+                             open_: bool) -> tuple:
+    """When the record literal is assigned to a local name and then
+    grown (`rec["wall_s"] = ...`, `rec.update(info)`) before being
+    appended, fold those additions in.  Scan is scoped to the enclosing
+    function — the pattern stage_end and roofline_record use."""
+    from .engine import enclosing_function, parent
+
+    assign = parent(dict_node)
+    if not (isinstance(assign, ast.Assign) and len(assign.targets) == 1
+            and isinstance(assign.targets[0], ast.Name)):
+        return fields, open_
+    local = assign.targets[0].id
+    fn = enclosing_function(dict_node)
+    if fn is None:
+        return fields, open_
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == local):
+                    key = _const_str(t.slice)
+                    if key is not None and key != "kind":
+                        fields.add(key)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "update"
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id == local):
+            open_ = True
+    return fields, open_
+
+
+def _harvested(rel: str) -> bool:
+    return rel.startswith(HARVEST_PREFIX) and not any(
+        rel.startswith(p) for p in HARVEST_EXCLUDE
+    )
+
+
+def extract_schema(modules) -> dict:
+    """{kind: {"fields": [...], "open": bool}} across the package."""
+    merged: dict[str, dict] = {}
+
+    def add(kind: str, fields: set, open_: bool) -> None:
+        entry = merged.setdefault(kind, {"fields": set(), "open": False})
+        entry["fields"] |= fields
+        entry["open"] = entry["open"] or open_
+
+    for mod in modules:
+        if not _harvested(mod.rel):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Dict):
+                got = _dict_kind_fields(node)
+                if got is None:
+                    continue
+                kind, fields, open_ = got
+                fields, open_ = _augment_from_local_uses(
+                    node, fields, open_
+                )
+                add(kind, fields, open_)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "annotation"
+                  and node.args):
+                kind = _const_str(node.args[0])
+                if kind is None:
+                    continue
+                fields = {kw.arg for kw in node.keywords
+                          if kw.arg is not None}
+                open_ = any(kw.arg is None for kw in node.keywords)
+                add(kind, fields, open_)
+    return {
+        kind: {"fields": sorted(entry["fields"]), "open": entry["open"]}
+        for kind, entry in sorted(merged.items())
+    }
+
+
+def load_schema(path: "str | None" = None) -> dict:
+    path = path or schema_file_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("kinds", {})
+
+
+def write_schema(schema: dict, path: "str | None" = None) -> str:
+    path = path or schema_file_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "_comment": (
+            "Journal record vocabulary, extracted from the package "
+            "source by oni_ml_tpu.analysis.schema.extract_schema.  "
+            "THIS FILE IS AUTHORITATIVE for CI (the journal-schema "
+            "rule fails on any drift); docs/observability.md's table "
+            "is the narrative copy.  Regenerate with "
+            "`python tools/graftlint.py --update-schema` after an "
+            "intentional vocabulary change.  Every record additionally "
+            "carries the Journal.append stamps: seq, t, mono_ns."
+        ),
+        "kinds": schema,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def diff_schema(extracted: dict, committed: dict) -> list:
+    """[(kind, message)] — every way extracted and committed disagree."""
+    out: list[tuple[str, str]] = []
+    for kind in sorted(set(extracted) - set(committed)):
+        out.append((kind, f"new record kind {kind!r} is not in the "
+                    "committed schema"))
+    for kind in sorted(set(committed) - set(extracted)):
+        out.append((kind, f"schema kind {kind!r} is no longer emitted "
+                    "anywhere in the package"))
+    for kind in sorted(set(extracted) & set(committed)):
+        ext, com = extracted[kind], committed[kind]
+        missing = sorted(set(com["fields"]) - set(ext["fields"]))
+        added = sorted(set(ext["fields"]) - set(com["fields"]))
+        if missing:
+            out.append((kind, f"kind {kind!r} dropped field(s) "
+                        f"{missing} still in the committed schema"))
+        if added:
+            out.append((kind, f"kind {kind!r} gained undeclared "
+                        f"field(s) {added}"))
+        if bool(ext.get("open")) != bool(com.get("open")):
+            out.append((kind, f"kind {kind!r} open-record flag changed "
+                        f"to {bool(ext.get('open'))}"))
+    return out

@@ -530,7 +530,12 @@ class LDATrainer:
                                      max_iters=cfg.alpha_max_iters)
                 n_a_disp += 1
 
-            ll = float(total_ll)
+            # The per-iteration convergence read is the stepwise
+            # driver's one deliberate device sync; span it like the
+            # fused driver's em.host_sync so the flight recorder
+            # prices the stall instead of it hiding in iteration wall.
+            with maybe_span("em.host_sync", it=it):
+                ll = float(total_ll)
             conv = self._log_iteration(
                 it, ll, ll_prev, likelihoods, ll_file, progress
             )

@@ -1297,6 +1297,13 @@ def main(argv: list[str] | None = None) -> int:
         from . import serve
 
         return serve.main(argv[1:])
+    # `ml_ops lint ...` is the static-analysis gate (oni_ml_tpu/analysis)
+    # — same engine as tools/graftlint.py and the oni-graftlint console
+    # script; routes before the YYYYMMDD parser like serve.
+    if argv and argv[0] == "lint":
+        from ..analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if len(args.fdate) != 8 or not args.fdate.isdigit():

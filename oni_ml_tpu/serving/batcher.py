@@ -236,11 +236,13 @@ class BatchScorer:
 
     @property
     def events_scored(self) -> int:
-        return self._events_scored
+        with self._cond:
+            return self._events_scored
 
     @property
     def batches_flushed(self) -> int:
-        return self._batch_seq
+        with self._cond:
+            return self._batch_seq
 
     # -- worker side --------------------------------------------------------
 
@@ -313,20 +315,28 @@ class BatchScorer:
         except Exception as e:
             for p in batch:
                 p.future._fail(e)
+            # Counter writes take the queue lock: the worker increments
+            # here while other threads read through the events_scored /
+            # batches_flushed properties, and an unguarded += is a
+            # read-modify-write race (lock-discipline lint).
+            with self._cond:
+                seq = self._batch_seq
+                self._batch_seq += 1
             self._emit_safe({
-                "stage": "serve", "batch": self._batch_seq,
+                "stage": "serve", "batch": seq,
                 "events": len(batch), "error": repr(e),
                 "trigger": trigger,
             })
-            self._batch_seq += 1
             return
         t1 = time.perf_counter()
         for p, s in zip(batch, scores):
+            # lint: ok(hidden-host-sync, scores is a host np.ndarray — score_features returns numpy, the device sync already happened inside the scoring engine)
             p.future._resolve(float(s), snap.version)
         t2 = time.perf_counter()   # demux: every future delivered
-        self._events_scored += len(batch)
-        seq = self._batch_seq
-        self._batch_seq += 1
+        with self._cond:
+            self._events_scored += len(batch)
+            seq = self._batch_seq
+            self._batch_seq += 1
         # Consumers run BEFORE the metrics emit: a metrics IO failure (a
         # full disk under --metrics) must not cost the batch its flagged
         # output / refresh evidence — observability is secondary to

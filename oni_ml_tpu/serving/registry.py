@@ -106,6 +106,7 @@ class ModelRegistry:
                 model=model,
                 version=self._version,
                 source=source,
+                # lint: ok(monotonic-clock, published_at is a true wall-clock epoch stamp surfaced to operators, never differenced)
                 published_at=time.time(),
             )
             self._previous = self._active

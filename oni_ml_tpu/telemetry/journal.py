@@ -65,6 +65,7 @@ class Journal:
                 return record
             rec = {
                 "seq": self._seq,
+                # lint: ok(monotonic-clock, the journal t field is a true wall-clock timestamp; intervals use the mono_ns stamp next to it)
                 "t": round(time.time(), 6),  # wall-clock timestamp
                 "mono_ns": time.monotonic_ns(),
                 **record,

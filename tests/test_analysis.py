@@ -1,0 +1,724 @@
+"""The static-analysis gate (oni_ml_tpu/analysis): engine mechanics
+(suppressions, baseline, parse errors), fixture-backed positives and
+negatives for every rule in the catalog, the graftlint CLI (--json
+golden, rule selection, --update-schema round trip), and the self-run
+that holds the LIVE repo clean against the committed baseline.
+
+Everything runs on synthetic trees under tmp_path except the self-run
+tests — no jax, no numpy, no device.
+"""
+
+import json
+import os
+
+import pytest
+
+from oni_ml_tpu.analysis import run_analysis
+from oni_ml_tpu.analysis import cli as lint_cli
+from oni_ml_tpu.analysis import schema as journal_schema
+from oni_ml_tpu.analysis.engine import ParsedModule, parse_modules
+from oni_ml_tpu.analysis.rules import (
+    HarvestCoverageRule,
+    HiddenHostSyncRule,
+    JournalDocsRule,
+    JournalSchemaRule,
+    LockDisciplineRule,
+    MonotonicClockRule,
+    QuantileRule,
+    RetraceHazardRule,
+    TunedConstantRule,
+    default_rules,
+)
+
+
+def make_tree(tmp_path, files: dict) -> str:
+    """Materialize {relpath: source} as a scannable fixture root."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def run_on(tmp_path, files, rules=None, baseline=()):
+    return run_analysis(root=make_tree(tmp_path, files), rules=rules,
+                        baseline=list(baseline))
+
+
+def rule_lines(report, rule_id):
+    return [(f.path, f.line) for f in report.findings
+            if f.rule == rule_id]
+
+
+# ---------------------------------------------------------------------------
+# engine: suppressions, baseline, parse errors
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_trailing_and_own_line(tmp_path):
+    src = (
+        "import time\n"
+        "a = time.time()  # lint: ok(monotonic-clock, epoch stamp)\n"
+        "# lint: ok(monotonic-clock, stamp on the next line)\n"
+        "b = time.time()\n"
+        "c = time.time()\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/x.py": src},
+               rules=[MonotonicClockRule()])
+    assert r.suppressed == 2
+    assert rule_lines(r, "monotonic-clock") == [("oni_ml_tpu/x.py", 5)]
+
+
+def test_wildcard_suppression_and_wrong_rule_id(tmp_path):
+    src = (
+        "import time\n"
+        "a = time.time()  # lint: ok(*, anything goes here)\n"
+        "b = time.time()  # lint: ok(quantile, wrong rule id)\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/x.py": src},
+               rules=[MonotonicClockRule()])
+    # `*` silences any rule on its line; a suppression naming a
+    # DIFFERENT rule does not.
+    assert r.suppressed == 1
+    assert rule_lines(r, "monotonic-clock") == [("oni_ml_tpu/x.py", 3)]
+
+
+def test_suppression_marker_in_string_literal_is_inert(tmp_path):
+    # Only real COMMENT tokens suppress: the marker inside a string
+    # (a hint message, a doc example) must not mask findings on its
+    # line or the next.
+    src = (
+        "import time\n"
+        'msg = "see # lint: ok(monotonic-clock, example)"; '
+        "t0 = time.time()\n"
+        'hint = "# lint: ok(monotonic-clock, own-line-looking string)"\n'
+        "t1 = time.time()\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/x.py": src},
+               rules=[MonotonicClockRule()])
+    assert r.suppressed == 0
+    assert rule_lines(r, "monotonic-clock") == \
+        [("oni_ml_tpu/x.py", 2), ("oni_ml_tpu/x.py", 4)]
+
+
+def test_reasonless_suppression_is_itself_a_finding(tmp_path):
+    src = (
+        "import time\n"
+        "a = time.time()  # lint: ok(monotonic-clock)\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/x.py": src},
+               rules=[MonotonicClockRule()])
+    rules_hit = {f.rule for f in r.findings}
+    # The reasonless suppression does NOT suppress, and is reported.
+    assert rules_hit == {"monotonic-clock", "suppression-format"}
+
+
+def test_baseline_absorbs_counted_findings_and_flags_stale(tmp_path):
+    src = "import time\na = time.time()\nb = time.time()\n"
+    baseline = [
+        {"rule": "monotonic-clock", "path": "oni_ml_tpu/x.py", "count": 1},
+        {"rule": "monotonic-clock", "path": "oni_ml_tpu/gone.py",
+         "count": 3},
+    ]
+    r = run_on(tmp_path, {"oni_ml_tpu/x.py": src},
+               rules=[MonotonicClockRule()], baseline=baseline)
+    assert r.baselined == 1
+    # One of the two real findings survives the count=1 budget...
+    assert len(rule_lines(r, "monotonic-clock")) == 1
+    # ...and the entry matching nothing is reported stale.
+    assert rule_lines(r, "stale-baseline") == [("oni_ml_tpu/gone.py", 0)]
+
+
+def test_unparseable_file_fails_the_report(tmp_path):
+    r = run_on(tmp_path, {"oni_ml_tpu/bad.py": "def broken(:\n"},
+               rules=[MonotonicClockRule()])
+    assert not r.ok
+    assert r.parse_errors and r.parse_errors[0][0] == "oni_ml_tpu/bad.py"
+
+
+def test_null_byte_source_is_a_parse_error_not_a_crash(tmp_path):
+    # ast.parse raises ValueError (not SyntaxError) on null bytes — a
+    # corrupted file must surface as a parse error, not a traceback
+    # out of the gate.
+    r = run_on(tmp_path, {"oni_ml_tpu/__init__.py": "",
+                          "oni_ml_tpu/bad.py": "x = 1\x00\n"},
+               rules=[MonotonicClockRule()])
+    assert not r.ok
+    assert any(p == "oni_ml_tpu/bad.py" for p, _ in r.parse_errors)
+
+
+def test_empty_scan_root_fails_the_report(tmp_path):
+    # A gate that scans nothing must not report clean — a wrong --root
+    # or cwd would otherwise pass CI while linting zero files.
+    r = run_analysis(root=str(tmp_path / "nonexistent"))
+    assert not r.ok
+    assert r.files_scanned == 0
+    assert any("no oni_ml_tpu/ package files" in msg
+               for _, msg in r.parse_errors)
+
+
+def test_scan_covers_tools_and_bench(tmp_path):
+    files = {
+        "tools/t.py": "import time\nx = time.time()\n",
+        "bench.py": "import time\ny = time.time()\n",
+        "oni_ml_tpu/__init__.py": "",
+    }
+    r = run_on(tmp_path, files, rules=[MonotonicClockRule()])
+    assert {p for p, _ in rule_lines(r, "monotonic-clock")} == \
+        {"tools/t.py", "bench.py"}
+
+
+# ---------------------------------------------------------------------------
+# migrated grep-lints, now AST-accurate
+# ---------------------------------------------------------------------------
+
+
+def test_monotonic_clock_ignores_docstring_mentions(tmp_path):
+    src = '"""Calls time.time() in prose only."""\nX = "time.time()"\n'
+    r = run_on(tmp_path, {"oni_ml_tpu/x.py": src},
+               rules=[MonotonicClockRule()])
+    assert r.ok  # the grep version flagged both of these
+
+
+def test_tuned_constant_literal_placement(tmp_path):
+    files = {
+        "oni_ml_tpu/config.py": "device_chunk = 65536\n",
+        "oni_ml_tpu/plans/seeds.py": "DEFAULT_CHUNK = 65536\n",
+        "oni_ml_tpu/consumer.py": (
+            "device_chunk = 65536\n"
+            "max_batch: int = 256\n"
+            "pre_workers = compute()\n"      # non-literal: fine
+            "unrelated = 3\n"
+        ),
+    }
+    r = run_on(tmp_path, files, rules=[TunedConstantRule()])
+    assert rule_lines(r, "tuned-constant") == [
+        ("oni_ml_tpu/consumer.py", 1), ("oni_ml_tpu/consumer.py", 2),
+    ]
+
+
+def test_quantile_math_only_in_telemetry(tmp_path):
+    files = {
+        "oni_ml_tpu/telemetry/spans.py": "q = np.percentile(a, 99)\n",
+        "oni_ml_tpu/scoring/x.py": "q = np.percentile(a, 99)\n",
+        "tools/probe.py": "q = np.quantile(a, 0.5)\n",
+    }
+    r = run_on(tmp_path, files, rules=[QuantileRule()])
+    assert {p for p, _ in rule_lines(r, "quantile")} == \
+        {"oni_ml_tpu/scoring/x.py", "tools/probe.py"}
+
+
+REGISTRY_SRC = (
+    "HARVEST_COVERAGE = {\n"
+    '    "models/covered.py": "harvested at dispatch",\n'
+    '    "models/ghost.py": "stale: file was deleted",\n'
+    '    "models/nojit.py": "stale: jit site was removed",\n'
+    "}\n"
+)
+JIT_SRC = "import jax\nf = jax.jit(lambda x: x)\n"
+
+
+def test_harvest_coverage_drift_both_ways(tmp_path):
+    files = {
+        "oni_ml_tpu/telemetry/roofline.py": REGISTRY_SRC,
+        "oni_ml_tpu/models/covered.py": JIT_SRC,
+        "oni_ml_tpu/models/uncovered.py": JIT_SRC,
+        "oni_ml_tpu/models/nojit.py": "x = 1\n",
+        # A docstring mention must NOT count as an entry point (the
+        # false positive the grep-lint had).
+        "oni_ml_tpu/models/prose.py": '"""uses jax.jit inside."""\n',
+    }
+    r = run_on(tmp_path, files, rules=[HarvestCoverageRule()])
+    got = rule_lines(r, "harvest-coverage")
+    # The unregistered jit file fails; the two stale registry entries
+    # fail; covered.py and prose.py are fine.
+    assert ("oni_ml_tpu/models/uncovered.py", 2) in got
+    assert ("oni_ml_tpu/telemetry/roofline.py", 3) in got   # ghost
+    assert ("oni_ml_tpu/telemetry/roofline.py", 4) in got   # nojit
+    assert len(got) == 3
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_hazard_decorator_forms(tmp_path):
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "\n"
+        "@jax.jit\n"
+        "def bad(x, flag):\n"
+        "    if flag:\n"
+        "        return x\n"
+        "    return -x\n"
+        "\n"
+        '@partial(jax.jit, static_argnames=("flag",))\n'
+        "def good(x, flag):\n"
+        "    if flag:\n"
+        "        return x\n"
+        "    return -x\n"
+        "\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def good2(x, n):\n"
+        "    while n > 0:\n"
+        "        n = n - 1\n"
+        "    return x\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/m.py": src},
+               rules=[RetraceHazardRule()])
+    assert rule_lines(r, "retrace-hazard") == [("oni_ml_tpu/m.py", 6)]
+
+
+def test_retrace_hazard_call_form_and_range(tmp_path):
+    src = (
+        "import jax\n"
+        "def f(x, n):\n"
+        "    for _ in range(n):\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        "jf_bad = jax.jit(f)\n"
+        'jf_good = jax.jit(f, static_argnames=("n",))\n'
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/m.py": src},
+               rules=[RetraceHazardRule()])
+    # Flagged once for the un-static wrapping only (sites dedup per
+    # (target, statics); the explicitly-static one is clean).
+    assert rule_lines(r, "retrace-hazard") == [("oni_ml_tpu/m.py", 3)]
+
+
+def test_retrace_hazard_static_site_does_not_shadow_bare_site(tmp_path):
+    # Order-reversed twin of the test above: a properly-static jit
+    # site FIRST must not absorb the bare jax.jit(f) after it.
+    src = (
+        "import jax\n"
+        "def f(x, n):\n"
+        "    for _ in range(n):\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        'jf_good = jax.jit(f, static_argnames=("n",))\n'
+        "jf_bad = jax.jit(f)\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/m.py": src},
+               rules=[RetraceHazardRule()])
+    assert rule_lines(r, "retrace-hazard") == [("oni_ml_tpu/m.py", 3)]
+
+
+def test_retrace_hazard_method_does_not_shadow_module_def(tmp_path):
+    # jax.jit(step) jits the MODULE function; a same-named class
+    # method with hazardous control flow must not be what gets
+    # analyzed (was a false positive).
+    src = (
+        "import jax\n"
+        "def step(x, n):\n"
+        "    return x + 1\n"
+        "class Driver:\n"
+        "    def step(self, x, n):\n"
+        "        if n > 2:\n"
+        "            return x\n"
+        "        return -x\n"
+        "jf = jax.jit(step)\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/m.py": src},
+               rules=[RetraceHazardRule()])
+    assert r.ok
+
+
+def test_retrace_hazard_nested_callable_param_is_own_binding(tmp_path):
+    # A nested lambda/def parameter shadowing a jit parameter is the
+    # nested callable's OWN (host-side) binding — not the traced
+    # argument (was a false positive).
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, n):\n"
+        "    g = lambda n: 1 if n else 0\n"
+        "    def h(n):\n"
+        "        while n:\n"
+        "            n = n - 1\n"
+        "        return n\n"
+        "    return x + g(0) + h(0)\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/m.py": src},
+               rules=[RetraceHazardRule()])
+    assert r.ok
+
+
+def test_retrace_hazard_trace_stable_tests_ignored(tmp_path):
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, y):\n"
+        "    if x.shape[0] == 1:\n"       # shape: trace-stable
+        "        return x\n"
+        "    if isinstance(y, tuple):\n"  # behind a call: out of scope
+        "        return x\n"
+        "    return x + 1\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/m.py": src},
+               rules=[RetraceHazardRule()])
+    assert r.ok
+
+
+def test_retrace_hazard_partial_bound_args_are_static(tmp_path):
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "def f(n, x):\n"
+        "    if n > 2:\n"
+        "        return x\n"
+        "    return -x\n"
+        "jf = jax.jit(partial(f, 4))\n"   # n positionally bound: static
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/m.py": src},
+               rules=[RetraceHazardRule()])
+    assert r.ok
+
+
+# ---------------------------------------------------------------------------
+# hidden-host-sync
+# ---------------------------------------------------------------------------
+
+HOT = "oni_ml_tpu/models/fused.py"
+
+
+def test_hidden_host_sync_in_hot_loop(tmp_path):
+    src = (
+        "import numpy as np\n"
+        "def drive(xs):\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        out.append(float(x))\n"
+        "    return out\n"
+    )
+    r = run_on(tmp_path, {HOT: src}, rules=[HiddenHostSyncRule()])
+    assert rule_lines(r, "hidden-host-sync") == [(HOT, 5)]
+
+
+def test_hidden_host_sync_span_wrapped_is_deliberate(tmp_path):
+    src = (
+        "def drive(xs):\n"
+        "    total = 0.0\n"
+        "    for x in xs:\n"
+        '        with maybe_span("em.host_sync"):\n'
+        "            total += float(x)\n"
+        "    return total\n"
+    )
+    r = run_on(tmp_path, {HOT: src}, rules=[HiddenHostSyncRule()])
+    assert r.ok
+
+
+def test_hidden_host_sync_scope(tmp_path):
+    src = (
+        "def f(x, xs):\n"
+        "    a = float(x)\n"              # not in a loop: fine
+        "    return a\n"
+    )
+    cold = (
+        "def f(xs):\n"
+        "    return [float(x) for x in xs]\n"
+    )
+    r = run_on(tmp_path, {
+        HOT: src,
+        "oni_ml_tpu/io/cold.py": cold,    # not a hot module: fine
+    }, rules=[HiddenHostSyncRule()])
+    assert r.ok
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_lock_discipline_mixed_guarding(tmp_path):
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "    def sneak(self):\n"
+        "        self._n = 0\n"           # guarded elsewhere: race
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/c.py": src},
+               rules=[LockDisciplineRule()])
+    assert rule_lines(r, "lock-discipline") == [("oni_ml_tpu/c.py", 10)]
+
+
+def test_lock_discipline_locked_helper_and_init_exempt(tmp_path):
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"           # __init__: pre-publication
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+        "    def _bump_locked(self):\n"
+        "        self._n += 1\n"          # name marks it lock-held
+        "    def reset(self):\n"
+        "        with self._lock:\n"
+        "            self._do_reset()\n"
+        "    def _do_reset(self):\n"
+        '        """Caller holds self._lock."""\n'
+        "        self._n = 0\n"           # docstring marks it
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/c.py": src},
+               rules=[LockDisciplineRule()])
+    assert r.ok
+
+
+def test_lock_discipline_threaded_unguarded_counter(tmp_path):
+    # The BatchScorer shape this rule caught live: a Condition guards
+    # the queue, a worker thread bumps counters with no lock, and other
+    # methods read them.
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._seq = 0\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        self._seq += 1\n"        # cross-thread, no guard
+        "    def seq(self):\n"
+        "        return self._seq\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/s.py": src},
+               rules=[LockDisciplineRule()])
+    assert rule_lines(r, "lock-discipline") == [("oni_ml_tpu/s.py", 8)]
+
+
+def test_lock_discipline_single_threaded_class_quiet(tmp_path):
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def bump(self):\n"
+        "        self._n += 1\n"          # never guarded anywhere,
+        "    def read(self):\n"           # no threads started: quiet
+        "        return self._n\n"
+    )
+    r = run_on(tmp_path, {"oni_ml_tpu/c.py": src},
+               rules=[LockDisciplineRule()])
+    assert r.ok
+
+
+# ---------------------------------------------------------------------------
+# journal-schema / journal-docs
+# ---------------------------------------------------------------------------
+
+EMITTER = (
+    "def emit(j, info):\n"
+    '    j.append({"kind": "em_ll", "iter": 1, "ll": 0.5})\n'
+    '    j.annotation("probe", ok=True)\n'
+)
+
+
+def _schema_of(tmp_path, files):
+    root = make_tree(tmp_path, files)
+    modules, errors = parse_modules(root)
+    assert not errors
+    return journal_schema.extract_schema(modules)
+
+
+def test_schema_extraction_dict_and_annotation(tmp_path):
+    ext = _schema_of(tmp_path, {"oni_ml_tpu/e.py": EMITTER})
+    assert ext == {
+        "em_ll": {"fields": ["iter", "ll"], "open": False},
+        "probe": {"fields": ["ok"], "open": False},
+    }
+
+
+def test_schema_extraction_follows_local_record_growth(tmp_path):
+    src = (
+        "def emit(j, info):\n"
+        '    rec = {"kind": "stage", "stage": "pre"}\n'
+        '    rec["wall_s"] = 1.0\n'
+        "    rec.update(info)\n"
+        "    j.append(rec)\n"
+    )
+    ext = _schema_of(tmp_path, {"oni_ml_tpu/e.py": src})
+    assert ext["stage"] == {"fields": ["stage", "wall_s"], "open": True}
+
+
+def test_journal_schema_drift_directions(tmp_path):
+    committed = {
+        "em_ll": {"fields": ["conv", "iter", "ll"], "open": False},
+        "probe": {"fields": ["ok"], "open": False},
+        "retired": {"fields": [], "open": False},
+    }
+    r = run_on(tmp_path, {"oni_ml_tpu/e.py": EMITTER},
+               rules=[JournalSchemaRule(schema=committed)])
+    msgs = " | ".join(f.message for f in r.findings)
+    # Dropped field (conv), and a kind no longer emitted (retired).
+    assert "dropped field(s) ['conv']" in msgs
+    assert "'retired' is no longer emitted" in msgs
+    assert len(r.findings) == 2
+
+
+def test_journal_schema_new_kind_and_new_field_fail(tmp_path):
+    committed = {"em_ll": {"fields": ["iter"], "open": False}}
+    r = run_on(tmp_path, {"oni_ml_tpu/e.py": EMITTER},
+               rules=[JournalSchemaRule(schema=committed)])
+    msgs = " | ".join(f.message for f in r.findings)
+    assert "new record kind 'probe'" in msgs
+    assert "gained undeclared field(s) ['ll']" in msgs
+
+
+def test_journal_schema_missing_committed_file(tmp_path):
+    r = run_on(tmp_path, {"oni_ml_tpu/e.py": EMITTER},
+               rules=[JournalSchemaRule()])
+    assert [f.rule for f in r.findings] == ["journal-schema"]
+    assert "missing or empty" in r.findings[0].message
+
+
+def test_journal_docs_requires_backticked_kind(tmp_path):
+    files = {
+        "oni_ml_tpu/e.py": EMITTER,
+        "docs/observability.md": "| `em_ll` | iteration likelihood |\n",
+    }
+    r = run_on(tmp_path, files, rules=[JournalDocsRule()])
+    assert [f.rule for f in r.findings] == ["journal-docs"]
+    assert "'probe'" in r.findings[0].message
+    # And with both kinds documented: clean.
+    files["docs/observability.md"] += "| `probe` | liveness marker |\n"
+    r2 = run_on(tmp_path / "b", files, rules=[JournalDocsRule()])
+    assert r2.ok
+
+
+# ---------------------------------------------------------------------------
+# the graftlint CLI
+# ---------------------------------------------------------------------------
+
+DIRTY = {"oni_ml_tpu/x.py": "import time\nt0 = time.time()\n"}
+
+
+def test_cli_json_golden(tmp_path, capsys):
+    root = make_tree(tmp_path, DIRTY)
+    rc = lint_cli.main(["--root", root, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["ok"] is False
+    assert out["files_scanned"] == 1
+    assert out["counts"] == {"monotonic-clock": 1}
+    assert out["findings"] == [{
+        "rule": "monotonic-clock",
+        "path": "oni_ml_tpu/x.py",
+        "line": 2,
+        "message": ("bare time.time() — wall clocks step under NTP; "
+                    "time intervals with a monotonic clock"),
+        "hint": ("use time.monotonic_ns()/time.perf_counter() for "
+                 "intervals; a true wall-clock timestamp gets "
+                 "`# lint: ok(monotonic-clock, <why>)`"),
+    }]
+
+
+def test_cli_human_output_and_exit_codes(tmp_path, capsys):
+    root = make_tree(tmp_path, DIRTY)
+    assert lint_cli.main(["--root", root]) == 1
+    out = capsys.readouterr().out
+    assert "oni_ml_tpu/x.py:2: [monotonic-clock]" in out
+    assert "(fix:" in out
+    clean = make_tree(tmp_path / "clean",
+                      {"oni_ml_tpu/y.py": "x = 1\n"})
+    assert lint_cli.main(["--root", clean]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_rule_selection(tmp_path, capsys):
+    root = make_tree(tmp_path, DIRTY)
+    # Selecting a rule that cannot fire here: clean.
+    assert lint_cli.main(["--root", root, "--rule", "quantile"]) == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        lint_cli.main(["--root", root, "--rule", "not-a-rule"])
+
+
+def test_cli_list_rules_names_whole_catalog(capsys):
+    assert lint_cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in default_rules():
+        assert rule.id in out
+
+
+def test_cli_update_schema_round_trip(tmp_path, capsys):
+    """--update-schema writes the contract INTO the scanned root; the
+    very next lint run is clean, and deleting a field from the emit
+    site afterwards fails the journal-schema rule — the acceptance
+    drill for schema drift."""
+    files = dict(DIRTY)
+    files["oni_ml_tpu/e.py"] = EMITTER
+    files["docs/observability.md"] = "`em_ll` and `probe`\n"
+    root = make_tree(tmp_path, files)
+    assert lint_cli.main(["--root", root, "--update-schema"]) == 0
+    schema_path = os.path.join(
+        root, "oni_ml_tpu/analysis/schema/journal_schema.json")
+    assert os.path.exists(schema_path)
+    capsys.readouterr()
+    assert lint_cli.main(
+        ["--root", root, "--rule", "journal-schema"]) == 0
+    capsys.readouterr()
+    # Drop a field from the call site: drift must fail the run.
+    (tmp_path / "oni_ml_tpu/e.py").write_text(
+        'def emit(j, info):\n    j.append({"kind": "em_ll", "iter": 1})\n'
+        '    j.annotation("probe", ok=True)\n'
+    )
+    assert lint_cli.main(
+        ["--root", root, "--rule", "journal-schema"]) == 1
+    assert "dropped field(s) ['ll']" in capsys.readouterr().out
+
+
+def test_ml_ops_lint_routes_to_graftlint(capsys):
+    from oni_ml_tpu.runner.ml_ops import main as ml_ops_main
+
+    assert ml_ops_main(["lint", "--list-rules"]) == 0
+    assert "retrace-hazard" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# the live repo
+# ---------------------------------------------------------------------------
+
+
+def test_live_repo_is_clean_against_committed_baseline():
+    """The self-run gate: every rule over the real tree (package,
+    tools/, bench.py), inline suppressions honored, committed baseline
+    applied.  A new finding — retrace hazard, unlocked write, schema or
+    coverage drift, a stale baseline entry — fails HERE."""
+    report = run_analysis()
+    assert report.ok, "\n" + "\n".join(
+        f.format() for f in report.findings
+    ) + "\n".join(f"{p}: {m}" for p, m in report.parse_errors)
+
+
+def test_committed_schema_matches_extraction_exactly():
+    """`journal_schema.json` must be regeneration-stable: if extraction
+    and the committed file ever disagree the journal-schema rule fails
+    the self-run above; this pins the sharper property that the file
+    was written BY the extractor (field lists sorted, open flags
+    bool)."""
+    from oni_ml_tpu.analysis.engine import repo_root
+
+    modules, errors = parse_modules(repo_root())
+    assert not errors
+    extracted = journal_schema.extract_schema(modules)
+    committed = journal_schema.load_schema()
+    assert extracted == committed
+
+
+def test_live_baseline_is_empty():
+    """The acceptance bar was an empty baseline (every true positive
+    fixed or suppressed-with-reason at adoption).  If a future change
+    NEEDS a baseline entry this test forces that to be a deliberate,
+    reviewed edit here."""
+    from oni_ml_tpu.analysis.engine import load_baseline
+
+    assert load_baseline() == []

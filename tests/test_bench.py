@@ -311,6 +311,35 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
     )
 
 
+def test_bench_lint_preflight_aborts_on_findings(capsys, monkeypatch):
+    """With BENCH_LINT on (conftest turns it off suite-wide), a failing
+    lint report aborts main() with a structured failure payload before
+    any phase runs — CI-rejected code never spends grant time."""
+    import bench
+
+    import oni_ml_tpu.analysis as analysis
+    from oni_ml_tpu.analysis.engine import Finding, Report
+
+    monkeypatch.setenv("BENCH_LINT", "1")
+    report = Report(
+        findings=[Finding("monotonic-clock", "oni_ml_tpu/x.py", 3,
+                          "bare time.time()")],
+        suppressed=0, baselined=0, files_scanned=1,
+        parse_errors=[("oni_ml_tpu/bad.py", "SyntaxError: boom")],
+    )
+    monkeypatch.setattr(analysis, "run_analysis", lambda: report)
+    _patch_phases(bench, monkeypatch)
+    assert bench.main() == 1
+    captured = capsys.readouterr()
+    rec = json.loads(captured.out.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert "lint preflight failed" in rec["error"]
+    assert "1 parse error(s)" in rec["error"]
+    assert rec["phases"] == {}          # aborted before any phase
+    assert "[monotonic-clock]" in captured.err
+    assert "parse error" in captured.err
+
+
 def test_bench_main_headline_survives_secondary_failure(capsys, monkeypatch):
     """A crashing secondary must not lose the headline or the other
     secondaries — it is recorded as an error stub and main() stays 0."""

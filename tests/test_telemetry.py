@@ -1,12 +1,12 @@
 """Telemetry flight recorder: journal write/replay, span tracing +
-Chrome trace export, heartbeat loss detection, the serving metrics
-registry refactor, and the monotonic-clock lint.
+Chrome trace export, heartbeat loss detection, and the serving metrics
+registry refactor.  (The grep-lints that lived here are AST rules in
+oni_ml_tpu/analysis/ now — see tests/test_analysis.py.)
 
 Everything here is the fast tier-1 smoke — no device, no subprocesses
 (the SIGKILL crash-recovery path lives in tests/test_journal_crash.py).
 """
 
-import glob
 import json
 import os
 import threading
@@ -430,180 +430,8 @@ def test_trace_view_converts_journal_to_valid_chrome_trace(tmp_path):
         assert "traceEvents" in json.load(f)
 
 
-# ---------------------------------------------------------------------------
-# monotonic-clock lint
-# ---------------------------------------------------------------------------
-
-# Files allowed to call time.time(): wall-clock TIMESTAMPS only, never
-# interval/span timing.  Everything else in the package must time with
-# monotonic clocks (time.monotonic_ns / time.perf_counter).
-_TIME_TIME_ALLOWED = {
-    "serving/registry.py",    # published_at epoch stamp on snapshots
-    "telemetry/journal.py",   # the journal's wall-clock `t` field
-}
-
-
-def test_no_bare_time_time_for_span_timing():
-    """Grep-lint: no module under oni_ml_tpu/ calls bare time.time()
-    outside the explicit wall-clock-timestamp allowlist — interval
-    timing on the wall clock breaks under NTP steps, which is exactly
-    what the span/journal layer exists to prevent."""
-    pkg = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "oni_ml_tpu",
-    )
-    offenders = []
-    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
-        rel = os.path.relpath(path, pkg)
-        if rel in _TIME_TIME_ALLOWED:
-            continue
-        with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                if "time.time()" in line.split("#")[0]:
-                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
-    assert not offenders, (
-        "bare time.time() outside the wall-clock allowlist (use "
-        "time.monotonic_ns / perf_counter for timing, or add a "
-        "justified allowlist entry):\n" + "\n".join(offenders)
-    )
-
-
-# ---------------------------------------------------------------------------
-# tuned-constant lint (measured execution plans, oni_ml_tpu/plans)
-# ---------------------------------------------------------------------------
-
-# Knob names whose NUMERIC defaults may live only in config.py (the
-# tuned-constant home) and under oni_ml_tpu/plans/ (the registry/seeds).
-# Everywhere else the value must flow through config or a plan lookup —
-# a literal re-hardcoded at a consumer is exactly the drift the plan
-# cache exists to end (the r05 device-chunk / break-even constants were
-# smeared this way before round 6).
-_TUNED_CONSTANT_NAMES = (
-    "fused_em_chunk",
-    "host_sync_every",
-    "device_chunk",
-    "DEFAULT_CHUNK",
-    "device_score_min",
-    "max_batch",
-    "max_wait_ms",
-    "pre_workers",
-    "break_even",
-)
-
-_TUNED_LITERAL_ALLOWED_PREFIXES = ("plans/",)
-_TUNED_LITERAL_ALLOWED_FILES = {"config.py"}
-
-
-def test_no_hardcoded_tuned_constants_outside_plans():
-    """Grep-lint: no module under oni_ml_tpu/ outside plans/ and
-    config.py assigns a tuned-constant name a numeric literal
-    (`name = <digit...>` / `name: type = <digit...>`).  Consumers must
-    read these through config or resolve them through the plan cache."""
-    import re
-
-    pat = re.compile(
-        r"\b(" + "|".join(_TUNED_CONSTANT_NAMES) + r")\s*(?::[^=\n]+)?=\s*[0-9]"
-    )
-    pkg = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "oni_ml_tpu",
-    )
-    offenders = []
-    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
-        rel = os.path.relpath(path, pkg)
-        if rel in _TUNED_LITERAL_ALLOWED_FILES or any(
-            rel.startswith(p) for p in _TUNED_LITERAL_ALLOWED_PREFIXES
-        ):
-            continue
-        with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                if pat.search(line.split("#")[0]):
-                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
-    assert not offenders, (
-        "tuned-constant literal outside config.py / oni_ml_tpu/plans/ "
-        "(route the value through config or a plans.resolve lookup):\n"
-        + "\n".join(offenders)
-    )
-
-
-# ---------------------------------------------------------------------------
-# quantile lint (roofline/SLO plane: one histogram, one estimator)
-# ---------------------------------------------------------------------------
-
-
-def _pkg_root() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "oni_ml_tpu",
-    )
-
-
-def test_no_adhoc_percentile_math_outside_telemetry():
-    """Grep-lint: no module under oni_ml_tpu/ outside telemetry/ does
-    its own quantile math (np.percentile / np.quantile /
-    statistics.quantiles).  Latency quantiles must come from the shared
-    fixed-boundary histogram (telemetry/spans.Histogram.quantile) so
-    p50/p99/p999 mean the same thing in every record, bench payload,
-    and OpenMetrics scrape."""
-    needles = ("np.percentile", "numpy.percentile", "np.quantile",
-               "numpy.quantile", "statistics.quantiles")
-    pkg = _pkg_root()
-    offenders = []
-    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
-        rel = os.path.relpath(path, pkg)
-        if rel.startswith("telemetry/"):
-            continue
-        with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                code = line.split("#")[0]
-                if any(n in code for n in needles):
-                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
-    assert not offenders, (
-        "ad-hoc percentile math outside telemetry/ (observe into a "
-        "shared Histogram and read .quantile()/summary() back):\n"
-        + "\n".join(offenders)
-    )
-
-
-# ---------------------------------------------------------------------------
-# roofline jit-coverage lint
-# ---------------------------------------------------------------------------
-
-
-def test_every_jit_entry_point_file_is_harvest_covered():
-    """Grep-lint: every file under oni_ml_tpu/ that creates a
-    `jax.jit(` entry point must be registered in
-    telemetry.roofline.HARVEST_COVERAGE — either naming how its
-    programs are cost-analysis-harvested or why they are exempt.  A new
-    jit site in an unregistered file fails here, so the roofline's
-    phase coverage cannot silently rot as kernels are added."""
-    from oni_ml_tpu.telemetry.roofline import HARVEST_COVERAGE
-
-    pkg = _pkg_root()
-    uncovered = []
-    jit_files = set()
-    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
-        rel = os.path.relpath(path, pkg)
-        if rel.startswith("telemetry/roofline"):
-            continue  # the registry itself
-        with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                # Both call form (`jax.jit(...)`) and decorator form
-                # (`@partial(jax.jit, ...)`); docstring mentions count
-                # too — coverage notes are cheap, silent gaps are not.
-                if "jax.jit" in line.split("#")[0]:
-                    jit_files.add(rel)
-                    if rel not in HARVEST_COVERAGE:
-                        uncovered.append(f"{rel}:{lineno}: {line.strip()}")
-    assert not uncovered, (
-        "jax.jit entry point in a file not registered for cost-analysis "
-        "harvest (add the file to telemetry/roofline.py "
-        "HARVEST_COVERAGE, naming the harvest hook or the exemption):\n"
-        + "\n".join(uncovered)
-    )
-    # ...and the registry carries no stale entries for files that no
-    # longer hold a jit site (drift cuts both ways).
-    stale = set(HARVEST_COVERAGE) - jit_files
-    assert not stale, (
-        f"HARVEST_COVERAGE names files with no jax.jit( site: {stale}"
-    )
+# The four grep-lints that lived here (monotonic-clock, tuned-constant,
+# quantile, harvest-coverage) moved to the AST rule engine in
+# oni_ml_tpu/analysis/ (same or stricter coverage, one suppression
+# mechanism instead of per-lint allowlists).  tests/test_analysis.py
+# enforces them now — including the live-repo clean run.

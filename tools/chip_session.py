@@ -107,7 +107,7 @@ def run_step(name, cmd, timeout, logf, boot_grace=BOOT_GRACE_S):
     logf.write(f"--- {name} @ {time.strftime('%F %T')} ---\n")
     logf.flush()
     start_pos = logf.tell()
-    t0 = time.time()
+    t0 = time.monotonic()
     proc = subprocess.Popen(cmd, stdout=logf, stderr=logf, text=True,
                             cwd=HERE)
     rc = None
@@ -115,7 +115,7 @@ def run_step(name, cmd, timeout, logf, boot_grace=BOOT_GRACE_S):
     # the log fd directly, so file growth == first output) or for the
     # grace to expire, whichever is first.
     boot_deadline = t0 + boot_grace
-    while proc.poll() is None and time.time() < boot_deadline:
+    while proc.poll() is None and time.monotonic() < boot_deadline:
         if os.path.getsize(logf.name) > start_pos:
             break
         time.sleep(0.05)
@@ -130,7 +130,7 @@ def run_step(name, cmd, timeout, logf, boot_grace=BOOT_GRACE_S):
             proc.wait()
         print(f"chip_session: {name} TIMED OUT after {timeout:.0f}s",
               flush=True)
-    wall = time.time() - t0
+    wall = time.monotonic() - t0
     logf.flush()
     with open(logf.name) as f:
         f.seek(start_pos)

@@ -1,0 +1,20 @@
+"""graftlint launcher for source checkouts:
+
+    python tools/graftlint.py [--json] [--rule ID] [--list-rules]
+    python tools/graftlint.py --update-schema | --update-baseline
+
+Thin wrapper over oni_ml_tpu.analysis.cli (the same code behind
+`oni-ml-ops lint` and the `oni-graftlint` console script).  Nothing
+here imports jax or numpy — the lint runs on any CI box in well under
+a second.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from oni_ml_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
