@@ -774,6 +774,54 @@ def _write_dns_day(f, n_events, n_clients=20_000, n_doms=5_000, seed=13,
         ) + "\n")
 
 
+def critical_path_summary(metrics, total_s):
+    """The streaming dataplane's headline accounting: per-stage wall
+    (inline wall + the stage's background tasks/checkpoint writes, i.e.
+    the stage's TOTAL work), the sum of those walls (what a fully
+    serial execution would cost), the overlapped end-to-end wall, and
+
+        overlap_efficiency = 1 - e2e / sum_of_stage_walls
+
+    — the fraction of total work the stage overlap hid (0 on a serial
+    run; negative would mean the dataplane added more glue than it
+    overlapped, which is exactly the regression this number exists to
+    catch via tools/bench_diff.py)."""
+    stage_wall = {
+        m["stage"]: float(m["wall_s"]) for m in metrics
+        if "wall_s" in m and m["stage"] in ("pre", "corpus", "lda",
+                                            "score")
+    }
+    dp = next((m for m in metrics if m.get("stage") == "dataplane"), None)
+    per_stage = dict(stage_wall)
+    background = 0.0
+    if dp is not None:
+        for task in dp.get("tasks", {}).values():
+            if not task.get("ok"):
+                continue
+            # A task's channel-backpressure stall (a producer blocked
+            # in put() while its consumer works) is idle wait, not
+            # work — counting it would double-count the consumer's
+            # inline wall and inflate overlap_efficiency.
+            work = task["wall_s"] - task.get("stall_s", 0.0)
+            background += work
+            if task.get("stage") in per_stage:
+                per_stage[task["stage"]] += work
+    work = sum(per_stage.values())
+    out = {
+        "per_stage_wall_s": {k: round(v, 3) for k, v in per_stage.items()},
+        "stage_wall_s": {k: round(v, 3) for k, v in stage_wall.items()},
+        "background_wall_s": round(background, 3),
+        "sum_of_stage_walls_s": round(work, 3),
+        "e2e_wall_s": round(total_s, 3),
+        "overlap_efficiency": (
+            round(1.0 - total_s / work, 4) if work > 0 else None
+        ),
+    }
+    if dp is not None:
+        out["edges"] = dp.get("edges", {})
+    return out
+
+
 def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
                        em_max_iters=40, dsource="flow", pre_workers=0,
                        compare_pre_workers1=True):
@@ -781,8 +829,10 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
     (`./ml_ops.sh YYYYMMDD flow`, timed per stage at ml_ops.sh:57-108):
     featurize + word counts, corpus build, LDA to convergence, scoring +
     emit, on a synthetic ~5M-event flow day.  Returns (total_seconds,
-    {stage: seconds}, events_per_sec, pre_detail) so any host-side stage
-    that comes to dominate the device work is visible in the breakdown.
+    {stage: seconds}, events_per_sec, pre_detail, critical_path) so any
+    host-side stage that comes to dominate the device work is visible
+    in the breakdown, and the dataplane's stage overlap is a tracked
+    headline number (critical_path["overlap_efficiency"]).
 
     `pre_detail` carries the pre stage's parallel-featurization record:
     resolved worker count, per-pass walls, merge overhead, the
@@ -847,6 +897,7 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
         }
         if "merge_wall_s" in pre_rec:
             pre_detail["merge_wall_s"] = pre_rec["merge_wall_s"]
+        critical = critical_path_summary(metrics, total)
         if compare_pre_workers1 and resolve_pre_workers(pre_workers) > 1:
             # Sequential baseline of JUST the pre stage into a second
             # day dir (same raw file): the sharding comparison the
@@ -867,7 +918,7 @@ def bench_pipeline_e2e(n_events=5_000_000, n_src=40_000, n_dst=8_000,
                 pre_detail["pre_speedup_vs_workers1"] = round(
                     w1 / stages["pre"], 2
                 )
-        return total, stages, n_events / total, pre_detail
+        return total, stages, n_events / total, pre_detail, critical
     finally:
         shutil.rmtree(work, ignore_errors=True)
         _E2E_WORKDIRS.remove(work)
@@ -1552,22 +1603,26 @@ def phase_pipeline_e2e():
     pre stage sharded (pre_workers=auto) and records the sequential
     pre-stage baseline alongside, so the featurization win — or
     single-core parity — is in the payload, not just in docs prose."""
-    total, stages, eps, pre = bench_pipeline_e2e()
+    total, stages, eps, pre, critical = bench_pipeline_e2e()
     return {"value": round(total, 1), "unit": "seconds",
             "events_per_sec": round(eps, 1), "n_events": 5_000_000,
             "stages": stages, "pre": pre,
+            "critical_path": critical,
+            "overlap_efficiency": critical.get("overlap_efficiency"),
             "pre_workers": pre.get("pre_workers")}
 
 
 def phase_pipeline_e2e_dns():
     """DNS day (combinatorial word space; one document per querying
     client, dns_pre_lda.scala:330-334)."""
-    total, stages, eps, pre = bench_pipeline_e2e(
+    total, stages, eps, pre, critical = bench_pipeline_e2e(
         n_events=2_000_000, n_src=20_000, dsource="dns"
     )
     return {"value": round(total, 1), "unit": "seconds",
             "events_per_sec": round(eps, 1), "n_events": 2_000_000,
             "stages": stages, "pre": pre,
+            "critical_path": critical,
+            "overlap_efficiency": critical.get("overlap_efficiency"),
             "pre_workers": pre.get("pre_workers")}
 
 

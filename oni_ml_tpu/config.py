@@ -324,6 +324,44 @@ class TelemetryConfig:
 
 
 @dataclass(frozen=True)
+class DataplaneConfig:
+    """Streaming dataplane (oni_ml_tpu/dataplane/): in-memory columnar
+    hand-offs through the pre→corpus→EM→score chain with bounded-buffer
+    overlap, and the inter-stage files demoted to background checkpoint
+    writes.  Artifacts stay byte-identical to the serial file-contract
+    path (--no-dataplane) — the dataplane changes WHEN files are
+    written and what the next stage reads, never the bytes."""
+
+    # Stream hand-offs + background checkpoint sinks on (--no-dataplane
+    # restores the exact serial path: inline writes, every stage
+    # re-reading its input from the file contract).  Single-process
+    # runs only; multi-host ranks always take the file contract.
+    enabled: bool = True
+    # Write the demoted inter-stage files (features.pkl,
+    # word_counts.dat, words/doc/model.dat, final.*, likelihood.dat,
+    # doc/word_results.csv).  --no-checkpoints skips them all: the run
+    # produces only its product artifacts (results CSV, metrics.json,
+    # run_journal.jsonl), and a later `--stages` resume is REFUSED
+    # against the missing file contract (fail-fast with the artifact
+    # name) instead of silently recomputing.  Batch single-host
+    # full-chain runs only.
+    checkpoints: bool = True
+    # Rows per columnar chunk on the featurizer→corpus edge.  Small
+    # enough that interning overlaps the pre stage's checkpoint writes
+    # from the first chunk; large enough that per-chunk remap overhead
+    # (an np.unique pass) stays negligible against ~1.5M-row days.
+    chunk_rows: int = 1 << 18
+    # Bounded-buffer depth per channel: a producer can run at most
+    # this many chunks ahead of its consumer before its put() stalls
+    # (the stall is priced as a dataplane.stall span).
+    channel_capacity: int = 4
+    # Concurrent background checkpoint writers.  Two overlaps the
+    # pickle dump with the word-counts emit on the pre stage without
+    # letting file IO steal every core from the compute stages.
+    sink_workers: int = 2
+
+
+@dataclass(frozen=True)
 class PlansConfig:
     """Measured execution plans (oni_ml_tpu/plans/, docs/performance.md
     "Measured execution plans"): the persistent autotune + plan cache
@@ -379,6 +417,7 @@ class PipelineConfig:
     serving: ServingConfig = field(default_factory=ServingConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     plans: PlansConfig = field(default_factory=PlansConfig)
+    dataplane: DataplaneConfig = field(default_factory=DataplaneConfig)
     # Mesh shape: (data, model). data shards documents, model shards the
     # vocabulary axis of beta.  (1, 1) = single device.
     mesh_shape: tuple = (1, 1)

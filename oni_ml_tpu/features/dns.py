@@ -175,6 +175,14 @@ class DnsFeatures:
             agg[k] = agg.get(k, 0) + 1
         return [(ip, w, c) for (ip, w), c in agg.items()]
 
+    def word_count_columns(self):
+        """Columnar word-count hand-off (dataplane/columns.py): the
+        triples interned in first-seen order, so the streaming corpus
+        builder assigns exactly the file contract's ids."""
+        from ..dataplane.columns import intern_word_counts
+
+        return intern_word_counts(self.word_counts())
+
     def featurized_row(self, i: int) -> list[str]:
         """Row as dns_post_lda sees it pre-scoring: 8 cols + domain,
         subdomain, subdomain.length, num.periods, subdomain.entropy,

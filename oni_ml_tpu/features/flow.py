@@ -114,6 +114,14 @@ class FlowFeatures:
             (ip, w, c) for (ip, w), c in dst.items()
         ]
 
+    def word_count_columns(self):
+        """Columnar word-count hand-off (dataplane/columns.py): the
+        triples interned in first-seen order, so the streaming corpus
+        builder assigns exactly the file contract's ids."""
+        from ..dataplane.columns import intern_word_counts
+
+        return intern_word_counts(self.word_counts())
+
     def featurized_row(self, i: int) -> list[str]:
         """The row as flow_post_lda sees it pre-scoring: original 27 cols
         + num_time + ibyt_bin/ipkt_bin/time_bin + word_port/ip_pair/

@@ -219,6 +219,18 @@ class NativeDnsFeatures:
             for i, w, c in zip(self.wc_ip, self.wc_word, self.wc_count)
         ]
 
+    def word_count_columns(self):
+        """Columnar word-count hand-off (dataplane/columns.py): the
+        aggregated table-id arrays straight from the native pass — no
+        string materialization; the streaming corpus builder's
+        first-seen remap reproduces `Corpus.from_features` exactly."""
+        from ..dataplane.columns import make_word_count_columns
+
+        return make_word_count_columns(
+            self.wc_ip, self.wc_word, self.wc_count,
+            self.ip_table, self.word_table,
+        )
+
     def featurized_row(self, i: int) -> list[str]:
         return self.row(i) + [
             self.domain_table[self.dom_id[i]],

@@ -203,6 +203,26 @@ class Corpus:
             os.path.join(directory, "model.dat"), self.doc_ptr, self.word_idx, self.counts
         )
 
+    def save_atomic(self, directory: str) -> None:
+        """`save()` with tmp+rename publication per file — what the
+        dataplane's background corpus-checkpoint sink uses.  The write
+        window overlaps the whole LDA stage there, so a hard kill
+        mid-write must never leave a COMPLETE-looking partial file
+        under a contract name that a resumed run's `_stage_done`
+        existence check would trust (identical bytes to `save()`,
+        pinned by tests/test_dataplane.py)."""
+        import os
+
+        def _publish(name, write_fn, *args):
+            tmp = os.path.join(directory, name + ".tmp")
+            write_fn(tmp, *args)
+            os.replace(tmp, os.path.join(directory, name))
+
+        _publish("words.dat", formats.write_words_dat, self.vocab)
+        _publish("doc.dat", formats.write_doc_dat, self.doc_names)
+        _publish("model.dat", formats.write_model_dat, self.doc_ptr,
+                 self.word_idx, self.counts)
+
 
 @dataclass
 class Batch:

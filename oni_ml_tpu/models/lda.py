@@ -1105,6 +1105,7 @@ def train_corpus(
     progress: Callable[[int, float, float], None] | None = None,
     mesh=None,
     vocab_sharded: bool = False,
+    save_final: bool = True,
 ) -> LDAResult:
     """Convenience: corpus -> batches -> fit -> (optionally) reference
     output files in `out_dir`.
@@ -1113,6 +1114,12 @@ def train_corpus(
     psum over ICI — the reference's MPI_Reduce, SURVEY §2.8); with
     `vocab_sharded` additionally, beta/suff-stats shard their vocabulary
     axis over `model` (BASELINE.json config 4).
+
+    `save_final=False` keeps likelihood.dat streaming and checkpoint
+    resume (both keyed off `out_dir`) but skips the final.* writes —
+    the streaming dataplane demotes those to background checkpoint
+    sinks that overlap scoring, so the trainer must not also write
+    them inline on the critical path.
     """
     e_fn = m_fn = None
     num_terms = corpus.num_terms
@@ -1187,7 +1194,7 @@ def train_corpus(
     )
     if num_terms != corpus.num_terms:
         result.log_beta = result.log_beta[:, : corpus.num_terms]
-    if out_dir and _is_coordinator():
+    if out_dir and save_final and _is_coordinator():
         # likelihood.dat was already streamed (crash-safe) during fit;
         # multi-host: the result is identical on every process (to_host
         # gathers collectively) but only the coordinator owns the files.

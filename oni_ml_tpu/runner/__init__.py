@@ -1,6 +1,6 @@
 """End-to-end pipeline runner — the framework's replacement for
 ml_ops.sh."""
 
-from .ml_ops import run_pipeline, Stage
+from .ml_ops import MissingArtifactError, run_pipeline, Stage
 
-__all__ = ["run_pipeline", "Stage"]
+__all__ = ["run_pipeline", "Stage", "MissingArtifactError"]

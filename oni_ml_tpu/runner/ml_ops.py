@@ -38,6 +38,7 @@ from enum import Enum
 from typing import Callable
 
 from ..config import (
+    DataplaneConfig,
     FeedbackConfig,
     LDAConfig,
     OnlineLDAConfig,
@@ -61,6 +62,14 @@ class Stage(str, Enum):
     CORPUS = "corpus"
     LDA = "lda"
     SCORE = "score"
+
+
+class MissingArtifactError(RuntimeError):
+    """A stage needed an upstream checkpoint that is not on disk — a
+    `--stages` invocation against an incomplete (or --no-checkpoints)
+    day.  Raised BEFORE the loader so the operator gets the artifact
+    name and the flag that regenerates it, not a stack trace from deep
+    inside a parser."""
 
 
 STAGE_ORDER = [Stage.PRE, Stage.CORPUS, Stage.LDA, Stage.SCORE]
@@ -109,6 +118,32 @@ class RunContext:
     journal_done: set = field(default_factory=set)
     recorder: object = None
     heartbeat: object = None
+    # Streaming dataplane (oni_ml_tpu/dataplane/): the per-run
+    # orchestrator for background checkpoint sinks, overlap tasks, and
+    # bounded inter-stage channels (None = the serial file-contract
+    # path: --no-dataplane, or any multi-process rank).  The hand-off
+    # slots carry live stage outputs downstream so no stage re-reads
+    # what the previous one just computed: `features` (pre→corpus AND
+    # pre→score — the featurized day is scoring's input too, so with a
+    # dataplane it survives until the score stage consumes it),
+    # `corpus_handoff` (corpus→lda), `model_handoff` (lda→score, the
+    # round-trip-exact ScoringModel), and `score_prep` (the
+    # tokenization/index prep task running concurrently with EM).
+    plane: object = None
+    corpus_handoff: object = None
+    model_handoff: object = None
+    score_prep: object = None
+    # Stages this invocation may run (wanted) — stage fns consult it to
+    # decide whether a downstream hand-off is worth producing.
+    wanted: list = field(default_factory=list)
+    # True when a replayed journal shows a prior --no-checkpoints run
+    # of this day: fail-fast messages then name the provenance of the
+    # missing file contract.
+    prior_no_checkpoints: bool = False
+    # Background-write failures collected at dataplane drain (the
+    # generalization of wc_writer_err) — the run fails on them after
+    # the finally block, without masking the run's own exception.
+    background_errs: list = field(default_factory=list)
 
     def path(self, name: str) -> str:
         return os.path.join(self.day_dir, name)
@@ -136,6 +171,38 @@ def _stage_done(ctx: RunContext, stage: Stage) -> "str | None":
     if stage.value in ctx.journal_done:
         return "journal: stage completed in a prior run"
     return "outputs exist"
+
+
+def _require_artifacts(ctx: RunContext, names: list, stage: Stage,
+                       regen_stage: Stage) -> None:
+    """Fail fast — naming the artifact and the regenerating flag —
+    when a stage's file-contract input is missing (the `--stages` /
+    resume path; in-process runs hand the live object downstream and
+    never get here)."""
+    missing = [n for n in names if not os.path.exists(ctx.path(n))]
+    if not missing:
+        return
+    msg = (
+        f"stage {stage.value} needs {missing[0]} in {ctx.day_dir} and it "
+        f"does not exist; regenerate it with `ml_ops {ctx.fdate} "
+        f"{ctx.dsource} --stages {regen_stage.value} --force`"
+        + (f" (also missing: {', '.join(missing[1:])})"
+           if len(missing) > 1 else "")
+    )
+    if ctx.prior_no_checkpoints:
+        msg += (
+            " — note: a prior run of this day used --no-checkpoints, so "
+            "no inter-stage files were written; resume is refused by "
+            "design, re-run the full day"
+        )
+    raise MissingArtifactError(msg)
+
+
+def _score_wanted(ctx: RunContext) -> bool:
+    """Whether this invocation may still run the score stage — decides
+    if the lda stage should produce the model hand-off and spawn the
+    scoring-prep overlap task."""
+    return Stage.SCORE in (ctx.wanted or STAGE_ORDER)
 
 
 def _coord_decision(value: bool) -> bool:
@@ -307,6 +374,9 @@ def stage_pre(ctx: RunContext) -> dict:
             spill_path=ctx.path("raw_lines.bin"),
             workers=workers, timings=timings,
         )
+    if ctx.plane is not None:
+        return _finish_pre_dataplane(ctx, features, fb_rows, workers,
+                                     workers_src, timings)
     t0 = time.perf_counter()
     with open(ctx.path("features.pkl"), "wb") as f:
         pickle.dump(features, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -376,6 +446,14 @@ def stage_pre(ctx: RunContext) -> dict:
         timings["wc_emit_s"] = round(time.perf_counter() - t0, 3)
         timings["wc_write"] = "inline"
     ctx.features = features  # direct handoff to stage_corpus
+    return _pre_record(ctx, features, fb_rows, workers, workers_src,
+                       timings, n_wc)
+
+
+def _pre_record(ctx: RunContext, features, fb_rows, workers, workers_src,
+                timings, n_wc) -> dict:
+    """The pre stage's metrics record, shared by the serial and
+    dataplane tails."""
     merge_wall = timings.pop("merge_s", None)
     out = {
         "events": features.num_events,
@@ -392,28 +470,139 @@ def stage_pre(ctx: RunContext) -> dict:
     return out
 
 
+def _finish_pre_dataplane(ctx: RunContext, features, fb_rows, workers,
+                          workers_src, timings) -> dict:
+    """Dataplane tail of the pre stage: the live container is the
+    hand-off (to corpus assembly AND, later, to scoring), and both
+    file artifacts — features.pkl and word_counts.dat — are demoted to
+    background checkpoint sinks whose writes overlap the downstream
+    stages.  Stale contract files are cleared synchronously BEFORE the
+    overlap window opens (tmp+rename protects against truncation, not
+    staleness — see the serial path's word_counts note)."""
+    from ..dataplane import atomic_write, atomic_write_bytes, clear_stale
+
+    plane = ctx.plane
+    pkl_path = ctx.path("features.pkl")
+    wc_path = ctx.path("word_counts.dat")
+    clear_stale(pkl_path, wc_path)
+
+    def _write_pkl(path=pkl_path, features=features):
+        def _dump(tmp):
+            with open(tmp, "wb") as f:
+                pickle.dump(features, f, protocol=pickle.HIGHEST_PROTOCOL)
+        atomic_write(path, _dump)
+
+    n_wc = None
+    if hasattr(features, "wc_ip"):
+        n_wc = len(features.wc_ip)
+
+        def _write_wc(path=wc_path, features=features):
+            from ..native_emit import word_counts_emit
+
+            blob = word_counts_emit(features)
+            if blob is not None:
+                atomic_write_bytes(path, blob)
+            else:
+                atomic_write(path, lambda tmp: formats.write_word_counts(
+                    tmp, features.word_counts()))
+    else:
+        # Fallback containers materialize triples anyway; count them
+        # here (the record needs n_wc) and only the write goes async.
+        triples = features.word_counts()
+        n_wc = len(triples)
+
+        def _write_wc(path=wc_path, triples=triples):
+            atomic_write(path, lambda tmp: formats.write_word_counts(
+                tmp, triples))
+
+    if plane.checkpoints:
+        plane.checkpoint("features_pkl", _write_pkl, stage=Stage.PRE.value)
+        plane.checkpoint("word_counts", _write_wc, stage=Stage.PRE.value)
+        timings["pickle"] = "background"
+        timings["wc_write"] = "background"
+    else:
+        timings["pickle"] = "skipped"
+        timings["wc_write"] = "skipped"
+    ctx.features = features  # hand-off: corpus assembly + scoring
+    return _pre_record(ctx, features, fb_rows, workers, workers_src,
+                       timings, n_wc)
+
+
 def stage_corpus(ctx: RunContext) -> dict:
-    if ctx.features is not None:
-        # In-process run: the featurizer's container is still live —
-        # build the CSR straight from its interned tables instead of
-        # re-parsing the ~word_count_rows text triples stage_pre just
-        # held in native arrays (identical output, pinned by
-        # tests/test_pre_parallel.py).
+    plane = ctx.plane
+    stream_info = None
+    if ctx.features is not None and plane is not None:
+        # Streaming dataplane: the featurizer's columnar word counts
+        # flow through a bounded channel into incremental first-seen
+        # assembly while the pre stage's demoted checkpoint writes
+        # (features.pkl, word_counts.dat) are still in flight — the
+        # full-day pre→corpus barrier is gone.  Identical corpus to
+        # Corpus.from_features (pinned by tests/test_dataplane.py).
+        # The features container stays parked: it is the score stage's
+        # input too.
+        from ..dataplane import (
+            consume_corpus,
+            stream_word_counts,
+            word_count_columns,
+        )
+
+        wc = word_count_columns(ctx.features)
+        ch = plane.channel("pre.wc->corpus")
+        plane.spawn(
+            "wc_stream",
+            lambda: stream_word_counts(
+                wc, ch, ctx.config.dataplane.chunk_rows
+            ),
+            stage=Stage.CORPUS.value,
+            # The producer's put() backpressure waits are idle, not
+            # work: exclude them from the task's work accounting so
+            # bench's sum-of-stage-walls can't double-count the
+            # consumer's inline wall.
+            stall=lambda: ch.stats()["put_stall_s"],
+        )
+        corpus, builder = consume_corpus(ch, wc.ip_table, wc.word_table)
+        handoff = "direct"
+        stream_info = {"chunks": builder.chunks, "rows": builder.rows}
+    elif ctx.features is not None:
+        # In-process serial run: the featurizer's container is still
+        # live — build the CSR straight from its interned tables
+        # instead of re-parsing the ~word_count_rows text triples
+        # stage_pre just held in native arrays (identical output,
+        # pinned by tests/test_pre_parallel.py).
         corpus = Corpus.from_features(ctx.features)
         handoff = "direct"
         ctx.features = None  # release featurizer arrays before LDA
     else:
         # Resume path (--stages corpus, or pre skipped as done): the
         # emitted file is the contract.
+        _require_artifacts(ctx, ["word_counts.dat"], Stage.CORPUS,
+                           Stage.PRE)
         corpus = Corpus.from_word_counts_file(ctx.path("word_counts.dat"))
         handoff = "file"
-    corpus.save(ctx.day_dir)
-    return {
+    if plane is not None:
+        # The LDA-C corpus triplet demoted to a background checkpoint
+        # overlapping EM; the live corpus hands off in memory, so the
+        # lda stage no longer re-parses model.dat it just watched this
+        # stage write.
+        from ..dataplane import clear_stale
+
+        clear_stale(*(ctx.path(n) for n in _STAGE_OUTPUTS[Stage.CORPUS]))
+        plane.checkpoint(
+            "corpus_dat", lambda: corpus.save_atomic(ctx.day_dir),
+            stage=Stage.CORPUS.value,
+        )
+        ctx.corpus_handoff = corpus
+    else:
+        corpus.save(ctx.day_dir)
+    out = {
         "docs": corpus.num_docs,
         "vocab": corpus.num_terms,
         "tokens": corpus.num_tokens,
         "handoff": handoff,
     }
+    if stream_info is not None:
+        out["stream"] = stream_info
+    return out
 
 
 def _em_progress(ctx: RunContext):
@@ -432,9 +621,47 @@ def _em_progress(ctx: RunContext):
 
 
 def stage_lda(ctx: RunContext) -> dict:
-    corpus = Corpus.from_model_dat(
-        ctx.path("model.dat"), ctx.path("words.dat"), ctx.path("doc.dat")
-    )
+    plane = ctx.plane
+    if ctx.corpus_handoff is not None:
+        # Streamed corpus: EM consumes the CSR the corpus stage just
+        # assembled in memory — the serial path's write-model.dat-then
+        # -re-parse-it round trip is gone (the file is a background
+        # checkpoint, not this stage's input).  Identical training:
+        # same id orderings, same CSR values (tests/test_dataplane.py
+        # pins final.beta/likelihood.dat bytes against the file path).
+        corpus = ctx.corpus_handoff
+        ctx.corpus_handoff = None
+        corpus_src = "handoff"
+    else:
+        _require_artifacts(ctx, ["model.dat", "words.dat", "doc.dat"],
+                           Stage.LDA, Stage.CORPUS)
+        corpus = Corpus.from_model_dat(
+            ctx.path("model.dat"), ctx.path("words.dat"),
+            ctx.path("doc.dat")
+        )
+        corpus_src = "file"
+    # The streamlined demotion path: plain batch EM only (the online
+    # and holdout trainers own their file writes inline; they keep the
+    # serial tail).
+    streamline = (plane is not None and not ctx.online
+                  and not ctx.eval_holdout)
+    if (plane is not None and ctx.features is not None
+            and not ctx.online and _score_wanted(ctx)):
+        # Scoring prep overlaps EM: the event tokenization / model-row
+        # index resolution depends only on the corpus orderings and
+        # the featurized day — both final here — so it runs on a
+        # background task for the whole fit and scoring dispatch
+        # starts the moment the model converges.
+        from ..dataplane import build_scoring_prep
+
+        feats = ctx.features
+        ctx.score_prep = plane.spawn(
+            "score_prep",
+            lambda: build_scoring_prep(
+                feats, corpus.doc_names, corpus.vocab, ctx.dsource
+            ),
+            stage=Stage.SCORE.value,
+        )
     held_metrics = {}
     if ctx.online:
         if ctx.vocab_sharded:
@@ -461,30 +688,52 @@ def stage_lda(ctx: RunContext) -> dict:
     elif ctx.eval_holdout:
         result, held_metrics = _train_with_holdout(ctx, corpus)
     else:
+        # With checkpoints off, out_dir=None turns off likelihood.dat
+        # streaming and checkpoint.npz resume too — the run's
+        # observability record is the journal's em_ll stream.
+        out_dir = ctx.day_dir if (plane is None or plane.checkpoints) \
+            else None
         result = train_corpus(
             corpus,
             ctx.config.lda,
-            out_dir=ctx.day_dir,
+            out_dir=out_dir,
             mesh=ctx.mesh,
             vocab_sharded=ctx.vocab_sharded,
             progress=_em_progress(ctx),
+            # Streamlined runs demote final.* to checkpoint sinks
+            # below; the trainer must not also write them inline.
+            save_final=not streamline,
         )
     from ..models.lda import _is_coordinator
 
     if _is_coordinator():
-        # result is rank-identical (collective gathers in train_corpus*);
-        # the shared day dir has exactly one writer.
-        formats.write_doc_results(
-            ctx.path("doc_results.csv"), corpus.doc_names, result.gamma
-        )
-        formats.write_word_results(
-            ctx.path("word_results.csv"), corpus.vocab, result.log_beta
+        if streamline:
+            _demote_lda_artifacts(ctx, corpus, result)
+        else:
+            # result is rank-identical (collective gathers in
+            # train_corpus*); the shared day dir has exactly one writer.
+            formats.write_doc_results(
+                ctx.path("doc_results.csv"), corpus.doc_names, result.gamma
+            )
+            formats.write_word_results(
+                ctx.path("word_results.csv"), corpus.vocab, result.log_beta
+            )
+    if streamline and _score_wanted(ctx):
+        # lda→score hand-off: the ScoringModel assembled in memory with
+        # the results CSVs' round-trip arithmetic (ScoringModel.from_lda
+        # — identical doubles, so identical scored bytes), parked so
+        # scoring starts without reading back the demoted checkpoints.
+        sc = ctx.config.scoring
+        ctx.model_handoff = ScoringModel.from_lda(
+            corpus.doc_names, result.gamma, corpus.vocab, result.log_beta,
+            sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback,
         )
     lls = [ll for ll, _ in result.likelihoods]
     out = {
         "em_iters": result.em_iters,
         "final_likelihood": lls[-1] if lls else None,
         "alpha": result.alpha,
+        "corpus": corpus_src,
     }
     # Dispatch-knob provenance (plans.resolve via the trainer): which
     # source — config override, measured plan, or shipped default —
@@ -497,6 +746,52 @@ def stage_lda(ctx: RunContext) -> dict:
                                      corpus))
     out.update(held_metrics)
     return out
+
+
+def _demote_lda_artifacts(ctx: RunContext, corpus, result) -> None:
+    """Submit the model artifacts (final.beta/gamma/other,
+    doc_results.csv, word_results.csv) as background checkpoint sinks
+    overlapping the score stage — same bytes as the serial inline
+    writes, published atomically because the write window now spans
+    downstream compute."""
+    from ..dataplane import atomic_write, clear_stale
+
+    plane = ctx.plane
+    clear_stale(*(ctx.path(n) for n in (
+        "final.beta", "final.gamma", "final.other",
+        "doc_results.csv", "word_results.csv",
+    )))
+    log_beta, gamma, alpha = result.log_beta, result.gamma, result.alpha
+    k = log_beta.shape[0]
+    num_terms = corpus.num_terms
+    doc_names, vocab = corpus.doc_names, corpus.vocab
+
+    def _write_final():
+        atomic_write(ctx.path("final.beta"),
+                     lambda tmp: formats.write_beta(tmp, log_beta))
+        atomic_write(ctx.path("final.gamma"),
+                     lambda tmp: formats.write_gamma(tmp, gamma))
+        atomic_write(ctx.path("final.other"),
+                     lambda tmp: formats.write_other(tmp, k, num_terms,
+                                                     alpha))
+
+    plane.checkpoint("final_model", _write_final, stage=Stage.LDA.value)
+    plane.checkpoint(
+        "doc_results",
+        lambda: atomic_write(
+            ctx.path("doc_results.csv"),
+            lambda tmp: formats.write_doc_results(tmp, doc_names, gamma),
+        ),
+        stage=Stage.LDA.value,
+    )
+    plane.checkpoint(
+        "word_results",
+        lambda: atomic_write(
+            ctx.path("word_results.csv"),
+            lambda tmp: formats.write_word_results(tmp, vocab, log_beta),
+        ),
+        stage=Stage.LDA.value,
+    )
 
 
 def _train_with_holdout(ctx: RunContext, corpus):
@@ -615,8 +910,51 @@ def _completion_score(ctx: RunContext, log_beta, alpha, corpus=None) -> dict:
 
 
 def stage_score(ctx: RunContext) -> dict:
-    with open(ctx.path("features.pkl"), "rb") as f:
-        features = pickle.load(f)
+    if ctx.features is not None:
+        # Streaming dataplane: the live featurized day IS the scoring
+        # input — no features.pkl read-back (that file is a background
+        # checkpoint of the same object, so the arrays are identical).
+        features = ctx.features
+        ctx.features = None
+        feat_src = "handoff"
+    else:
+        _require_artifacts(ctx, ["features.pkl"], Stage.SCORE, Stage.PRE)
+        with open(ctx.path("features.pkl"), "rb") as f:
+            features = pickle.load(f)
+        feat_src = "file"
+        _resolve_spill_blobs(ctx, features)
+    sc = ctx.config.scoring
+    fallback = sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback
+    if ctx.model_handoff is not None:
+        model = ctx.model_handoff
+        ctx.model_handoff = None
+        model_src = "handoff"
+    else:
+        _require_artifacts(
+            ctx, ["doc_results.csv", "word_results.csv"], Stage.SCORE,
+            Stage.LDA,
+        )
+        model = ScoringModel.from_files(
+            ctx.path("doc_results.csv"), ctx.path("word_results.csv"),
+            fallback,
+        )
+        model_src = "file"
+    prep = None
+    if ctx.score_prep is not None:
+        # Join the EM-overlapped tokenization/index prep; by the time
+        # training has converged this is normally already done, so the
+        # span prices (near-)zero wait — a long join here means the
+        # overlap failed to hide the prep and shows up in trace_view.
+        from ..telemetry.spans import maybe_span
+
+        with maybe_span("dataplane.prep_join"):
+            prep = ctx.score_prep.result()
+        ctx.score_prep = None
+    return _score_day(ctx, features, model, prep,
+                      feat_src=feat_src, model_src=model_src)
+
+
+def _resolve_spill_blobs(ctx: RunContext, features) -> None:
     # Spilled raw rows (stage_pre) are referenced by the path recorded
     # at pre time.  The spill file lives beside features.pkl, so a
     # moved/renamed/published day dir invalidates the recorded path
@@ -670,11 +1008,10 @@ def stage_score(ctx: RunContext) -> dict:
                 "deleted or the day dir moved without it; re-run the pre "
                 "stage (--stages pre --force)"
             )
+
+def _score_day(ctx: RunContext, features, model, prep,
+               feat_src: str, model_src: str) -> dict:
     sc = ctx.config.scoring
-    fallback = sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback
-    model = ScoringModel.from_files(
-        ctx.path("doc_results.csv"), ctx.path("word_results.csv"), fallback
-    )
     from ..scoring import DispatchStats, score_dns_csv, score_flow_csv
 
     score_fn = score_flow_csv if ctx.dsource == "flow" else score_dns_csv
@@ -721,14 +1058,31 @@ def stage_score(ctx: RunContext) -> dict:
     blob, scores = score_fn(
         features, model, sc.threshold,
         engine=sc.engine, chunk=chunk, mesh=ctx.mesh,
-        stats=stats,
+        stats=stats, prep=prep,
     )
-    with open(ctx.path(ctx.results_name()), "wb") as f:
-        f.write(blob)
+    res_path = ctx.path(ctx.results_name())
+    if ctx.plane is not None:
+        # The results CSV is a PRODUCT, not a checkpoint: its write is
+        # demoted to a background sink (overlapping the run's drain /
+        # metrics tail) but never skipped by --no-checkpoints.
+        from ..dataplane import atomic_write_bytes, clear_stale
+
+        clear_stale(res_path)
+        ctx.plane.output(
+            "results_csv",
+            lambda: atomic_write_bytes(res_path, blob),
+            stage=Stage.SCORE.value,
+        )
+    else:
+        with open(res_path, "wb") as f:
+            f.write(blob)
     out = {
         "scored_events": features.num_raw_events,
         "flagged": int(len(scores)),
         "min_score": float(scores[0]) if len(scores) else None,
+        "features": feat_src,
+        "model": model_src,
+        "prep": "overlapped" if prep is not None else "inline",
     }
     if plans_rec is not None:
         out["plans"] = plans_rec
@@ -809,6 +1163,29 @@ def run_pipeline(
             "use --eval-quality for drift monitoring (full-day training "
             "and scoring) or --eval-holdout for a true held-out score"
         )
+    dp = config.dataplane
+    if not dp.checkpoints:
+        # Checkpoints-off is the pure-streaming mode: nothing but the
+        # product artifacts is written, so there is no file contract to
+        # resume against.  Restrict it to the configurations where that
+        # is coherent — a full in-process batch chain.
+        if not dp.enabled:
+            raise ValueError(
+                "--no-checkpoints requires the streaming dataplane "
+                "(drop --no-dataplane)"
+            )
+        if stages is not None:
+            raise ValueError(
+                "--no-checkpoints cannot run a --stages subset: without "
+                "the file contract there is nothing for a partial run "
+                "to read or resume from"
+            )
+        if online or eval_holdout:
+            raise ValueError(
+                "--no-checkpoints supports the plain batch pipeline "
+                "only (the online/holdout trainers own their file "
+                "contracts)"
+            )
     day_dir = formats.ensure_dir(config.day_dir(fdate))
     ctx = RunContext(
         config=config,
@@ -858,6 +1235,16 @@ def run_pipeline(
     multiproc = jax.process_count() > 1
     is_coord = jax.process_index() == 0
     wanted = stages or STAGE_ORDER
+    ctx.wanted = list(wanted)
+    if not dp.checkpoints and multiproc:
+        # Multi-host ranks coordinate through the shared file contract
+        # (the plane is single-process only) — a pure-streaming run is
+        # impossible there, and silently writing the full contract
+        # would contradict what the operator asked for.
+        raise ValueError(
+            "--no-checkpoints requires a single-process run: multi-host "
+            "ranks coordinate through the inter-stage file contract"
+        )
 
     # Telemetry flight recorder (docs/observability.md).  Coordinator
     # only: the shared day dir has exactly one journal writer, like
@@ -879,6 +1266,13 @@ def run_pipeline(
         jpath = ctx.path("run_journal.jsonl")
         replayed = Journal.replay(jpath)
         prior_done = RunJournal.completed_stages(replayed)
+        # Provenance for fail-fast messages: a prior --no-checkpoints
+        # run explains a day dir with a journal but no file contract.
+        ctx.prior_no_checkpoints = any(
+            r.get("kind") == "run_start"
+            and r.get("checkpoints") is False
+            for r in replayed
+        )
         ctx.journal = RunJournal(
             Journal(jpath, fsync_every=tel.journal_fsync_every)
         )
@@ -888,6 +1282,7 @@ def run_pipeline(
             stages=[Stage(s).value for s in wanted],
             replayed_records=len(replayed),
             journal_done=sorted(prior_done),
+            checkpoints=dp.checkpoints,
         )
         ctx.recorder = Recorder(journal=ctx.journal.journal)
         if tel.heartbeat_s > 0:
@@ -902,6 +1297,23 @@ def run_pipeline(
                 recorder=ctx.recorder,
             ).start()
             ctx.heartbeat = hb
+
+    # Streaming dataplane (oni_ml_tpu/dataplane): single-process runs
+    # only — multi-host ranks coordinate through the shared file
+    # contract, exactly as before.  The plane owns the run's background
+    # checkpoint sinks, overlap tasks, and bounded channels; it is
+    # drained (joined, errors surfaced) in the finally below, the
+    # generalization of the old word_counts writer join.
+    plane_record = None
+    if dp.enabled and not multiproc:
+        from ..dataplane import Dataplane
+
+        ctx.plane = Dataplane(
+            dp,
+            recorder=ctx.recorder,
+            journal=ctx.journal.journal if ctx.journal is not None
+            else None,
+        )
 
     run_ok = False
     run_err: "BaseException | None" = None
@@ -925,18 +1337,31 @@ def run_pipeline(
         if th is not None:
             th.join()
             ctx.wc_writer = None
+        if ctx.plane is not None:
+            # Drain the dataplane: join every background checkpoint
+            # sink and overlap task (demoted writes are part of the
+            # run's contract — the day dir must be complete before
+            # this process hands it to anyone), collect their errors,
+            # and keep the per-task/per-edge accounting for the
+            # metrics record below.
+            plane_record = ctx.plane.drain()
+            ctx.background_errs.extend(ctx.plane.errors)
+        if ctx.wc_writer_err:
+            ctx.background_errs.extend(
+                ("word_counts", e) for e in ctx.wc_writer_err
+            )
         if hb is not None:
             hb.stop()
         if ctx.journal is not None:
-            # A failed background word_counts.dat write fails the RUN
-            # (the RuntimeError below) — the journal's run_end must not
+            # A failed background checkpoint write fails the RUN (the
+            # RuntimeError below) — the journal's run_end must not
             # record ok=True for an invocation whose caller saw an
-            # exception and whose pre-stage contract file is missing.
+            # exception and whose contract file is missing.
             err = run_err if run_err is not None else (
-                ctx.wc_writer_err[0] if ctx.wc_writer_err else None
+                ctx.background_errs[0][1] if ctx.background_errs else None
             )
             ctx.journal.run_end(
-                ok=run_ok and not ctx.wc_writer_err,
+                ok=run_ok and not ctx.background_errs,
                 **({} if err is None else {"error": repr(err)[:300]}),
             )
             ctx.journal.close()
@@ -945,10 +1370,11 @@ def run_pipeline(
             # every exit path; the process-wide default store stays
             # open.
             plan_store.close()
-    if ctx.wc_writer_err:
+    if ctx.background_errs:
+        name, first = ctx.background_errs[0]
         raise RuntimeError(
-            "background word_counts.dat write failed"
-        ) from ctx.wc_writer_err[0]
+            f"dataplane background write/task {name!r} failed"
+        ) from first
     if is_coord:
         # The run's plans/compile accounting: how many XLA compile
         # requests the persistent cache served (a fully warmed re-run
@@ -980,6 +1406,15 @@ def run_pipeline(
         rl_records = _roofline.emitted_records(since=roofline0)
         if rl_records:
             ctx.emit({"stage": "roofline", "records": rl_records})
+        if plane_record is not None and (
+            plane_record["tasks"] or plane_record["edges"]
+        ):
+            # Dataplane accounting: per-task walls with stage
+            # attribution (the work the overlap hid) and per-edge
+            # queue/stall totals — what bench.py's pipeline_e2e
+            # critical-path breakdown and trace_view's stall table
+            # consume.
+            ctx.emit({"stage": "dataplane", **plane_record})
 
     def _dump_metrics() -> None:
         with open(ctx.path("metrics.json"), "w") as f:
@@ -1000,16 +1435,28 @@ def run_pipeline(
     return ctx.metrics
 
 
+def _release_handoffs(ctx: RunContext, stage: Stage) -> None:
+    """Drop hand-offs whose consumer (this stage) will not run.  The
+    featurizer container has TWO consumers on the dataplane — corpus
+    assembly and scoring — so it survives a skipped corpus stage when
+    the score stage is still coming; a serial run keeps the legacy
+    release-before-LDA's-peak behavior (scoring re-reads
+    features.pkl)."""
+    if stage is Stage.CORPUS:
+        if ctx.plane is None or not _score_wanted(ctx):
+            ctx.features = None
+    elif stage is Stage.LDA:
+        ctx.corpus_handoff = None
+    elif stage is Stage.SCORE:
+        ctx.features = None
+        ctx.model_handoff = None
+
+
 def _run_stages(ctx: RunContext, wanted, force: bool, multiproc: bool,
                 is_coord: bool) -> None:
     for stage in STAGE_ORDER:
         if stage not in wanted:
-            if stage is Stage.CORPUS:
-                # The handoff container only has one consumer; a run
-                # that excludes the corpus stage must not hold the
-                # featurizer's arrays (and, in no-spill runs, the raw
-                # blob) through LDA's peak.
-                ctx.features = None
+            _release_handoffs(ctx, stage)
             continue
         done = (
             _stage_done(ctx, stage) if (is_coord or not multiproc) else None
@@ -1018,8 +1465,7 @@ def _run_stages(ctx: RunContext, wanted, force: bool, multiproc: bool,
         if multiproc:
             skip = _coord_decision(skip)
         if skip:
-            if stage is Stage.CORPUS:
-                ctx.features = None  # see above
+            _release_handoffs(ctx, stage)
             if is_coord:
                 record = {"stage": stage.value, "skipped": done}
                 if ctx.journal is not None:
@@ -1112,6 +1558,10 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
             enabled=not args.no_plans,
             cache_path=args.plan_cache or "",
             compilation_cache=not args.no_compilation_cache,
+        ),
+        dataplane=DataplaneConfig(
+            enabled=not args.no_dataplane,
+            checkpoints=not args.no_checkpoints,
         ),
     )
 
@@ -1277,6 +1727,24 @@ def build_parser() -> argparse.ArgumentParser:
         "the run's metrics record compile requests vs cache hits)",
     )
     p.add_argument(
+        "--no-dataplane", action="store_true",
+        help="disable the streaming dataplane (oni_ml_tpu/dataplane): "
+        "run the serial file-contract pipeline — every stage writes "
+        "its artifacts inline and the next stage reads them back from "
+        "disk.  Artifacts are byte-identical either way; the dataplane "
+        "only changes when files land and what stages read",
+    )
+    p.add_argument(
+        "--no-checkpoints", action="store_true",
+        help="skip the demoted inter-stage checkpoint files entirely "
+        "(features.pkl, word_counts.dat, words/doc/model.dat, final.*, "
+        "likelihood.dat, doc/word_results.csv): the run streams "
+        "everything in memory and writes only its products (results "
+        "CSV, metrics.json, run_journal.jsonl).  A later --stages "
+        "resume against such a day is refused — there is no file "
+        "contract to resume from.  Full-chain batch runs only",
+    )
+    p.add_argument(
         "--profile", default=None, metavar="DIR",
         help="capture a jax.profiler trace of the whole run into DIR "
         "(view with TensorBoard); replaces the reference's bash `time` "
@@ -1366,6 +1834,18 @@ def main(argv: list[str] | None = None) -> int:
             flush=True,
         )
         return 3
+    except MissingArtifactError as e:
+        # A --stages resume against a missing upstream checkpoint:
+        # structured fail-fast naming the artifact and the regenerating
+        # flag, not a loader stack trace.
+        print(
+            json.dumps({
+                "fdate": args.fdate, "dsource": args.dsource,
+                "error": "missing_artifact", "detail": str(e),
+            }),
+            flush=True,
+        )
+        return 2
     return 0
 
 

@@ -90,6 +90,39 @@ class ScoringModel:
         vocab, word_topic = formats.read_word_results(word_results_path)
         return cls.from_results(doc_names, doc_topic, vocab, word_topic, fallback)
 
+    @classmethod
+    def from_lda(
+        cls, doc_names: list[str], gamma: np.ndarray, vocab: list[str],
+        log_beta: np.ndarray, fallback: float,
+    ) -> "ScoringModel":
+        """In-memory model from a trained LDA result, equal *to the
+        double* to writing doc_results.csv / word_results.csv and
+        loading them back with `from_files` — the EM→score hand-off the
+        streaming dataplane uses so scoring never waits on (or reads
+        back) the demoted result-file checkpoints.
+
+        Round-trip exactness: the writers format with `str(float64)`
+        (shortest repr, which parses back to the identical double), so
+        replicating their normalization arithmetic — per-row here,
+        exactly as write_doc_results folds each row — yields the
+        file-path matrices bit-for-bit, and therefore byte-identical
+        scored CSVs (pinned by tests/test_dataplane.py)."""
+        gamma = np.asarray(gamma, dtype=np.float64)
+        doc_topic = np.zeros_like(gamma)
+        totals = gamma.sum(axis=1)
+        nz = totals > 0
+        # Elementwise row / row-sum, vectorized: identical doubles to
+        # the per-row fold write_doc_results performs (same pairwise
+        # row reduction, same single division per element).
+        doc_topic[nz] = gamma[nz] / totals[nz][:, None]
+        log_beta = np.asarray(log_beta, dtype=np.float64)
+        # Verbatim write_word_results arithmetic (exp+normalize with
+        # the row-max shift), transposed to V x K.
+        shifted = np.exp(log_beta - log_beta.max(axis=1, keepdims=True))
+        word_topic = (shifted / shifted.sum(axis=1, keepdims=True)).T
+        return cls.from_results(doc_names, doc_topic, vocab, word_topic,
+                                fallback)
+
     def ip_rows(self, ips: list[str]) -> np.ndarray:
         return _index_rows(self.ip_index, ips, len(self.ip_index))
 
@@ -456,9 +489,74 @@ def _dns_client_strings(features, n: int):
     return [features.client_ip(i) for i in range(n)]
 
 
+def flow_event_indices(features, ip_index: dict, word_index: dict):
+    """Model-row index arrays (sip, sw, dip, dw) for every raw flow
+    event, resolved against the given `{ip: row}` / `{word: row}`
+    orderings (the doc_results / word_results row orders); misses get
+    the fallback row `len(index)`.  Shared by the inline scoring path
+    and the dataplane's scoring prep (which runs it concurrently with
+    EM — it depends only on the corpus orderings, never the trained
+    model)."""
+    n = features.num_raw_events
+    fb_ip, fb_w = len(ip_index), len(word_index)
+    if hasattr(features, "sip_id"):
+        # Native-backed features carry interned id arrays: resolve model
+        # rows once per unique IP/word, then gather — O(unique) dict
+        # lookups instead of O(events).
+        ip_map = _index_rows(ip_index, features.ip_table, fb_ip)
+        word_map = _index_rows(word_index, features.word_table, fb_w)
+        return (
+            ip_map[features.sip_id[:n]], word_map[features.sw_id[:n]],
+            ip_map[features.dip_id[:n]], word_map[features.dw_id[:n]],
+        )
+    sips, dips = _flow_endpoint_strings(features, n)
+    return (
+        _index_rows(ip_index, sips, fb_ip),
+        _index_rows(word_index, features.src_word[:n], fb_w),
+        _index_rows(ip_index, dips, fb_ip),
+        _index_rows(word_index, features.dest_word[:n], fb_w),
+    )
+
+
+def dns_event_indices(features, ip_index: dict, word_index: dict):
+    """Model-row index arrays (ip, word) for every raw DNS event (see
+    flow_event_indices)."""
+    n = features.num_raw_events
+    fb_ip, fb_w = len(ip_index), len(word_index)
+    if hasattr(features, "word_id"):
+        ip_map = _index_rows(ip_index, features.ip_table, fb_ip)
+        word_map = _index_rows(word_index, features.word_table, fb_w)
+        return ip_map[features.ip_id[:n]], word_map[features.word_id[:n]]
+    return (
+        _index_rows(ip_index, _dns_client_strings(features, n), fb_ip),
+        _index_rows(word_index, features.word[:n], fb_w),
+    )
+
+
+def _prep_indices(prep, features, model: ScoringModel, dsource: str,
+                  index_fn):
+    """Event index arrays from a dataplane ScoringPrep when one is
+    supplied (verified against this model's index spaces — a mismatch
+    is a bug and fails loudly), else resolved inline."""
+    if prep is not None:
+        if prep.dsource != dsource:
+            raise ValueError(
+                f"scoring prep is for dsource {prep.dsource!r}, "
+                f"scoring {dsource!r}"
+            )
+        if prep.num_raw_events != features.num_raw_events:
+            raise ValueError(
+                f"scoring prep covers {prep.num_raw_events} raw events, "
+                f"features carry {features.num_raw_events}"
+            )
+        prep.check_model(model)
+        return prep.indices
+    return index_fn(features, model.ip_index, model.word_index)
+
+
 def _flow_scored(features, model: ScoringModel, threshold: float,
                  engine: str | None = None, chunk: int | None = None,
-                 mesh=None, stats=None):
+                 mesh=None, stats=None, prep=None):
     """Shared flow scoring core -> (blob | None, rows | None, scores):
     exactly one of blob/rows is set — native emit produces the bytes
     buffer, the Python loop produces the row list — so each public
@@ -468,24 +566,13 @@ def _flow_scored(features, model: ScoringModel, threshold: float,
     engine="device" routes the score+filter through the fused on-chip
     pipeline (scoring/pipeline.py): f32 arithmetic, chunked dispatch,
     survivors-only readback; `mesh` shards it data-parallel.  The
-    default host engine stays the float64 golden-bytes oracle."""
+    default host engine stays the float64 golden-bytes oracle.
+    `prep` (dataplane ScoringPrep) supplies the event index arrays
+    precomputed concurrently with EM."""
     n = features.num_raw_events
-    if hasattr(features, "sip_id"):
-        # Native-backed features carry interned id arrays: resolve model
-        # rows once per unique IP/word, then gather — O(unique) dict
-        # lookups instead of O(events).
-        ip_map = model.ip_rows(features.ip_table)
-        word_map = model.word_rows(features.word_table)
-        sip_idx = ip_map[features.sip_id[:n]]
-        sw_idx = word_map[features.sw_id[:n]]
-        dip_idx = ip_map[features.dip_id[:n]]
-        dw_idx = word_map[features.dw_id[:n]]
-    else:
-        sips, dips = _flow_endpoint_strings(features, n)
-        sip_idx = model.ip_rows(sips)
-        sw_idx = model.word_rows(features.src_word[:n])
-        dip_idx = model.ip_rows(dips)
-        dw_idx = model.word_rows(features.dest_word[:n])
+    sip_idx, sw_idx, dip_idx, dw_idx = _prep_indices(
+        prep, features, model, "flow", flow_event_indices
+    )
     if _score_engine(engine) == "device":
         from . import pipeline
 
@@ -525,17 +612,18 @@ def _flow_scored(features, model: ScoringModel, threshold: float,
 def score_flow_csv(
     features: FlowFeatures, model: ScoringModel, threshold: float,
     engine: str | None = None, chunk: int | None = None,
-    mesh=None, stats=None,
+    mesh=None, stats=None, prep=None,
 ) -> tuple[bytes, np.ndarray]:
     """Flow scoring with the output as one CSV buffer (newline-
     terminated rows) — the fast path for the runner, which writes the
     bytes straight to <dsource>_results.csv.  Row assembly runs in C++
     for native-backed features (native_src/row_emit.cpp; >90% of the
     stage is emit otherwise), bit-identical to the Python loop.
-    engine/chunk/mesh/stats select and instrument the device pipeline
-    (see _flow_scored)."""
+    engine/chunk/mesh/stats select and instrument the device pipeline;
+    `prep` supplies dataplane-precomputed event indices (see
+    _flow_scored)."""
     blob, rows, scores = _flow_scored(features, model, threshold,
-                                      engine, chunk, mesh, stats)
+                                      engine, chunk, mesh, stats, prep)
     if blob is None:
         blob = "".join(r + "\n" for r in rows).encode(
             "utf-8", "surrogateescape"
@@ -567,18 +655,12 @@ def score_flow(
 
 def _dns_scored(features, model: ScoringModel, threshold: float,
                 engine: str | None = None, chunk: int | None = None,
-                mesh=None, stats=None):
+                mesh=None, stats=None, prep=None):
     """Shared DNS scoring core (see _flow_scored)."""
     n = features.num_raw_events
-    if hasattr(features, "word_id"):
-        # Native-backed: O(unique) model-row resolution (see score_flow).
-        ip_map = model.ip_rows(features.ip_table)
-        word_map = model.word_rows(features.word_table)
-        ip_idx = ip_map[features.ip_id[:n]]
-        word_idx = word_map[features.word_id[:n]]
-    else:
-        ip_idx = model.ip_rows(_dns_client_strings(features, n))
-        word_idx = model.word_rows(features.word[:n])
+    ip_idx, word_idx = _prep_indices(
+        prep, features, model, "dns", dns_event_indices
+    )
     if _score_engine(engine) == "device":
         from . import pipeline
 
@@ -608,11 +690,11 @@ def _dns_scored(features, model: ScoringModel, threshold: float,
 def score_dns_csv(
     features: DnsFeatures, model: ScoringModel, threshold: float,
     engine: str | None = None, chunk: int | None = None,
-    mesh=None, stats=None,
+    mesh=None, stats=None, prep=None,
 ) -> tuple[bytes, np.ndarray]:
     """DNS scoring as one CSV buffer (see score_flow_csv)."""
     blob, rows, scores = _dns_scored(features, model, threshold,
-                                     engine, chunk, mesh, stats)
+                                     engine, chunk, mesh, stats, prep)
     if blob is None:
         blob = "".join(r + "\n" for r in rows).encode(
             "utf-8", "surrogateescape"

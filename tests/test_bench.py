@@ -178,11 +178,20 @@ def test_bench_dns_scoring_smoke():
 def test_bench_pipeline_e2e_smoke():
     import bench
 
-    total, stages, eps, pre = bench.bench_pipeline_e2e(
+    total, stages, eps, pre, critical = bench.bench_pipeline_e2e(
         n_events=3000, n_src=50, n_dst=30, em_max_iters=3
     )
     assert total > 0 and eps > 0
     assert set(stages) == {"pre", "corpus", "lda", "score"}
+    # The critical-path breakdown: per-stage walls (inline + that
+    # stage's background tasks), the serial-equivalent sum, the
+    # overlapped e2e wall, and the headline overlap_efficiency.
+    assert set(critical["stage_wall_s"]) == {"pre", "corpus", "lda",
+                                             "score"}
+    assert critical["sum_of_stage_walls_s"] > 0
+    assert critical["e2e_wall_s"] > 0
+    assert critical["overlap_efficiency"] is not None
+    assert "edges" in critical  # dataplane ran: per-edge stall stats
     # The pre record carries the parallel-featurization payload: the
     # resolved worker count, per-pass walls, the handoff mode, and (on
     # a multi-core host) the sequential comparison.
@@ -191,7 +200,7 @@ def test_bench_pipeline_e2e_smoke():
     assert isinstance(pre["wall"], dict)
     if pre["pre_workers"] > 1:
         assert pre["pre_s_workers1"] > 0
-    total, stages, eps, pre = bench.bench_pipeline_e2e(
+    total, stages, eps, pre, _ = bench.bench_pipeline_e2e(
         n_events=2000, n_src=40, em_max_iters=3, dsource="dns",
         compare_pre_workers1=False,
     )
@@ -241,7 +250,13 @@ def _patch_phases(bench, monkeypatch):
         bench, "bench_pipeline_e2e",
         lambda *a, **k: (60.0, {"pre": 10.0, "lda": 40.0}, 80000.0,
                          {"pre_workers": 2, "wall": {}, "handoff": "direct",
-                          "pre_s_workers1": 18.0}),
+                          "pre_s_workers1": 18.0},
+                         {"stage_wall_s": {"pre": 10.0, "lda": 40.0},
+                          "per_stage_wall_s": {"pre": 12.0, "lda": 40.0},
+                          "background_wall_s": 2.0,
+                          "sum_of_stage_walls_s": 52.0,
+                          "e2e_wall_s": 60.0,
+                          "overlap_efficiency": -0.1538, "edges": {}}),
     )
     monkeypatch.setattr(bench, "_backend_responsive", lambda *a, **k: True)
     monkeypatch.setattr(
@@ -712,3 +727,89 @@ def test_bench_midrun_backend_death_annotates_record(capsys, monkeypatch):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] > 0                      # headline survived
     assert "lda_em_convergence" in rec["backend_lost"]
+
+
+def test_bench_diff_regression_gate(tmp_path):
+    """tools/bench_diff.py: the documented post-bench step — compares
+    headline / phases / utilization / overlap_efficiency between two
+    payloads and exits 1 on regression beyond thresholds."""
+    import io
+    import os
+    import sys
+    from contextlib import redirect_stdout
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import bench_diff
+
+    def payload(value, e2e_seconds, eff, mxu):
+        return {
+            "metric": "lda_em_throughput", "value": value,
+            "unit": "docs/sec",
+            "utilization": {"mxu_pct": mxu, "hbm_pct": 3.1},
+            "secondary": {
+                "pipeline_e2e": {"value": e2e_seconds, "unit": "seconds",
+                                 "overlap_efficiency": eff},
+                "dns_scoring": {"value": 150000.0, "unit": "events/sec"},
+            },
+        }
+
+    old_p = tmp_path / "old.json"
+    new_p = tmp_path / "new.json"
+    # The driver wrapper form ({"parsed": ...}) must unwrap.
+    old_p.write_text(json.dumps(
+        {"n": 5, "rc": 0, "parsed": payload(1e6, 100.0, 0.10, 10.5)}
+    ))
+
+    # 1) Improvement everywhere: exit 0.
+    new_p.write_text(json.dumps(payload(1.2e6, 90.0, 0.15, 11.0)))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert bench_diff.main([str(old_p), str(new_p)]) == 0
+    assert "no regressions" in buf.getvalue()
+
+    # 2) Throughput collapse: exit 1, headline row flagged.
+    new_p.write_text(json.dumps(payload(0.5e6, 100.0, 0.10, 10.5)))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert bench_diff.main([str(old_p), str(new_p)]) == 1
+    assert "REGRESSION" in buf.getvalue()
+
+    # 3) Seconds are lower-better: slower e2e beyond threshold fails.
+    new_p.write_text(json.dumps(payload(1e6, 150.0, 0.10, 10.5)))
+    assert bench_diff.main(
+        [str(old_p), str(new_p), "--json"]) == 1
+
+    # 4) overlap_efficiency drop beyond --efficiency-drop fails even
+    # with every wall within tolerance.
+    new_p.write_text(json.dumps(payload(1e6, 101.0, 0.01, 10.5)))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert bench_diff.main([str(old_p), str(new_p)]) == 1
+    assert "overlap_efficiency" in buf.getvalue()
+    # ...but within tolerance passes.
+    new_p.write_text(json.dumps(payload(1e6, 101.0, 0.08, 10.5)))
+    assert bench_diff.main([str(old_p), str(new_p)]) == 0
+
+    # 5) Utilization absolute-point drop fails.
+    new_p.write_text(json.dumps(payload(1e6, 100.0, 0.10, 7.0)))
+    assert bench_diff.main([str(old_p), str(new_p)]) == 1
+
+    # 6) --json emits structured rows.
+    new_p.write_text(json.dumps(payload(1.1e6, 95.0, 0.12, 10.6)))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert bench_diff.main([str(old_p), str(new_p), "--json"]) == 0
+    out = json.loads(buf.getvalue())
+    assert out["regressions"] == 0
+    names = {r["name"] for r in out["rows"]}
+    assert "headline:lda_em_throughput" in names
+    assert "phase:pipeline_e2e" in names
+    assert "overlap_efficiency:pipeline_e2e" in names
+    assert "utilization:mxu_pct" in names
+
+    # 7) Unusable input: exit 2.
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    assert bench_diff.main([str(bad), str(new_p)]) == 2

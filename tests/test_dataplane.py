@@ -1,0 +1,779 @@
+"""Streaming dataplane (oni_ml_tpu/dataplane/): channel semantics,
+columnar hand-off typing, streaming corpus assembly, EM-overlapped
+scoring prep, checkpoint demotion — and the contract the whole package
+exists to keep: every artifact byte-identical to the serial
+file-contract path, with resume/fail-fast semantics preserved.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oni_ml_tpu.config import (
+    DataplaneConfig,
+    FeedbackConfig,
+    LDAConfig,
+    PipelineConfig,
+    ScoringConfig,
+)
+from oni_ml_tpu.dataplane import (
+    Channel,
+    ChannelClosed,
+    ChannelError,
+    Column,
+    ColumnSet,
+    StreamingCorpusBuilder,
+    atomic_write_bytes,
+    build_scoring_prep,
+    clear_stale,
+    intern_word_counts,
+    stream_word_counts,
+    word_count_columns,
+)
+from oni_ml_tpu.dataplane.sinks import CheckpointSinks, Task
+from oni_ml_tpu.io import Corpus
+from oni_ml_tpu.runner import MissingArtifactError, Stage, run_pipeline
+from oni_ml_tpu.telemetry import Journal
+
+from test_features import flow_row
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------------------------
+# Channel
+# ---------------------------------------------------------------------------
+
+
+def test_channel_fifo_and_close():
+    ch = Channel("t", capacity=8)
+    for i in range(5):
+        ch.put(i)
+    ch.close()
+    assert list(ch) == [0, 1, 2, 3, 4]
+    with pytest.raises(ChannelClosed):
+        ch.get()
+    with pytest.raises(ValueError):
+        ch.put(99)  # put after close is a producer bug
+
+
+def test_channel_bounded_backpressure():
+    """A producer can run at most `capacity` items ahead; its put()
+    blocks (and the stall is accounted) until the consumer drains."""
+    ch = Channel("t", capacity=2)
+    done = threading.Event()
+
+    def producer():
+        for i in range(6):
+            ch.put(i)
+        ch.close()
+        done.set()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.05)  # let the producer hit the bound
+    assert not done.is_set()  # blocked at capacity, not buffering all 6
+    assert list(ch) == list(range(6))
+    t.join()
+    st = ch.stats()
+    assert st["puts"] == 6 and st["gets"] == 6
+    assert st["max_depth"] <= 2
+    assert st["put_stall_s"] > 0  # the blocked window was priced
+
+
+def test_channel_producer_failure_poisons_consumer():
+    ch = Channel("t", capacity=4)
+    ch.put(1)
+    ch.fail(RuntimeError("boom"))
+    assert ch.get() == 1  # buffered items drain first
+    with pytest.raises(ChannelError) as ei:
+        ch.get()
+    assert "producer failed" in str(ei.value)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+
+def test_channel_consumer_failure_unblocks_producer():
+    ch = Channel("t", capacity=1)
+    ch.put(0)  # fill to capacity
+    errs = []
+
+    def producer():
+        try:
+            ch.put(1)  # blocks on the bound
+        except ChannelError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.05)
+    ch.fail(RuntimeError("consumer died"))
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert len(errs) == 1 and "consumer failed" in str(errs[0])
+
+
+def test_channel_journals_depth_and_stalls(tmp_path):
+    jpath = str(tmp_path / "j.jsonl")
+    with Journal(jpath) as j:
+        ch = Channel("pre.wc->corpus", capacity=4, journal=j)
+        ch.put("a")
+        ch.put("b")
+        ch.get()
+        ch.close()
+    recs = [r for r in Journal.replay(jpath) if r["kind"] == "dataplane"]
+    assert [r["event"] for r in recs] == ["depth"] * 3
+    assert [(r["side"], r["depth"]) for r in recs] == [
+        ("put", 1), ("put", 2), ("get", 1)
+    ]
+    assert all(r["edge"] == "pre.wc->corpus" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# Columns
+# ---------------------------------------------------------------------------
+
+
+def test_column_dtype_kind_enforced():
+    with pytest.raises(TypeError, match="declared dtype kind"):
+        Column("doc_id", np.zeros(3, np.float64), "i")
+    with pytest.raises(TypeError, match="1-D numpy array"):
+        Column("doc_id", np.zeros((3, 2), np.int32), "i")
+
+
+def test_columnset_validation_and_chunks():
+    with pytest.raises(ValueError, match="rows"):
+        ColumnSet([Column("a", np.zeros(3, np.int32)),
+                   Column("b", np.zeros(4, np.int32))])
+    with pytest.raises(ValueError, match="duplicate"):
+        ColumnSet([Column("a", np.zeros(3, np.int32)),
+                   Column("a", np.zeros(3, np.int32))])
+    cs = ColumnSet([Column("a", np.arange(10, dtype=np.int32))])
+    chunks = list(cs.chunks(4))
+    assert [c.num_rows for c in chunks] == [4, 4, 2]
+    np.testing.assert_array_equal(
+        np.concatenate([c["a"] for c in chunks]), cs["a"]
+    )
+    # Row windows are views, not copies: chunking a day allocates nothing.
+    assert chunks[0]["a"].base is not None
+
+
+def test_intern_word_counts_matches_from_word_counts():
+    triples = [("10.0.0.2", "w_b", 3), ("10.0.0.1", "w_a", 1),
+               ("10.0.0.2", "w_a", 2), ("10.0.0.3", "w_c", 5),
+               ("10.0.0.1", "w_b", 4)]
+    wc = intern_word_counts(triples)
+    ref = Corpus.from_word_counts(triples)
+    built = StreamingCorpusBuilder()
+    built.add_arrays(wc.ids["doc_id"], wc.ids["word_id"], wc.ids["count"])
+    got = built.finish(wc.ip_table, wc.word_table)
+    assert got.doc_names == ref.doc_names
+    assert got.vocab == ref.vocab
+    np.testing.assert_array_equal(got.doc_ptr, ref.doc_ptr)
+    np.testing.assert_array_equal(got.word_idx, ref.word_idx)
+    np.testing.assert_array_equal(got.counts, ref.counts)
+
+
+# ---------------------------------------------------------------------------
+# Streaming corpus assembly == batch assembly, every chunking
+# ---------------------------------------------------------------------------
+
+
+def _flow_features(tmp_path, n=80, seed=11):
+    from oni_ml_tpu.features.native_flow import featurize_flow_file
+
+    rng = np.random.default_rng(seed)
+    lines = ["dummy,header"]
+    for _ in range(n):
+        lines.append(flow_row(
+            hour=int(rng.integers(0, 24)), minute=int(rng.integers(0, 60)),
+            second=int(rng.integers(0, 60)),
+            sip=f"10.0.0.{rng.integers(1, 12)}",
+            dip=f"172.16.0.{rng.integers(1, 12)}",
+            col10=str(rng.choice([80, 443, 55000, 0])),
+            col11=str(rng.choice([80, 6000, 70000])),
+            ipkt=str(rng.integers(1, 100)),
+            ibyt=str(rng.integers(40, 10000)),
+        ))
+    raw = tmp_path / "flow.csv"
+    raw.write_text("\n".join(lines) + "\n")
+    return featurize_flow_file(str(raw))
+
+
+def _corpora_equal(a: Corpus, b: Corpus):
+    assert a.doc_names == b.doc_names
+    assert a.vocab == b.vocab
+    np.testing.assert_array_equal(a.doc_ptr, b.doc_ptr)
+    np.testing.assert_array_equal(a.word_idx, b.word_idx)
+    np.testing.assert_array_equal(a.counts, b.counts)
+
+
+@pytest.mark.parametrize("chunk_rows", [1, 7, 64, 1 << 18])
+def test_streamed_corpus_identical_to_batch(tmp_path, chunk_rows):
+    """First-seen order over a sequentially consumed chunk stream is
+    first-seen order over the concatenation: the streamed corpus equals
+    Corpus.from_features whatever the chunking."""
+    features = _flow_features(tmp_path)
+    ref = Corpus.from_features(features)
+    wc = word_count_columns(features)
+    ch = Channel("e", capacity=2)
+    t = threading.Thread(
+        target=stream_word_counts, args=(wc, ch, chunk_rows)
+    )
+    t.start()
+    builder = StreamingCorpusBuilder()
+    for chunk in ch:
+        builder.add(chunk)
+    t.join()
+    got = builder.finish(wc.ip_table, wc.word_table)
+    _corpora_equal(got, ref)
+    assert builder.rows == len(wc.ids["doc_id"])
+
+
+def test_streamed_corpus_matches_word_counts_file(tmp_path):
+    """...and equals parsing the emitted word_counts.dat — the streamed
+    path reproduces the file contract's ids exactly."""
+    from oni_ml_tpu.io import formats
+
+    features = _flow_features(tmp_path)
+    wc_path = str(tmp_path / "wc.dat")
+    formats.write_word_counts(wc_path, features.word_counts())
+    ref = Corpus.from_word_counts_file(wc_path)
+    wc = word_count_columns(features)
+    builder = StreamingCorpusBuilder()
+    for chunk in wc.ids.chunks(13):
+        builder.add(chunk)
+    _corpora_equal(builder.finish(wc.ip_table, wc.word_table), ref)
+
+
+def test_pure_python_container_columns(tmp_path):
+    """The pure-Python fallback containers intern through
+    intern_word_counts; the columnar hand-off must agree with their
+    word_counts() triples."""
+    from oni_ml_tpu.features.flow import featurize_flow
+
+    features = _flow_features(tmp_path)
+    rows = [features.row(i) for i in range(features.num_events)]
+    lines = ["h"] + [",".join(r) for r in rows]
+    pyf = featurize_flow(lines)
+    wc = word_count_columns(pyf)
+    builder = StreamingCorpusBuilder()
+    builder.add_arrays(wc.ids["doc_id"], wc.ids["word_id"],
+                       wc.ids["count"])
+    got = builder.finish(wc.ip_table, wc.word_table)
+    _corpora_equal(got, Corpus.from_word_counts(pyf.word_counts()))
+
+
+def test_consume_corpus_failure_poisons_channel():
+    """A consumer-side failure must poison the channel so a producer
+    blocked in put() backpressure unblocks with the consumer's error
+    instead of deadlocking the plane's drain join."""
+    from oni_ml_tpu.dataplane import consume_corpus
+
+    ch = Channel("e", capacity=1)
+    errs = []
+
+    def producer():
+        try:
+            for i in range(10):
+                ch.put(ColumnSet([
+                    Column("doc_id", np.zeros(2, np.int32)),
+                    Column("word_id", np.zeros(2, np.int32)),
+                    Column("count", np.ones(2, np.int64)),
+                ]))
+            ch.close()
+        except ChannelError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=producer)
+    t.start()
+
+    class _Boom(Exception):
+        pass
+
+    orig_add = StreamingCorpusBuilder.add
+    try:
+        StreamingCorpusBuilder.add = lambda self, chunk: (
+            (_ for _ in ()).throw(_Boom("consumer died"))
+        )
+        with pytest.raises(_Boom):
+            consume_corpus(ch, [], [])
+    finally:
+        StreamingCorpusBuilder.add = orig_add
+    t.join(timeout=5.0)
+    assert not t.is_alive()  # producer unblocked, not deadlocked
+    assert errs and "consumer failed" in str(errs[0])
+
+
+def test_task_stall_excluded_from_work_accounting():
+    """A producer task's channel-backpressure stall is idle, not work:
+    it rides the completion row as stall_s and is subtracted from the
+    plane's background wall and bench's critical-path sum."""
+    import bench
+    from oni_ml_tpu.config import DataplaneConfig
+    from oni_ml_tpu.dataplane import Dataplane
+
+    plane = Dataplane(DataplaneConfig())
+    task = plane.spawn("producer", lambda: time.sleep(0.2),
+                       stall=lambda: 0.15)
+    task.join_quiet()
+    rec = plane.drain()
+    row = rec["tasks"]["producer"]
+    assert row["stall_s"] == pytest.approx(0.15)
+    assert row["wall_s"] >= 0.2
+    assert rec["background_wall_s"] == pytest.approx(
+        row["wall_s"] - 0.15, abs=0.02
+    )
+    metrics = [
+        {"stage": "pre", "wall_s": 1.0},
+        {"stage": "corpus", "wall_s": 1.0},
+        {"stage": "lda", "wall_s": 1.0},
+        {"stage": "score", "wall_s": 1.0},
+        {"stage": "dataplane", "tasks": {
+            "wc_stream": {"stage": "corpus", "wall_s": 0.9,
+                          "stall_s": 0.8, "ok": True},
+        }, "edges": {}},
+    ]
+    crit = bench.critical_path_summary(metrics, total_s=4.0)
+    # Only the 0.1s of real work counts toward the serial-equivalent.
+    assert crit["sum_of_stage_walls_s"] == pytest.approx(4.1)
+    assert crit["per_stage_wall_s"]["corpus"] == pytest.approx(1.1)
+    assert crit["background_wall_s"] == pytest.approx(0.1)
+
+
+def test_builder_rejects_ragged_chunk():
+    b = StreamingCorpusBuilder()
+    with pytest.raises(ValueError, match="ragged"):
+        b.add_arrays(np.zeros(3, np.int32), np.zeros(2, np.int32),
+                     np.zeros(3, np.int64))
+
+
+def test_corpus_save_atomic_same_bytes(tmp_path):
+    features = _flow_features(tmp_path)
+    corpus = Corpus.from_features(features)
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    corpus.save(str(a))
+    corpus.save_atomic(str(b))
+    for name in ("words.dat", "doc.dat", "model.dat"):
+        assert (a / name).read_bytes() == (b / name).read_bytes(), name
+        assert not (b / (name + ".tmp")).exists()
+
+
+# ---------------------------------------------------------------------------
+# ScoringModel.from_lda / scoring prep
+# ---------------------------------------------------------------------------
+
+
+def _tiny_lda(tmp_path):
+    from oni_ml_tpu.models.lda import train_corpus
+
+    features = _flow_features(tmp_path)
+    corpus = Corpus.from_features(features)
+    cfg = LDAConfig(num_topics=3, em_max_iters=4, batch_size=32,
+                    min_bucket_len=16, seed=5)
+    return features, corpus, train_corpus(corpus, cfg)
+
+
+def test_scoring_model_from_lda_equals_file_roundtrip(tmp_path):
+    """The lda→score hand-off: ScoringModel.from_lda equals writing
+    doc/word_results.csv and loading them back, to the double — the
+    writers' str(float64) shortest-repr round trip is exact."""
+    from oni_ml_tpu.io import formats
+    from oni_ml_tpu.scoring.score import ScoringModel
+
+    _, corpus, result = _tiny_lda(tmp_path)
+    dpath = str(tmp_path / "doc_results.csv")
+    wpath = str(tmp_path / "word_results.csv")
+    formats.write_doc_results(dpath, corpus.doc_names, result.gamma)
+    formats.write_word_results(wpath, corpus.vocab, result.log_beta)
+    via_files = ScoringModel.from_files(dpath, wpath, 1e-4)
+    in_mem = ScoringModel.from_lda(
+        corpus.doc_names, result.gamma, corpus.vocab, result.log_beta,
+        1e-4,
+    )
+    assert via_files.ip_index == in_mem.ip_index
+    assert via_files.word_index == in_mem.word_index
+    # Bit-for-bit: the file round trip (str(float64) shortest repr)
+    # must not perturb a single double.
+    np.testing.assert_array_equal(via_files.theta, in_mem.theta)
+    np.testing.assert_array_equal(via_files.p, in_mem.p)
+
+
+def test_scoring_prep_matches_inline_indices(tmp_path):
+    from oni_ml_tpu.scoring.score import flow_event_indices
+
+    features, corpus, _ = _tiny_lda(tmp_path)
+    prep = build_scoring_prep(features, corpus.doc_names, corpus.vocab,
+                              "flow")
+    ip_index = {ip: i for i, ip in enumerate(corpus.doc_names)}
+    word_index = {w: i for i, w in enumerate(corpus.vocab)}
+    inline = flow_event_indices(features, ip_index, word_index)
+    assert prep.num_raw_events == features.num_raw_events
+    for got, want in zip(prep.indices, inline):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_scoring_prep_model_mismatch_fails_loudly(tmp_path):
+    """Prep built against a different corpus than the model was trained
+    on must fail, not silently rescore with wrong rows."""
+    from oni_ml_tpu.scoring.score import score_flow_csv
+
+    features, corpus, result = _tiny_lda(tmp_path)
+    from oni_ml_tpu.scoring.score import ScoringModel
+
+    model = ScoringModel.from_lda(
+        corpus.doc_names, result.gamma, corpus.vocab, result.log_beta,
+        1e-4,
+    )
+    prep = build_scoring_prep(
+        features, corpus.doc_names[:-1], corpus.vocab, "flow"
+    )
+    with pytest.raises(ValueError, match="different corpora"):
+        score_flow_csv(features, model, 1.1, prep=prep)
+    bad_src = build_scoring_prep(features, corpus.doc_names, corpus.vocab,
+                                 "flow")
+    bad_src.dsource = "dns"
+    with pytest.raises(ValueError, match="dsource"):
+        score_flow_csv(features, model, 1.1, prep=bad_src)
+
+
+def test_scoring_with_prep_identical_csv(tmp_path):
+    from oni_ml_tpu.scoring.score import ScoringModel, score_flow_csv
+
+    features, corpus, result = _tiny_lda(tmp_path)
+    model = ScoringModel.from_lda(
+        corpus.doc_names, result.gamma, corpus.vocab, result.log_beta,
+        1e-4,
+    )
+    prep = build_scoring_prep(features, corpus.doc_names, corpus.vocab,
+                              "flow")
+    blob_prep, _ = score_flow_csv(features, model, 1.1, prep=prep)
+    blob_inline, _ = score_flow_csv(features, model, 1.1)
+    assert blob_prep == blob_inline
+
+
+# ---------------------------------------------------------------------------
+# Sinks / tasks
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_and_clear_stale(tmp_path):
+    p = str(tmp_path / "art.bin")
+    atomic_write_bytes(p, b"hello")
+    assert open(p, "rb").read() == b"hello"
+    assert not os.path.exists(p + ".tmp")
+    open(p + ".tmp", "wb").write(b"junk")
+    clear_stale(p)
+    assert not os.path.exists(p) and not os.path.exists(p + ".tmp")
+    clear_stale(p)  # idempotent on missing files
+
+
+def test_checkpoint_sinks_report_errors(tmp_path):
+    sinks = CheckpointSinks(workers=2)
+    ok_path = str(tmp_path / "ok.bin")
+    sinks.submit("good", lambda: atomic_write_bytes(ok_path, b"x"),
+                 stage="pre")
+
+    def _bad():
+        raise OSError("disk gone")
+
+    sinks.submit("bad", _bad, stage="lda")
+    rows, errors = sinks.drain()
+    sinks.close()
+    assert rows["good"]["ok"] and rows["good"]["stage"] == "pre"
+    assert not rows["bad"]["ok"] and "disk gone" in rows["bad"]["error"]
+    assert len(errors) == 1 and errors[0][0] == "bad"
+    assert os.path.exists(ok_path)
+
+
+def test_task_result_reraises_and_marks_consumed():
+    t = Task("boom", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(RuntimeError):
+        t.result()
+    assert t.consumed  # drain must not double-report
+    ok = Task("fine", lambda: 42)
+    assert ok.result() == 42
+    assert ok.completion.ok and ok.completion.wall_s >= 0
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: byte-identity, checkpoints off, fail-fast, resume refusal
+# ---------------------------------------------------------------------------
+
+_ARTIFACTS = [
+    "word_counts.dat", "words.dat", "doc.dat", "model.dat",
+    "final.beta", "final.gamma", "final.other", "likelihood.dat",
+    "doc_results.csv", "word_results.csv", "flow_results.csv",
+]
+
+
+def _day_cfg(root, **dp):
+    rng = np.random.default_rng(7)
+    lines = ["dummy,header"]
+    for _ in range(60):
+        lines.append(flow_row(
+            hour=int(rng.integers(0, 24)), minute=int(rng.integers(0, 60)),
+            second=int(rng.integers(0, 60)),
+            sip=f"10.0.0.{rng.integers(1, 9)}",
+            dip=f"172.16.0.{rng.integers(1, 9)}",
+            col10=str(rng.choice([80, 443, 55000, 0])),
+            col11=str(rng.choice([80, 6000, 70000])),
+            ipkt=str(rng.integers(1, 100)),
+            ibyt=str(rng.integers(40, 10000)),
+        ))
+    raw = os.path.join(str(root), "flow.csv")
+    with open(raw, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return PipelineConfig(
+        data_dir=str(root), flow_path=raw,
+        lda=LDAConfig(num_topics=4, em_max_iters=6, batch_size=32,
+                      min_bucket_len=16, seed=3),
+        feedback=FeedbackConfig(dup_factor=5),
+        scoring=ScoringConfig(threshold=1.1),
+        dataplane=DataplaneConfig(**dp),
+    )
+
+
+def _sha(path):
+    return hashlib.sha256(open(path, "rb").read()).hexdigest()
+
+
+def test_dataplane_artifacts_byte_identical_to_serial(tmp_path):
+    """THE acceptance pin: streaming on vs --no-dataplane produce the
+    same bytes for every artifact.  (features.pkl embeds its day-dir
+    path — spill bookkeeping — so it is compared semantically.)"""
+    plane_dir, serial_dir = tmp_path / "plane", tmp_path / "serial"
+    plane_dir.mkdir(), serial_dir.mkdir()
+    m_plane = run_pipeline(_day_cfg(plane_dir), "20160122", "flow")
+    m_serial = run_pipeline(
+        _day_cfg(serial_dir, enabled=False), "20160122", "flow"
+    )
+    p_day = plane_dir / "20160122"
+    s_day = serial_dir / "20160122"
+    for name in _ARTIFACTS:
+        assert _sha(str(p_day / name)) == _sha(str(s_day / name)), name
+    with open(p_day / "features.pkl", "rb") as f:
+        pf = pickle.load(f)
+    with open(s_day / "features.pkl", "rb") as f:
+        sf = pickle.load(f)
+    assert pf.num_events == sf.num_events
+    assert pf.word_counts() == sf.word_counts()
+    # Stage provenance: the streaming run handed everything off in
+    # memory; the serial run round-tripped the file contract.
+    by_stage = {m["stage"]: m for m in m_plane}
+    assert by_stage["score"]["features"] == "handoff"
+    assert by_stage["score"]["model"] == "handoff"
+    assert by_stage["score"]["prep"] == "overlapped"
+    assert by_stage["lda"]["corpus"] == "handoff"
+    assert by_stage["corpus"]["stream"]["chunks"] >= 1
+    s_stage = {m["stage"]: m for m in m_serial}
+    assert s_stage["score"].get("features", "file") == "file"
+    assert "dataplane" not in s_stage
+    # The dataplane record carries every demoted write + overlap task.
+    dp = by_stage["dataplane"]
+    assert set(dp["tasks"]) == {
+        "features_pkl", "word_counts", "corpus_dat", "final_model",
+        "doc_results", "word_results", "results_csv", "wc_stream",
+        "score_prep",
+    }
+    assert all(t["ok"] for t in dp["tasks"].values())
+    assert "pre.wc->corpus" in dp["edges"]
+
+
+def test_no_checkpoints_products_only_and_resume_refused(tmp_path):
+    """--no-checkpoints: only products land (results CSV, metrics.json,
+    journal); a later --stages resume is refused with the artifact name
+    AND the provenance note."""
+    cfg = _day_cfg(tmp_path, checkpoints=False)
+    run_pipeline(cfg, "20160122", "flow")
+    day = tmp_path / "20160122"
+    assert (day / "flow_results.csv").exists()
+    assert (day / "metrics.json").exists()
+    assert (day / "run_journal.jsonl").exists()
+    for name in _ARTIFACTS[:-1]:  # every contract file skipped
+        assert not (day / name).exists(), name
+    # Same scored bytes as a full serial day on the same input.
+    serial_root = tmp_path / "serial"
+    serial_root.mkdir()
+    run_pipeline(_day_cfg(serial_root, enabled=False), "20160122", "flow")
+    assert _sha(str(day / "flow_results.csv")) == _sha(
+        str(serial_root / "20160122" / "flow_results.csv")
+    )
+    # run_start carries checkpoints: false.
+    recs = Journal.replay(str(day / "run_journal.jsonl"))
+    assert recs[0]["kind"] == "run_start"
+    assert recs[0]["checkpoints"] is False
+    # Resume against the file-less day (a normal, checkpoints-on
+    # invocation — the --no-checkpoints provenance comes from the
+    # journal): refused, naming the artifact.
+    resume_cfg = PipelineConfig(
+        data_dir=cfg.data_dir, flow_path=cfg.flow_path,
+        lda=cfg.lda, feedback=cfg.feedback, scoring=cfg.scoring,
+    )
+    with pytest.raises(MissingArtifactError) as ei:
+        run_pipeline(resume_cfg, "20160122", "flow", stages=[Stage.LDA])
+    msg = str(ei.value)
+    assert "model.dat" in msg
+    assert "--no-checkpoints" in msg and "refused" in msg
+
+
+def test_no_checkpoints_validation():
+    cfg = PipelineConfig(dataplane=DataplaneConfig(checkpoints=False,
+                                                   enabled=False))
+    with pytest.raises(ValueError, match="no-dataplane"):
+        run_pipeline(cfg, "20160122", "flow")
+    cfg2 = PipelineConfig(dataplane=DataplaneConfig(checkpoints=False))
+    with pytest.raises(ValueError, match="stages"):
+        run_pipeline(cfg2, "20160122", "flow", stages=[Stage.PRE])
+    with pytest.raises(ValueError, match="online"):
+        run_pipeline(cfg2, "20160122", "flow", online=True)
+
+
+@pytest.mark.parametrize("stage,artifact", [
+    (Stage.CORPUS, "word_counts.dat"),
+    (Stage.LDA, "model.dat"),
+    (Stage.SCORE, "features.pkl"),
+])
+def test_stages_fail_fast_names_missing_artifact(tmp_path, stage,
+                                                 artifact):
+    """A --stages invocation whose upstream checkpoint is missing fails
+    fast with the artifact name and the regenerating flag — not a
+    loader stack trace."""
+    cfg = _day_cfg(tmp_path)
+    with pytest.raises(MissingArtifactError) as ei:
+        run_pipeline(cfg, "20160122", "flow", stages=[stage])
+    msg = str(ei.value)
+    assert artifact in msg
+    assert "--stages" in msg and "--force" in msg
+
+
+def test_stages_resume_over_file_contract_unchanged(tmp_path):
+    """A resumed --stages run falls back to the file contract exactly
+    as before the dataplane: same bytes as the uninterrupted chain."""
+    full_root, staged_root = tmp_path / "full", tmp_path / "staged"
+    full_root.mkdir(), staged_root.mkdir()
+    run_pipeline(_day_cfg(full_root), "20160122", "flow")
+    cfg = _day_cfg(staged_root)
+    run_pipeline(cfg, "20160122", "flow", stages=[Stage.PRE])
+    run_pipeline(cfg, "20160122", "flow", stages=[Stage.CORPUS])
+    m_lda = run_pipeline(cfg, "20160122", "flow", stages=[Stage.LDA])
+    m_score = run_pipeline(cfg, "20160122", "flow", stages=[Stage.SCORE])
+    by = {m["stage"]: m for m in m_lda}
+    assert by["lda"]["corpus"] == "file"
+    by = {m["stage"]: m for m in m_score}
+    assert by["score"]["features"] == "file"
+    assert by["score"]["model"] == "file"
+    assert by["score"]["prep"] == "inline"
+    for name in _ARTIFACTS:
+        assert _sha(str(staged_root / "20160122" / name)) == _sha(
+            str(full_root / "20160122" / name)
+        ), name
+
+
+def test_checkpoint_write_failure_fails_run(tmp_path, monkeypatch):
+    """A failed background checkpoint write fails the RUN (rc path:
+    RuntimeError naming the sink) — a day dir missing its contract
+    files must never report ok."""
+    cfg = _day_cfg(tmp_path)
+    import oni_ml_tpu.runner.ml_ops as ml_ops
+
+    def _boom(path, triples):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ml_ops.formats, "write_word_counts", _boom)
+    # Native containers take the word_counts_emit path; break that too.
+    import oni_ml_tpu.native_emit as native_emit
+
+    monkeypatch.setattr(
+        native_emit, "word_counts_emit",
+        lambda features: (_ for _ in ()).throw(OSError("disk full")),
+    )
+    with pytest.raises(RuntimeError, match="word_counts"):
+        run_pipeline(cfg, "20160122", "flow")
+    # The journal's run_end must agree the run failed.
+    recs = Journal.replay(
+        str(tmp_path / "20160122" / "run_journal.jsonl")
+    )
+    ends = [r for r in recs if r["kind"] == "run_end"]
+    assert len(ends) == 1 and not ends[0]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Journal records + trace_view lanes / stall table
+# ---------------------------------------------------------------------------
+
+
+def test_journal_dataplane_records_and_trace_lanes(tmp_path):
+    """The journal carries the dataplane vocabulary (depth/task/edge
+    events) and trace_view renders queue-depth counter lanes + the
+    per-edge stall table."""
+    cfg = _day_cfg(tmp_path)
+    run_pipeline(cfg, "20160122", "flow")
+    jpath = str(tmp_path / "20160122" / "run_journal.jsonl")
+    records = Journal.replay(jpath)
+    dp = [r for r in records if r.get("kind") == "dataplane"]
+    events = {r["event"] for r in dp}
+    assert events == {"depth", "task", "edge"}
+    tasks = {r["name"] for r in dp if r["event"] == "task"}
+    assert {"wc_stream", "score_prep", "features_pkl",
+            "corpus_dat"} <= tasks
+    edges = [r for r in dp if r["event"] == "edge"]
+    assert len(edges) == 1 and edges[0]["edge"] == "pre.wc->corpus"
+    assert {"capacity", "puts", "gets", "put_stall_s", "get_stall_s",
+            "max_depth"} <= set(edges[0])
+    # The overlap spans the ISSUE names: checkpoint sinks, overlap
+    # tasks, and the score stage's (near-zero) prep join.
+    span_names = {r["name"] for r in records if r.get("kind") == "span"}
+    assert "dataplane.task.score_prep" in span_names
+    assert "dataplane.task.wc_stream" in span_names
+    assert "dataplane.checkpoint.features_pkl" in span_names
+    assert "dataplane.prep_join" in span_names
+
+    sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+    import trace_view
+
+    trace = trace_view.journal_to_trace(records)
+    names = [e["name"] for e in trace["traceEvents"]]
+    # Queue-depth counter lane per edge, next to the stage spans.
+    assert "dataplane pre.wc->corpus depth" in names
+    assert "dataplane.task.score_prep" in names
+    json.dumps(trace)
+    table = trace_view.dataplane_edge_table(records)
+    assert len(table) == 1
+    assert table[0]["edge"] == "pre.wc->corpus"
+    import io
+
+    buf = io.StringIO()
+    trace_view.print_summary(records, 0, out=buf)
+    out = buf.getvalue()
+    assert "dataplane edges" in out
+    assert "pre.wc->corpus" in out
+    assert "background tasks" in out
+
+
+def test_channel_stall_lane_rendered():
+    """A blocked get (starved consumer) renders as a stall counter lane
+    so starvation is visually obvious in the trace."""
+    sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+    import trace_view
+
+    records = [
+        {"kind": "dataplane", "event": "depth", "edge": "e", "side": "get",
+         "depth": 0, "wait_s": 0.25, "mono_ns": 1000},
+        {"kind": "dataplane", "event": "depth", "edge": "e", "side": "put",
+         "depth": 1, "mono_ns": 2000},
+    ]
+    trace = trace_view.journal_to_trace(records)
+    by_name = {}
+    for e in trace["traceEvents"]:
+        by_name.setdefault(e["name"], []).append(e)
+    assert "dataplane e depth" in by_name
+    stall = by_name["dataplane e get_stall_ms"]
+    assert stall[0]["args"]["stall_ms"] == pytest.approx(250.0)

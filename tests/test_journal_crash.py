@@ -200,6 +200,139 @@ def test_sigkill_mid_em_journal_replays_and_resumes(killed_run):
     assert all("journal" in m.get("skipped", "") for m in metrics3
                if m["stage"] != "plans")
 
+    # -- crash/resume byte-identity across the dataplane ---------------
+    # The resumed day's artifacts must equal an UNINTERRUPTED run of the
+    # same config on the same input: the kill landed mid-EM with the
+    # pre/corpus checkpoints already demoted to (completed, atomic)
+    # background writes, and the resumed LDA/score fell back to the
+    # file contract — same corpus, same training, same bytes.
+    ref_dir = tmp_path / "uninterrupted"
+    ref_dir.mkdir()
+    ref_raw = str(ref_dir / "flow.csv")
+    _write_flow_day(ref_raw)
+    ref_cfg = PipelineConfig(
+        data_dir=str(ref_dir), flow_path=ref_raw,
+        lda=LDAConfig(num_topics=4, em_max_iters=6, batch_size=32,
+                      min_bucket_len=16, seed=3),
+        feedback=FeedbackConfig(dup_factor=5),
+        scoring=ScoringConfig(threshold=1.1),
+    )
+    run_pipeline(ref_cfg, "20160122", "flow")
+    for name in ("word_counts.dat", "words.dat", "doc.dat", "model.dat",
+                 "final.beta", "final.gamma", "final.other",
+                 "likelihood.dat", "doc_results.csv", "word_results.csv",
+                 "flow_results.csv"):
+        killed = (day / name).read_bytes()
+        ref = (ref_dir / "20160122" / name).read_bytes()
+        assert killed == ref, f"{name} diverged after crash/resume"
+    # No half-written temporaries survived the kill: every demoted
+    # write publishes via tmp+rename.
+    leftovers = [p for p in os.listdir(day) if p.endswith(".tmp")]
+    assert not leftovers, leftovers
+
+
+_NOCKPT_CHILD_SCRIPT = _CHILD_SCRIPT.replace(
+    "from oni_ml_tpu.config import (FeedbackConfig, LDAConfig, "
+    "PipelineConfig,\n                               ScoringConfig)",
+    "from oni_ml_tpu.config import (DataplaneConfig, FeedbackConfig, "
+    "LDAConfig,\n                               PipelineConfig, "
+    "ScoringConfig)",
+).replace(
+    "    scoring=ScoringConfig(threshold=1.1),\n)",
+    "    scoring=ScoringConfig(threshold=1.1),\n"
+    "    dataplane=DataplaneConfig(checkpoints=False),\n)",
+)
+
+
+def test_sigkill_no_checkpoints_resume_refused(tmp_path):
+    """Kill a --no-checkpoints (pure streaming) run mid-EM: the day dir
+    holds no file contract, so a --stages resume is REFUSED with the
+    missing artifact named and the --no-checkpoints provenance — and a
+    full re-run recomputes the day from scratch."""
+    assert "DataplaneConfig" in _NOCKPT_CHILD_SCRIPT
+    assert "checkpoints=False" in _NOCKPT_CHILD_SCRIPT
+    raw = str(tmp_path / "flow.csv")
+    _write_flow_day(raw)
+    jpath = str(tmp_path / "20160122" / "run_journal.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("ONI_ML_TPU_TESTS_ON_TPU", None)
+    log = open(str(tmp_path / "child.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _NOCKPT_CHILD_SCRIPT, str(tmp_path), raw],
+        stdout=log, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(HERE), env=env,
+    )
+    try:
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                log.close()
+                pytest.fail(
+                    "child exited before the kill (rc="
+                    f"{proc.returncode}):\n"
+                    + open(str(tmp_path / "child.log")).read()[-2000:]
+                )
+            if os.path.exists(jpath) and sum(
+                1 for r in Journal.replay(jpath)
+                if r.get("kind") == "em_ll"
+            ) >= 2:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("journal never showed EM in flight")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30.0)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30.0)
+        log.close()
+
+    day = tmp_path / "20160122"
+    # Pure streaming: the kill left no contract files (and no temps).
+    for name in ("features.pkl", "word_counts.dat", "words.dat",
+                 "doc.dat", "model.dat", "final.beta",
+                 "doc_results.csv", "word_results.csv"):
+        assert not (day / name).exists(), name
+    records = Journal.replay(jpath)
+    assert records[0]["kind"] == "run_start"
+    assert records[0]["checkpoints"] is False
+
+    from oni_ml_tpu.config import (
+        FeedbackConfig,
+        LDAConfig,
+        PipelineConfig,
+        ScoringConfig,
+    )
+    from oni_ml_tpu.runner import (
+        MissingArtifactError,
+        Stage,
+        run_pipeline,
+    )
+
+    cfg = PipelineConfig(
+        data_dir=str(tmp_path), flow_path=raw,
+        lda=LDAConfig(num_topics=4, em_max_iters=6, batch_size=32,
+                      min_bucket_len=16, seed=3),
+        feedback=FeedbackConfig(dup_factor=5),
+        scoring=ScoringConfig(threshold=1.1),
+    )
+    with pytest.raises(MissingArtifactError) as ei:
+        run_pipeline(cfg, "20160122", "flow", stages=[Stage.LDA])
+    msg = str(ei.value)
+    assert "model.dat" in msg
+    assert "--no-checkpoints" in msg and "refused" in msg
+
+    # A full (checkpoints-on) re-run recomputes everything: no stage
+    # can skip against the missing contract, whatever the journal says.
+    metrics = run_pipeline(cfg, "20160122", "flow")
+    by_stage = {m["stage"]: m for m in metrics}
+    for stage in ("pre", "corpus", "lda", "score"):
+        assert "skipped" not in by_stage[stage], stage
+    assert (day / "flow_results.csv").exists()
+    assert (day / "model.dat").exists()
+
 
 def test_journal_written_by_normal_run_and_traceable(tmp_path):
     """A healthy run's journal: stage spans for every stage, em_ll

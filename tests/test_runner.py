@@ -22,10 +22,10 @@ from test_features import dns_row, flow_row
 
 def _stages(metrics):
     """Pipeline-stage names in order, without the run-level `plans` /
-    `roofline` accounting records run_pipeline appends after the
-    stages."""
+    `roofline` / `dataplane` accounting records run_pipeline appends
+    after the stages."""
     return [m["stage"] for m in metrics
-            if m["stage"] not in ("plans", "roofline")]
+            if m["stage"] not in ("plans", "roofline", "dataplane")]
 
 
 def test_dns_parquet_source(tmp_path):

@@ -1,0 +1,188 @@
+"""Compare two bench payloads and fail loudly on regression.
+
+The post-bench CI step (docs/performance.md "Catching regressions"):
+feed it the previous round's captured payload (BENCH_rNN.json — the
+driver wrapper with a "parsed" object — or a raw `python bench.py`
+headline line) and the fresh one, and it diffs every comparable number:
+
+  * the headline metric (direction inferred from the unit: rates are
+    higher-better, seconds lower-better),
+  * every secondary phase's `value` present in BOTH payloads,
+  * roofline utilization (headline `utilization.mxu_pct` / `hbm_pct`,
+    absolute percentage points),
+  * the streaming dataplane's `overlap_efficiency` from the
+    pipeline_e2e phases (absolute drop — the number is a fraction of
+    hidden work, so relative deltas near 0 are noise).
+
+Exit codes: 0 = no regression beyond thresholds, 1 = regression
+(printed per row), 2 = unusable input.  `--json` emits the full row
+set for dashboards.
+
+Usage:
+
+    python tools/bench_diff.py BENCH_r05.json BENCH_r06.json \
+        [--threshold-pct 10] [--efficiency-drop 0.05] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Phases whose payloads carry an overlap_efficiency headline.
+_OVERLAP_PHASES = ("pipeline_e2e", "pipeline_e2e_dns")
+
+
+def load_payload(path: str) -> dict:
+    """A bench payload from either container: the driver's capture
+    wrapper ({"parsed": {...}}), a raw headline object, or a
+    failure payload (whose comparable numbers live in "last_good")."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    if data.get("value") is None and isinstance(
+        data.get("last_good"), dict
+    ):
+        data = data["last_good"]
+    return data
+
+
+def _higher_is_better(unit: str) -> bool:
+    u = (unit or "").lower()
+    if "/" in u:          # docs/sec, events/sec, ...
+        return True
+    return u not in ("seconds", "second", "s", "ms", "milliseconds")
+
+
+def _rel_row(name: str, old, new, unit: str, threshold_pct: float):
+    """One relative-delta comparison row; regression when the metric
+    moved the WRONG direction by more than threshold_pct."""
+    if not isinstance(old, (int, float)) or not isinstance(
+        new, (int, float)
+    ) or old == 0:
+        return None
+    delta_pct = 100.0 * (new - old) / abs(old)
+    worse = -delta_pct if _higher_is_better(unit) else delta_pct
+    return {
+        "name": name, "old": old, "new": new, "unit": unit,
+        "delta_pct": round(delta_pct, 2),
+        "regression": worse > threshold_pct,
+    }
+
+
+def _abs_row(name: str, old, new, unit: str, max_drop: float):
+    """Absolute-drop comparison (utilization points, overlap
+    efficiency): regression when new < old - max_drop."""
+    if not isinstance(old, (int, float)) or not isinstance(
+        new, (int, float)
+    ):
+        return None
+    return {
+        "name": name, "old": old, "new": new, "unit": unit,
+        "delta_abs": round(new - old, 4),
+        "regression": (old - new) > max_drop,
+    }
+
+
+def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
+                  efficiency_drop: float = 0.05,
+                  util_drop_pct: float = 2.0) -> "list[dict]":
+    rows = []
+    # Headline.
+    r = _rel_row(
+        f"headline:{new.get('metric', old.get('metric', '?'))}",
+        old.get("value"), new.get("value"), new.get("unit", ""),
+        threshold_pct,
+    )
+    if r:
+        rows.append(r)
+    # Secondary phases present in both.
+    old_sec = old.get("secondary") or {}
+    new_sec = new.get("secondary") or {}
+    for name in sorted(set(old_sec) & set(new_sec)):
+        o, n = old_sec[name], new_sec[name]
+        if not isinstance(o, dict) or not isinstance(n, dict):
+            continue
+        r = _rel_row(f"phase:{name}", o.get("value"), n.get("value"),
+                     n.get("unit", o.get("unit", "")), threshold_pct)
+        if r:
+            rows.append(r)
+    # Roofline utilization on the headline (absolute points — 10.5% MXU
+    # dropping to 8% is a real kernel regression even though the
+    # relative delta reads -24%).
+    old_util = old.get("utilization") or {}
+    new_util = new.get("utilization") or {}
+    for key in ("mxu_pct", "hbm_pct"):
+        r = _abs_row(f"utilization:{key}", old_util.get(key),
+                     new_util.get(key), "pct", util_drop_pct)
+        if r:
+            rows.append(r)
+    # Streaming-dataplane overlap efficiency (absolute fraction).
+    for name in _OVERLAP_PHASES:
+        o, n = old_sec.get(name), new_sec.get(name)
+        if not isinstance(o, dict) or not isinstance(n, dict):
+            continue
+        r = _abs_row(f"overlap_efficiency:{name}",
+                     o.get("overlap_efficiency"),
+                     n.get("overlap_efficiency"), "fraction",
+                     efficiency_drop)
+        if r:
+            rows.append(r)
+    return rows
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two bench payloads; exit 1 on regression "
+        "beyond thresholds."
+    )
+    ap.add_argument("old", help="baseline payload (BENCH_rNN.json or "
+                    "raw bench.py output)")
+    ap.add_argument("new", help="candidate payload")
+    ap.add_argument("--threshold-pct", type=float, default=10.0,
+                    help="relative regression tolerance for headline / "
+                    "phase values (default 10%%)")
+    ap.add_argument("--efficiency-drop", type=float, default=0.05,
+                    help="max tolerated absolute drop in "
+                    "overlap_efficiency (default 0.05)")
+    ap.add_argument("--util-drop-pct", type=float, default=2.0,
+                    help="max tolerated absolute drop in utilization "
+                    "percentage points (default 2.0)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the comparison rows as JSON")
+    args = ap.parse_args(argv)
+    try:
+        old = load_payload(args.old)
+        new = load_payload(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    rows = diff_payloads(old, new, args.threshold_pct,
+                         args.efficiency_drop, args.util_drop_pct)
+    if not rows:
+        print("bench_diff: no comparable metrics between the two "
+              "payloads", file=sys.stderr)
+        return 2
+    regressions = [r for r in rows if r["regression"]]
+    if args.as_json:
+        print(json.dumps({"rows": rows,
+                          "regressions": len(regressions)}, indent=2))
+    else:
+        for r in rows:
+            delta = (f"{r['delta_pct']:+.2f}%" if "delta_pct" in r
+                     else f"{r['delta_abs']:+.4f}")
+            flag = "  REGRESSION" if r["regression"] else ""
+            print(f"{r['name']:<44} {r['old']:>14} -> {r['new']:>14} "
+                  f"({delta}){flag}")
+        verdict = (f"{len(regressions)} regression(s)" if regressions
+                   else "no regressions")
+        print(f"bench_diff: {len(rows)} metrics compared, {verdict}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -145,6 +145,38 @@ def journal_to_trace(records: "list[dict]") -> dict:
                     "name": f"roofline {phase}", "ph": "C",
                     "ts": us(ns), "pid": pid, "tid": 0, "args": args,
                 })
+        elif kind == "dataplane":
+            event = rec.get("event")
+            edge = rec.get("edge", "?")
+            if event == "depth":
+                # Queue-depth counter lane per inter-stage edge, next to
+                # the stage spans: a consumer pinned at depth 0 while
+                # its producing stage runs is starved; a producer pinned
+                # at capacity is backpressured.
+                events.append({
+                    "name": f"dataplane {edge} depth", "ph": "C",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"depth": rec.get("depth", 0)},
+                })
+                wait = rec.get("wait_s")
+                if isinstance(wait, (int, float)) and wait > 0:
+                    # Stall lane per side: the priced blocking waits
+                    # (dataplane.stall spans carry the same windows as
+                    # slices; the counter makes the magnitude plottable).
+                    side = rec.get("side", "?")
+                    events.append({
+                        "name": f"dataplane {edge} {side}_stall_ms",
+                        "ph": "C", "ts": us(ns), "pid": pid, "tid": 0,
+                        "args": {"stall_ms": wait * 1e3},
+                    })
+            # "task" completions are NOT re-rendered here: every sink /
+            # overlap task also records a dataplane.checkpoint.<name> or
+            # dataplane.task.<name> span (same window, real start), and
+            # the span branch above already draws it — a second slice
+            # from the completion record would render every background
+            # write twice.  Task records feed the terminal summary's
+            # background-task table instead; "edge" drain rollups feed
+            # the per-edge stall table.
         elif kind == "backend_lost":
             events.append({
                 "name": "BACKEND LOST", "ph": "i", "s": "g",
@@ -191,6 +223,44 @@ def stage_summary(records: "list[dict]") -> "list[dict]":
     return out
 
 
+def dataplane_edge_table(records: "list[dict]") -> "list[dict]":
+    """Per-edge stall rollup from the dataplane's drain-time "edge"
+    records: one row per channel with its traffic and both sides'
+    accumulated stall — a starved consumer (get_stall) or a
+    backpressured producer (put_stall) is a number here, not just a
+    shape in the trace."""
+    rows = []
+    for rec in records:
+        if rec.get("kind") != "dataplane" or rec.get("event") != "edge":
+            continue
+        rows.append({
+            "edge": rec.get("edge", "?"),
+            "capacity": rec.get("capacity"),
+            "puts": rec.get("puts", 0),
+            "gets": rec.get("gets", 0),
+            "put_stall_s": float(rec.get("put_stall_s") or 0.0),
+            "get_stall_s": float(rec.get("get_stall_s") or 0.0),
+            "max_depth": rec.get("max_depth", 0),
+        })
+    return rows
+
+
+def dataplane_task_table(records: "list[dict]") -> "list[dict]":
+    """Background sink / overlap-task completions (the work the stage
+    overlap hid from the critical path), per task, stage-attributed."""
+    rows = []
+    for rec in records:
+        if rec.get("kind") != "dataplane" or rec.get("event") != "task":
+            continue
+        rows.append({
+            "name": rec.get("name", "?"),
+            "stage": rec.get("stage"),
+            "wall_s": float(rec.get("wall_s") or 0.0),
+            "ok": rec.get("ok"),
+        })
+    return rows
+
+
 def print_summary(records: "list[dict]", dropped: int,
                   out=sys.stdout) -> None:
     rows = stage_summary(records)
@@ -226,6 +296,26 @@ def print_summary(records: "list[dict]", dropped: int,
                 detail = "wall-time only (no cost analysis)"
             print(f"  {phase:<28} wall {r.get('wall_s', 0):>8.3f}s  "
                   f"x{r.get('dispatches', 1):<5} {detail}", file=out)
+    edges = dataplane_edge_table(records)
+    if edges:
+        print("dataplane edges (queue traffic + stalls):", file=out)
+        print(f"  {'edge':<24} {'cap':>4} {'puts':>7} {'gets':>7} "
+              f"{'put_stall_s':>12} {'get_stall_s':>12} {'max_depth':>9}",
+              file=out)
+        for e in edges:
+            print(f"  {e['edge']:<24} {e['capacity']:>4} {e['puts']:>7} "
+                  f"{e['gets']:>7} {e['put_stall_s']:>12.3f} "
+                  f"{e['get_stall_s']:>12.3f} {e['max_depth']:>9}",
+                  file=out)
+    tasks = dataplane_task_table(records)
+    if tasks:
+        hidden = sum(t["wall_s"] for t in tasks if t["ok"])
+        print(f"dataplane background tasks ({hidden:.3f}s overlapped):",
+              file=out)
+        for t in sorted(tasks, key=lambda t: -t["wall_s"]):
+            flag = "" if t["ok"] else "  FAILED"
+            print(f"  {t['name']:<24} stage={str(t['stage']):<8} "
+                  f"{t['wall_s']:>8.3f}s{flag}", file=out)
     if not rows:
         print("no stage records", file=out)
         return
