@@ -101,10 +101,16 @@ ALPHA_MAX_ITERS = 8
 def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
               force_sparse=False, wmajor=True, warm_start=False,
               precision="bf16", compact=False, word_law="uniform",
-              n_batches=1):
+              n_batches=1, engine=None):
     """Shared corpus/dense-path/runner setup for the EM benches:
     returns (log_beta, groups, run_chunk, use_dense, used_wmajor,
     corpus_itemsize, gammas0, info).
+
+    `engine` pins the E-step engine for A/B measurement: "dense"
+    forces the dense-corpus kernel even off-TPU (interpret mode — the
+    CPU crossover baseline), "sparse" forces the fused sparse bucketed
+    kernel (ops/sparse_estep.py), None keeps the production auto
+    resolution.  info["estep_engine"] names what actually ran.
 
     word_law="loguniform" draws token ids log-uniformly over [1, V]
     (zipf s≈1) — the realistic frequency law for config-4's
@@ -147,14 +153,46 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
     )
     doc_mask = jnp.ones((nb, b), jnp.float32)
 
+    if engine not in (None, "dense", "sparse"):
+        raise ValueError(f"unknown bench EM engine {engine!r}")
+    if engine == "sparse":
+        force_sparse = True       # the dense family stands down
     use_dense, use_wmajor, compiler_options = dense_estep.plan(
         b, v, k, precision, wmajor=wmajor
     )
     want_wmajor = wmajor  # caller's layout preference, pre-feasibility
     use_dense = use_dense and not force_sparse
+    if engine == "dense" and not use_dense:
+        # Forced dense off-TPU: the interpret-mode baseline the
+        # dense-vs-sparse crossover compares against.  Feasibility
+        # still gates (an infeasible shape has no dense baseline).
+        if dense_estep.pick_block(b, v, k, precision) is None:
+            raise ValueError(
+                f"dense engine forced but B={b}, V={v}, K={k} has no "
+                "VMEM-feasible doc block"
+            )
+        use_dense = True
+        use_wmajor = (
+            wmajor
+            and dense_estep.pick_block_w(b, v, k, precision) is not None
+        )
     wmajor = use_dense and use_wmajor
     corpus_itemsize = 4
     info = {}
+    e_step_fn = None
+    if engine == "sparse":
+        from oni_ml_tpu.ops import sparse_estep
+
+        if sparse_estep.pick_block(b, l, k, precision) is None:
+            raise ValueError(
+                f"sparse engine forced but B={b}, L={l}, K={k} has no "
+                "VMEM-feasible doc block"
+            )
+        e_step_fn = sparse_estep.make_e_step_fn(precision=precision)
+        info["estep_engine"] = "sparse"
+        kib = sparse_estep.scoped_vmem_kib(b, l, k, precision)
+        if kib and jax.default_backend() == "tpu":
+            compiler_options = {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
     # Gate bf16 storage on the DENSIFIED cells (duplicate words in a
     # doc sum), exactly like the trainer.
     store = dense_estep.corpus_dtype(
@@ -199,18 +237,27 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
             {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
             if kib and jax.default_backend() == "tpu" else None
         )
-        info = {"compact_width": wc,
-                "unique_words": int(len(plan.uniques[0][0])),
-                "engine_variant": "compact"}
+        info.update({"compact_width": wc,
+                     "unique_words": int(len(plan.uniques[0][0])),
+                     "engine_variant": "compact"})
     else:
-        compiler_options = None
+        if engine != "sparse":     # the sparse engine set its own kib
+            compiler_options = None
         groups = ((word_idx, counts, doc_mask),)
+    if "estep_engine" not in info:
+        # "sparse_auto": sparse stacked groups through estep.e_step's
+        # auto dispatch (fused sparse kernel on TPU, XLA on CPU).
+        info["estep_engine"] = (
+            "compact" if info.get("engine_variant") == "compact"
+            else "dense" if use_dense else "sparse_auto"
+        )
 
     run_chunk = fused.make_chunk_runner(
         num_docs=nb * b, num_topics=k, num_terms=v, chunk=chunk,
         var_max_iters=var_max_iters, var_tol=1e-6, em_tol=em_tol,
         estimate_alpha=True, compiler_options=compiler_options,
         dense_wmajor=wmajor, warm_start=warm_start,
+        e_step_fn=e_step_fn,
         dense_precision=precision if use_dense else "f32",
         # cap ALPHA_MAX_ITERS takes update_alpha's unrolled lowering
         # (one fused scalar chain instead of a dynamic-trip while_loop
@@ -236,12 +283,14 @@ def _setup_em(k, v, b, l, *, chunk, var_max_iters, em_tol,
 def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
              force_sparse=False, wmajor=True, warm_start=False,
              precision="bf16", compact=False, word_law="uniform",
-             n_batches=1):
+             n_batches=1, engine=None):
     """Production fused-EM throughput at (K, V, B, L); returns a dict:
     docs_per_sec, t_iter (seconds per EM iteration), use_dense, wmajor,
-    corpus_itemsize, and mean_vi (mean inner fixed-point iterations per
-    EM step in the timed rounds — shows the var_tol early exit and warm
-    start collapsing the inner loop as beta stabilizes).
+    corpus_itemsize, estep_engine (what actually ran — `engine` pins
+    "dense"/"sparse" for A/B crossover measurement), and mean_vi (mean
+    inner fixed-point iterations per EM step in the timed rounds —
+    shows the var_tol early exit and warm start collapsing the inner
+    loop as beta stabilizes).
 
     chunk EM iterations run device-resident per host call; the default
     amortizes the host<->device round-trip, which DOMINATES under the
@@ -264,7 +313,7 @@ def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
         k, v, b, l, chunk=chunk, var_max_iters=var_max_iters,
         em_tol=0.0, force_sparse=force_sparse, wmajor=wmajor,
         warm_start=warm_start, precision=precision, compact=compact,
-        word_law=word_law, n_batches=n_batches,
+        word_law=word_law, n_batches=n_batches, engine=engine,
     )
     alpha = jnp.float32(2.5)
     have = jnp.asarray(False)
@@ -304,17 +353,31 @@ def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
             groups, chunk, res.gammas, res.steps_done > 0,
             shape=f"k{k}.v{v}.b{b}.l{l}.c{chunk}",
         )
+    # Effective vs dense-equivalent FLOP accounting
+    # (ops/sparse_estep.py): `effective` is the live-token work the
+    # math needs, `dense_equiv` what the full-V dense engine executes
+    # for the same batch — their ratio is the density waste factor, and
+    # the roofline's useful_mxu_pct is effective over peak ("useful
+    # fraction of peak" next to mxu_pct's "fraction of peak").
+    from oni_ml_tpu.ops import sparse_estep as _sp
+
+    mean_vi = float(np.mean(vi))
+    eff_iter = _sp.effective_flops(n_batches * b, l, k, mean_vi)
+    dense_eq_iter = _sp.dense_equiv_flops(n_batches * b, v, k, mean_vi)
     rl_rec = _rl.roofline_record("em.run_chunk", wall_s=best * chunk,
-                                 dispatches=1)
+                                 dispatches=1,
+                                 effective_flops=eff_iter * chunk)
     rl_rec.pop("kind", None)   # payload section, not a journal line
     return {
         "roofline": rl_rec,
+        "flops_effective_per_iter": eff_iter,
+        "flops_dense_equiv_per_iter": dense_eq_iter,
         "docs_per_sec": n_batches * b / best,
         "t_iter": best,
         "use_dense": use_dense,
         "wmajor": wmajor,
         "corpus_itemsize": corpus_itemsize,
-        "mean_vi": float(np.mean(vi)),
+        "mean_vi": mean_vi,
         # Dispatch settings ride along so phase records stay
         # self-describing across rounds (r03's 1.31M was chunk=32 +
         # while-loop alpha; r05 runs chunk=128 + unrolled cap-8).
@@ -324,6 +387,75 @@ def bench_em(k, v, b, l, chunk=128, rounds=5, var_max_iters=20,
         "chunk": chunk,
         **info,
     }
+
+
+def bench_dense_vs_sparse(k, v, b, l, chunk=32, rounds=2,
+                          precision="bf16"):
+    """Measured dense-vs-sparse E-step engine comparison at one shape —
+    the bench-side twin of the trainer's inline crossover sweep
+    (sparse_estep.engine_crossover), run through the REAL fused chunk
+    driver with each engine pinned.
+
+    Returns {"dense": {...}, "sparse": {...}, "winner",
+    "resolved_engine", "resolved_source"}: per-engine docs/s, t_iter,
+    and roofline (effective vs dense-equivalent FLOPs), the measured
+    winner — persisted to the plan cache under the exact-shape AND
+    density-band keys, so the engine choice survives process death and
+    run 2 resolves it with source "plan" — and what the crossover now
+    RESOLVES to (the number the acceptance gate checks: the resolved
+    engine is never slower than the dense baseline, because it is the
+    measured winner)."""
+    from oni_ml_tpu import plans
+    from oni_ml_tpu.ops import dense_estep, sparse_estep
+
+    out = {"shape": f"k{k}.v{v}.b{b}.l{l}.{precision}"}
+    timed = {}
+    for engine in ("dense", "sparse"):
+        feasible = (
+            dense_estep.pick_block(b, v, k, precision)
+            if engine == "dense"
+            else sparse_estep.pick_block(b, l, k, precision)
+        )
+        if feasible is None:
+            out[engine] = {"skipped": "no VMEM-feasible doc block"}
+            continue
+        em = bench_em(k, v, b, l, chunk=chunk, rounds=rounds,
+                      warm_start=True, precision=precision, engine=engine)
+        timed[engine] = em
+        out[engine] = {
+            "docs_per_sec": round(em["docs_per_sec"], 1),
+            "t_iter": em["t_iter"],
+            "mean_vi": round(em["mean_vi"], 2),
+            "roofline": em.get("roofline"),
+        }
+    if not timed:
+        out["winner"] = None
+        return out
+    winner = max(timed, key=lambda e: timed[e]["docs_per_sec"])
+    out["winner"] = winner
+    # Persist the measured crossover exactly like the trainer's inline
+    # sweep (dispatch_calibration pattern): exact shape + density band.
+    exact, band = sparse_estep.crossover_shapes(k, v, b, l, precision)
+    value = {
+        "engine": winner,
+        "dense_s": timed.get("dense", {}).get("t_iter"),
+        "sparse_s": timed.get("sparse", {}).get("t_iter"),
+    }
+    measurements = {
+        e: round(timed[e]["docs_per_sec"], 1) for e in timed
+    }
+    plans.note_sweep("estep_engine")
+    for shape in (exact, band):
+        plans.record_value("estep_engine", value, shape=shape,
+                           source="autotune", measurements=measurements,
+                           unit="docs/sec")
+    # What a fresh auto run now resolves to: the plan entry just
+    # recorded (source "plan" proves the persistence round-trip).
+    sparse_estep._CROSSOVER_CACHE.pop(exact, None)
+    cross = sparse_estep.engine_crossover(k, v, b, l, precision=precision)
+    out["resolved_engine"] = cross["engine"]
+    out["resolved_source"] = cross["source"]
+    return out
 
 
 def bench_convergence(k=20, v=8192, b=4096, l=128, em_tol=1e-4,
@@ -1437,12 +1569,24 @@ def phase_headline():
         else {}
     )
     engine = _engine_label(em["use_dense"], warm=True)
+    # Measured dense-vs-sparse crossover at the headline shape: both
+    # engines through the real chunk driver, winner persisted to the
+    # plan cache (run 2 resolves it with source "plan"), per-engine
+    # roofline carrying effective vs dense-equivalent FLOPs.  Short
+    # chunk/rounds: this is an attribution section, not the headline.
+    dvs = bench_dense_vs_sparse(k1, v1, b1, l1,
+                                chunk=min(chunk, 32), rounds=2)
     return {"value": round(em["docs_per_sec"], 1), "unit": "docs/sec",
             "engine": engine, "utilization": util,
+            "estep_engine": em.get("estep_engine"),
+            "dense_vs_sparse": dvs,
             # The measured (cost-analysis) twin of the analytic
             # `utilization` model above — tracked side by side so drift
             # between the two is itself a finding.
             "roofline": em.get("roofline"),
+            "flops_effective_per_iter": em.get("flops_effective_per_iter"),
+            "flops_dense_equiv_per_iter": em.get(
+                "flops_dense_equiv_per_iter"),
             "mean_vi_iters": round(em["mean_vi"], 2),
             "chunk": em["chunk"],
             "chunk_source": chunk_src,
@@ -1757,6 +1901,47 @@ def run_phase(name: str) -> int:
     return 2
 
 
+def _bench_diff_gate(record: "_Record", base_path: str) -> int:
+    """Opt-in post-run regression gate (BENCH_DIFF_AGAINST=payload.json,
+    docs/performance.md "Catching regressions"): diff this run's grown
+    record against a prior captured payload via tools/bench_diff,
+    annotate the record with the row set (so the verdict travels IN the
+    payload the driver parses), and return bench_diff's exit semantics
+    — 0 clean, 1 regression(s), 2 unusable baseline — for CI use."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    ))
+    import bench_diff
+
+    try:
+        old = bench_diff.load_payload(base_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        record.annotate("bench_diff",
+                        {"against": base_path, "error": str(e)})
+        print(f"bench: bench_diff: unusable baseline {base_path}: {e}",
+              file=sys.stderr)
+        return 2
+    with record.lock:
+        new = dict(record.data or {})
+    rows = bench_diff.diff_payloads(old, new)
+    regressions = [r for r in rows if r["regression"]]
+    # annotate() re-emits, so the LAST payload line carries the verdict.
+    record.annotate("bench_diff", {
+        "against": base_path,
+        "compared": len(rows),
+        "regressions": len(regressions),
+        "rows": rows,
+    })
+    for r in regressions:
+        print(f"bench: bench_diff REGRESSION {r['name']}: "
+              f"{r['old']} -> {r['new']}", file=sys.stderr)
+    if not rows:
+        print("bench: bench_diff: no comparable metrics vs "
+              f"{base_path}", file=sys.stderr)
+        return 2
+    return 1 if regressions else 0
+
+
 def main() -> int:
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         return run_phase(sys.argv[2])
@@ -1892,6 +2077,8 @@ def main() -> int:
         unit=payload["unit"],
         vs_baseline=round(payload["value"] / HISTORY_DOCS_PER_SEC, 2),
         engine=payload.get("engine"),
+        estep_engine=payload.get("estep_engine"),
+        dense_vs_sparse=payload.get("dense_vs_sparse"),
         utilization=payload.get("utilization", {}),
         roofline=payload.get("roofline"),
         mean_vi_iters=payload.get("mean_vi_iters"),
@@ -1964,10 +2151,20 @@ def main() -> int:
 
         shutil.rmtree(_RUN_E2E_DIR, ignore_errors=True)
     record.emit()
+    rc = 0
+    diff_base = os.environ.get("BENCH_DIFF_AGAINST")
+    if diff_base:
+        # Opt-in post-run regression gate: compare against the named
+        # prior payload, annotate the record, and let the nonzero exit
+        # carry into CI (a healthy measured round on a regressed tree
+        # must not exit 0 when the operator asked for the gate).
+        rc = _bench_diff_gate(record, diff_base)
     if _BENCH_JOURNAL is not None:
+        # The measurement run itself completed; a bench_diff regression
+        # travels in the record + exit code, not as a journal failure.
         _BENCH_JOURNAL.run_end(ok=True)
         _BENCH_JOURNAL.close()
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

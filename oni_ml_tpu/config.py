@@ -161,6 +161,26 @@ class LDAConfig:
     # instead of the 128-lane tile (measured ~1.2x on the EM iteration;
     # ops/dense_estep._dense_kernel_w).  False = row-major [B, W].
     dense_wmajor: bool = True
+    # EM E-step engine family (single-process batch training):
+    # "dense" = today's dense-corpus family (full-V dense, compact-vocab
+    # fallback, XLA/Pallas sparse groups — everything gated by dense_em
+    # above); "sparse" = the fused sparse bucketed Pallas engine
+    # (ops/sparse_estep.py: corpus packed by Corpus.bucketed_layout,
+    # K×L work per doc instead of K×V); "auto" consults the MEASURED
+    # dense-vs-sparse crossover persisted in the plan cache
+    # (sparse_estep.engine_crossover — the dispatch_calibration pattern:
+    # measured once per backend+shape, source "plan" on run 2) on TPU
+    # and stays with the dense family elsewhere.  The sparse engine is
+    # single-process only; meshes keep the sharded dense/sparse plans.
+    # ONI_ML_TPU_ESTEP=sparse forces it; ONI_ML_TPU_ESTEP_ENGINE pins
+    # the crossover's answer without forcing infeasible shapes.
+    estep_engine: str = "auto"
+    # Minimum packed tile length for the sparse engine's bucketed
+    # layout (Corpus.bucketed_layout min_len): buckets pad up to
+    # power-of-two lengths floored here.  128 = the Pallas lane tile,
+    # so [K, BB, L] slab blocks never pad lanes; resolves through the
+    # plan cache (knob "sparse_estep_l") when left at the default.
+    sparse_min_bucket_len: int = 128
 
     @property
     def k(self) -> int:

@@ -1,4 +1,4 @@
 from . import formats
-from .corpus import Batch, Corpus, make_batches
+from .corpus import Batch, BucketedLayout, Corpus, make_batches
 
-__all__ = ["formats", "Corpus", "Batch", "make_batches"]
+__all__ = ["formats", "Corpus", "Batch", "BucketedLayout", "make_batches"]

@@ -191,6 +191,122 @@ class Corpus:
             self.vocab, ptr, widx, cnts,
         )
 
+    def bucket_shapes(
+        self,
+        min_len: int = 128,
+        batch_cap: int = 4096,
+        pad_multiple: int = 8,
+    ) -> "list[tuple[int, int, int]]":
+        """The padded (B, L, real_docs) batch shapes `bucketed_layout`
+        with the same parameters would produce — derived from doc
+        lengths alone, no packing, so engine feasibility gates can
+        check EVERY shape (the VMEM-worst bucket is often a small-B,
+        huge-L one, not the largest batch) without paying the
+        O(tokens) layout pass.  Pinned equal to the real layout's
+        shapes by tests/test_sparse_estep.py."""
+        if min_len < 1:
+            raise ValueError(f"min_len must be >= 1, got {min_len}")
+        lengths = np.maximum(self.doc_lengths(), 1)
+        buck = np.maximum(
+            min_len, 2 ** np.ceil(np.log2(lengths)).astype(np.int64)
+        )
+        shapes: list[tuple[int, int, int]] = []
+        for L in np.unique(buck):
+            n = int((buck == L).sum())
+            for start in range(0, n, batch_cap):
+                c = min(batch_cap, n - start)
+                shapes.append(
+                    (-(-c // pad_multiple) * pad_multiple, int(L), c)
+                )
+        return shapes
+
+    def bucketed_layout(
+        self,
+        min_len: int = 128,
+        batch_cap: int = 4096,
+        pad_multiple: int = 8,
+    ) -> "BucketedLayout":
+        """Pack the corpus into length-sorted power-of-two buckets of
+        padded [B, L] word-id/count tiles — the sparse Pallas E-step's
+        corpus layout (ops/sparse_estep.py).
+
+        Documents are stable-sorted by token count and binned into
+        power-of-two length buckets floored at `min_len` (the 128-lane
+        tile by default, so the kernel's [K, BB, L] slab blocks pad no
+        lanes); each bucket splits into batches of at most `batch_cap`
+        docs, the batch axis padded to a multiple of `pad_multiple`
+        (the sublane granularity).  The whole pass is vectorized CSR
+        gathers — no per-doc Python loop — and the result is cached on
+        this Corpus, keyed by the three parameters.  The returned
+        layout's perm/inv_perm restore document order bit-exactly.
+        """
+        key = (min_len, batch_cap, pad_multiple)
+        cache = getattr(self, "_layout_cache", None)
+        if cache is None:
+            cache = {}
+            # Corpus is a plain dataclass; the cache rides as an
+            # instance attribute so dataclass equality/replace ignore it.
+            object.__setattr__(self, "_layout_cache", cache)
+        if key in cache:
+            return cache[key]
+        if min_len < 1:
+            raise ValueError(f"min_len must be >= 1, got {min_len}")
+        lengths = self.doc_lengths()
+        d = self.num_docs
+        # Stable sort by token count: ties keep first-seen doc order, so
+        # the layout (and therefore every artifact downstream of a
+        # pinned sparse run) is deterministic.
+        order = np.argsort(lengths, kind="stable").astype(np.int64)
+        # Power-of-two bucket length per doc, floored at min_len
+        # (empty docs ride the smallest bucket; their zero counts are
+        # arithmetically inert, same rule as make_batches).
+        clamped = np.maximum(lengths, 1)
+        buck = np.maximum(
+            min_len,
+            2 ** np.ceil(np.log2(clamped)).astype(np.int64),
+        )
+        batches: list[Batch] = []
+        perm_parts: list[np.ndarray] = []
+        for L in np.unique(buck[order]):
+            docs = order[buck[order] == L]
+            for start in range(0, len(docs), batch_cap):
+                chunk = docs[start:start + batch_cap]
+                n = len(chunk)
+                b = -(-n // pad_multiple) * pad_multiple
+                # Vectorized CSR gather: token j of packed row i lives
+                # at word_idx[ptr[d_i] + j] while j < len(d_i), else
+                # pad (id 0, count 0).
+                col = np.arange(int(L), dtype=np.int64)[None, :]
+                lens = lengths[chunk][:, None]
+                src = np.minimum(
+                    self.doc_ptr[chunk][:, None] + col,
+                    len(self.word_idx) - 1 if len(self.word_idx) else 0,
+                )
+                live = col < lens
+                widx = np.zeros((b, int(L)), np.int32)
+                cnts = np.zeros((b, int(L)), np.float32)
+                if len(self.word_idx):
+                    widx[:n] = np.where(live, self.word_idx[src], 0)
+                    cnts[:n] = np.where(live, self.counts[src], 0)
+                didx = np.zeros((b,), np.int32)
+                didx[:n] = chunk
+                mask = np.zeros((b,), np.float32)
+                mask[:n] = 1.0
+                batches.append(Batch(widx, cnts, didx, mask))
+                perm_parts.append(chunk)
+        perm = (
+            np.concatenate(perm_parts) if perm_parts
+            else np.zeros(0, np.int64)
+        )
+        inv_perm = np.empty(d, np.int64)
+        inv_perm[perm] = np.arange(d, dtype=np.int64)
+        layout = BucketedLayout(
+            batches=tuple(batches), perm=perm, inv_perm=inv_perm,
+            min_len=min_len,
+        )
+        cache[key] = layout
+        return layout
+
     # -- serialization (reference contracts) --------------------------------
 
     def save(self, directory: str) -> None:
@@ -244,6 +360,42 @@ class Batch:
     @property
     def bucket_len(self) -> int:
         return self.word_idx.shape[1]
+
+
+@dataclass(frozen=True)
+class BucketedLayout:
+    """Length-sorted, power-of-two-bucketed packing of a corpus — the
+    sparse E-step engine's device layout (ops/sparse_estep.py).
+
+    `batches` are ordinary padded `Batch` tiles, but built by ONE
+    vectorized pass (a stable argsort by token count, then CSR gathers)
+    instead of make_batches' per-doc fill loop, and with the bucket
+    floor at the Pallas lane tile (min_len=128 by default) so a
+    [K, BB, L] slab block never pads its lane dimension.
+
+    `perm[j]` is the ORIGINAL doc id of the j-th real (unmasked) row in
+    packed order; `inv_perm` inverts it, so `values[inv_perm]` restores
+    document order bit-exactly from per-row results concatenated in
+    layout order (`restore()`).  The layout is cached on the Corpus —
+    building it is an O(tokens) host pass that must run once per
+    (min_len, batch_cap, pad_multiple), not once per consumer.
+    """
+
+    batches: tuple          # tuple[Batch]
+    perm: np.ndarray        # [D] int64: packed position -> original doc id
+    inv_perm: np.ndarray    # [D] int64: original doc id -> packed position
+    min_len: int
+
+    def restore(self, packed_rows: np.ndarray) -> np.ndarray:
+        """Per-doc values in packed (layout) order -> original document
+        order.  Exact: a pure permutation gather, no arithmetic."""
+        packed_rows = np.asarray(packed_rows)
+        if packed_rows.shape[0] != len(self.perm):
+            raise ValueError(
+                f"{packed_rows.shape[0]} packed rows for "
+                f"{len(self.perm)} documents"
+            )
+        return packed_rows[self.inv_perm]
 
 
 def _bucket_len(n: int, min_bucket: int) -> int:

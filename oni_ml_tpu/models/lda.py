@@ -617,8 +617,11 @@ class LDATrainer:
         env = os.environ.get("ONI_ML_TPU_ESTEP", "")
         # "compact" forces the compact-vocab dense variant: full-V dense
         # off here, then _plan_compact treats the same env as forced-on.
+        # "sparse" forces the fused sparse bucketed engine — the whole
+        # dense family stands down.
         mode = {"dense": "on", "compact": "off", "xla": "off",
-                "pallas": "off"}.get(env, self.config.dense_em)
+                "pallas": "off", "sparse": "off"}.get(
+                    env, self.config.dense_em)
         if mode not in ("auto", "on", "off"):
             raise ValueError(
                 f"LDAConfig.dense_em={mode!r}: expected 'auto', 'on', or "
@@ -976,6 +979,30 @@ class LDATrainer:
                 compiler_options = {
                     "xla_tpu_scoped_vmem_limit_kib": str(max(filter(None, kibs)))
                 }
+        if (
+            not use_dense
+            and compact is None
+            and getattr(self._e_base, "_oni_sparse_engine", False)
+            and jax.default_backend() == "tpu"
+        ):
+            from ..ops import sparse_estep
+
+            # Same scoped-VMEM forwarding the dense kernels need: XLA
+            # drops a fusion-wrapped pallas_call's own CompilerParams
+            # limit inside the chunk program.
+            kibs = [
+                sparse_estep.scoped_vmem_kib(
+                    b.word_idx.shape[0], b.word_idx.shape[1], k,
+                    getattr(self._e_base, "precision", "f32"),
+                )
+                for b in batches
+            ]
+            if any(kibs):
+                compiler_options = {
+                    "xla_tpu_scoped_vmem_limit_kib": str(
+                        max(filter(None, kibs))
+                    )
+                }
         run_chunk = fused.make_chunk_runner(
             num_docs=num_docs,
             num_topics=k,
@@ -1098,6 +1125,85 @@ class LDATrainer:
         return log_beta, alpha, it
 
 
+def resolve_estep_engine(
+    corpus: Corpus, config: LDAConfig, mesh=None, vocab_sharded: bool = False
+) -> "tuple[str, str]":
+    """Resolve the E-step engine FAMILY for a batch training run:
+    ("sparse" | "dense", source).
+
+    "sparse" is the fused bucketed Pallas engine (ops/sparse_estep.py:
+    corpus packed by Corpus.bucketed_layout, K×L work per doc);
+    "dense" is everything that exists today — the dense/compact/XLA/
+    Pallas family whose internal gates (_use_dense, _plan_compact,
+    estep.e_step auto) are unchanged.  Precedence mirrors the rest of
+    the plan layer: ONI_ML_TPU_ESTEP env ("env") > an explicit
+    LDAConfig.estep_engine ("config") > the MEASURED dense-vs-sparse
+    crossover from the plan cache (sparse_estep.engine_crossover —
+    source "plan" when a persisted entry serves, "measured" when this
+    run sweeps it once) on TPU, else the dense family ("default").
+    Meshes always take the dense family: the sparse engine is
+    single-process (its suff-stats scatter and layout permutation are
+    not sharded yet) and forcing it there is an error, not a silent
+    fallback."""
+    env = os.environ.get("ONI_ML_TPU_ESTEP", "")
+    choice = config.estep_engine
+    if choice not in ("auto", "dense", "sparse"):
+        raise ValueError(
+            f"LDAConfig.estep_engine={choice!r}: expected 'auto', "
+            "'dense', or 'sparse'"
+        )
+    forced_sparse = env == "sparse" or (not env and choice == "sparse")
+    if mesh is not None or vocab_sharded:
+        if forced_sparse:
+            raise ValueError(
+                "the sparse bucketed E-step engine is single-process; "
+                "meshes keep the sharded dense/sparse plans "
+                "(unset ONI_ML_TPU_ESTEP=sparse / estep_engine='sparse')"
+            )
+        return "dense", "default"
+    if forced_sparse and config.dense_em == "on":
+        raise ValueError(
+            "estep_engine='sparse' conflicts with dense_em='on' — pin "
+            "one engine family, not both"
+        )
+    if env:
+        return ("sparse", "env") if env == "sparse" else ("dense", "env")
+    if choice != "auto":
+        return choice, "config"
+    if jax.default_backend() != "tpu" or config.dense_em == "on":
+        # CPU/interpret runs keep today's paths (the dense family's
+        # auto already resolves to XLA there); dense_em="on" is an
+        # explicit family pin.
+        return "dense", "default"
+    from ..ops import sparse_estep
+
+    l_len, _ = sparse_estep.resolve_layout_len(config.sparse_min_bucket_len)
+    # Shapes only — the O(tokens) packing pass is deferred to
+    # train_corpus's sparse branch, so a dense-winning crossover never
+    # pays for (or keeps cached) padded tiles it won't train on.
+    shapes = corpus.bucket_shapes(
+        min_len=l_len, batch_cap=config.batch_size,
+        pad_multiple=sparse_estep.pad_multiple_for(config.dense_precision),
+    )
+    if not shapes:
+        return "dense", "default"
+    # EVERY bucket shape must admit a block — the VMEM-worst bucket is
+    # typically a small-B huge-L one, not the largest batch.
+    if any(
+        sparse_estep.pick_block(
+            bb, ll, config.num_topics, config.dense_precision
+        ) is None
+        for bb, ll, _ in shapes
+    ):
+        return "dense", "default"
+    b_dom, l_dom, _ = max(shapes, key=lambda s: s[2])
+    cross = sparse_estep.engine_crossover(
+        config.num_topics, corpus.num_terms, b_dom, l_dom,
+        precision=config.dense_precision,
+    )
+    return cross["engine"], cross["source"]
+
+
 def train_corpus(
     corpus: Corpus,
     config: LDAConfig,
@@ -1126,6 +1232,46 @@ def train_corpus(
     initial_log_beta = None
     if vocab_sharded and mesh is None:
         raise ValueError("vocab_sharded=True requires a mesh")
+    engine, engine_src = resolve_estep_engine(
+        corpus, config, mesh=mesh, vocab_sharded=vocab_sharded
+    )
+    sparse_layout = None
+    sparse_l_record = None
+    if engine == "sparse":
+        from ..ops import sparse_estep
+
+        sparse_l, sparse_l_src = sparse_estep.resolve_layout_len(
+            config.sparse_min_bucket_len
+        )
+        sparse_l_record = {"value": sparse_l, "source": sparse_l_src}
+        # The batch axis pads to the engine precision's sublane tile
+        # (16 for bf16) so every bucket's padded doc count admits a
+        # kernel block; a forced-sparse run whose shapes still cannot
+        # block fails HERE with the shapes named, not mid-training
+        # inside the chunk program.
+        pad = sparse_estep.pad_multiple_for(config.dense_precision)
+        bad = [
+            (bb, ll)
+            for bb, ll, _ in corpus.bucket_shapes(
+                min_len=sparse_l, batch_cap=config.batch_size,
+                pad_multiple=pad,
+            )
+            if sparse_estep.pick_block(
+                bb, ll, config.num_topics, config.dense_precision
+            ) is None
+        ]
+        if bad:
+            raise ValueError(
+                f"sparse E-step engine selected but bucket shapes {bad} "
+                "admit no VMEM-feasible doc block at precision "
+                f"{config.dense_precision!r} (K={config.num_topics}); "
+                "use the dense family for this corpus"
+            )
+        sparse_layout = corpus.bucketed_layout(
+            min_len=sparse_l, batch_cap=config.batch_size,
+            pad_multiple=pad,
+        )
+        e_fn = sparse_estep.make_e_step_fn(precision=config.dense_precision)
     if mesh is not None:
         from ..parallel import sharded
         from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -1165,11 +1311,18 @@ def train_corpus(
         else:
             e_fn = sharded.make_data_parallel_e_step(mesh)
 
-    batches = make_batches(
-        corpus, batch_size=config.batch_size,
-        min_bucket_len=config.min_bucket_len,
-        pad_multiple=mesh.shape[DATA_AXIS] if mesh is not None else 8,
-    )
+    if sparse_layout is not None:
+        # The sparse engine trains over the bucketed layout's packed
+        # tiles; Batch.doc_index carries the permutation, so fit()'s
+        # gamma scatter restores document order bit-exactly
+        # (layout.inv_perm is the same map, pinned by tests).
+        batches = list(sparse_layout.batches)
+    else:
+        batches = make_batches(
+            corpus, batch_size=config.batch_size,
+            min_bucket_len=config.min_bucket_len,
+            pad_multiple=mesh.shape[DATA_AXIS] if mesh is not None else 8,
+        )
     trainer = LDATrainer(
         config,
         num_terms=num_terms,
@@ -1192,6 +1345,11 @@ def train_corpus(
         initial_log_beta=initial_log_beta,
         checkpoint_path=ckpt_path,
     )
+    # Engine attribution rides the same plan record every other
+    # resolved knob does (stage records surface it per run).
+    result.plan["estep_engine"] = {"value": engine, "source": engine_src}
+    if sparse_l_record is not None:
+        result.plan["sparse_estep_l"] = sparse_l_record
     if num_terms != corpus.num_terms:
         result.log_beta = result.log_beta[:, : corpus.num_terms]
     if out_dir and save_final and _is_coordinator():

@@ -204,9 +204,11 @@ def e_step(
 ) -> EStepResult:
     """Run the per-document fixed point to convergence for one batch.
 
-    backend: "auto" uses the Pallas VMEM-resident fixed point on TPU when
-    the shapes admit it (ops/pallas_estep.py), else pure XLA; "xla" /
-    "pallas" / "dense" force a path (ONI_ML_TPU_ESTEP env var overrides
+    backend: "auto" uses the fused sparse Pallas E-step on TPU when the
+    shapes admit it (ops/sparse_estep.py — fixed point AND suff-stats/
+    ELBO tail in one VMEM residency), else the fixed-point-only Pallas
+    kernel (ops/pallas_estep.py), else pure XLA; "xla" / "pallas" /
+    "sparse" / "dense" force a path (ONI_ML_TPU_ESTEP env var overrides
     "auto").  "dense" densifies the batch per call — drivers that own the
     batches amortize the densification instead (models/fused.py).
     """
@@ -219,12 +221,15 @@ def e_step(
         # is amortized across the run).  Honoring them per call here would
         # re-scatter the batch every EM iteration — the exact cost the dense
         # paths exist to avoid — so auto dispatch ignores them; only an
-        # explicit backend="dense" argument densifies inline.
+        # explicit backend="dense" argument densifies inline.  "sparse"
+        # passes through: the fused sparse kernel has no per-call setup
+        # to amortize, so forcing it per call is well-defined.
         backend = "auto" if env in ("dense", "compact") else env
-    if backend not in ("auto", "xla", "pallas", "dense"):
+    if backend not in ("auto", "xla", "pallas", "sparse", "dense"):
         raise ValueError(
             f"unknown E-step backend {backend!r} (set via ONI_ML_TPU_ESTEP "
-            "or the backend= argument); expected auto, xla, pallas, or dense"
+            "or the backend= argument); expected auto, xla, pallas, "
+            "sparse, or dense"
         )
     if backend == "dense":
         from . import dense_estep
@@ -243,6 +248,30 @@ def e_step(
             interpret=jax.default_backend() != "tpu",
             gamma_prev=gamma_prev, warm=warm,
         )
+    if backend in ("auto", "sparse"):
+        from . import sparse_estep
+
+        b, l = word_idx.shape
+        if backend == "sparse":
+            if sparse_estep.pick_block(b, l, log_beta.shape[0]) is None:
+                raise ValueError(
+                    f"sparse E-step forced but B={b}, L={l}, "
+                    f"K={log_beta.shape[0]} has no VMEM-feasible doc "
+                    "block (unset ONI_ML_TPU_ESTEP=sparse or reduce "
+                    "the batch)"
+                )
+            return sparse_estep.e_step(
+                log_beta, alpha, word_idx, counts, doc_mask,
+                var_max_iters, var_tol,
+                interpret=jax.default_backend() != "tpu",
+                gamma_prev=gamma_prev, warm=warm,
+            )
+        if sparse_estep.available(b, l, log_beta.shape[0]):
+            return sparse_estep.e_step(
+                log_beta, alpha, word_idx, counts, doc_mask,
+                var_max_iters, var_tol,
+                gamma_prev=gamma_prev, warm=warm,
+            )
     if backend != "xla":
         from . import pallas_estep
 

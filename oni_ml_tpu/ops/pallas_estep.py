@@ -54,8 +54,14 @@ _VMEM_BUDGET = 12 * 1024 * 1024
 _MAX_BLOCK_DOCS = 128
 
 
-def _vmem_estimate(bb: int, l: int, k: int) -> int:
-    return 2 * k * bb * l * 4 + 2 * k * bb * 128 * 4
+def _vmem_estimate(bb: int, l: int, k: int, precision: str = "f32") -> int:
+    """Working-set bytes at doc block `bb`.  `precision` is the SLAB
+    storage dtype ("bf16" halves the double-buffered slab term — the
+    dominant one), mirroring dense_estep._vmem_estimate's signature;
+    before this took a precision, bf16 block picks sized VMEM as f32
+    and silently halved the feasible block space."""
+    slab_item = 2 if precision == "bf16" else 4
+    return 2 * k * bb * l * slab_item + 2 * k * bb * 128 * 4
 
 
 def newton_recip(q: jnp.ndarray) -> jnp.ndarray:
@@ -180,15 +186,16 @@ def _fixed_point_kernel(
     iters_ref[pl.program_id(0), 0] = iters
 
 
-def pick_block(b: int, l: int, k: int) -> int | None:
+def pick_block(b: int, l: int, k: int, precision: str = "f32") -> int | None:
     """Largest power-of-two doc block whose estimated kernel working set
     (double-buffered slab + the K sets of lane-padded column temporaries,
     _vmem_estimate) fits the VMEM budget.  None if no valid block exists
-    (fall back to the XLA path)."""
-    bb = 8
+    (fall back to the XLA path).  A bf16-stored slab needs its doc
+    block on the 16-sublane tile (f32 tiles at 8)."""
+    bb = 16 if precision == "bf16" else 8
     best = None
     while bb <= min(b, _MAX_BLOCK_DOCS) and b % bb == 0:
-        if _vmem_estimate(bb, l, k) > _VMEM_BUDGET:
+        if _vmem_estimate(bb, l, k, precision) > _VMEM_BUDGET:
             break
         best = bb
         bb *= 2
@@ -298,6 +305,9 @@ def e_step(
     return estep.EStepResult(gamma, suff, alpha_ss, likelihood, iters)
 
 
-def available(b: int, l: int, k: int) -> bool:
+def available(b: int, l: int, k: int, precision: str = "f32") -> bool:
     """True when shapes admit a VMEM-feasible block and we're on TPU."""
-    return jax.default_backend() == "tpu" and pick_block(b, l, k) is not None
+    return (
+        jax.default_backend() == "tpu"
+        and pick_block(b, l, k, precision) is not None
+    )

@@ -36,6 +36,13 @@ def _calibration_dict(v) -> bool:
     )
 
 
+def _engine_dict(v) -> bool:
+    # The dense-vs-sparse crossover record (sparse_estep.engine_crossover):
+    # engine must name a real family — a hand-edited "fastest" would
+    # otherwise silently fall through every engine gate downstream.
+    return isinstance(v, dict) and v.get("engine") in ("dense", "sparse")
+
+
 @dataclass(frozen=True)
 class Knob:
     """One tunable: `scope` picks the fingerprint (a host knob like
@@ -84,6 +91,29 @@ KNOBS = {
         Knob(
             "dense_estep_block_w", None, valid=_pos_int,
             doc="W-major twin of dense_estep_block (pick_block_w)",
+        ),
+        Knob(
+            "sparse_estep_bb", None, valid=_pos_int,
+            doc="measured doc-block override for ops/sparse_estep."
+                "pick_block (the analytic VMEM pick is the prior); "
+                "shape key b{B}.l{L}.k{K}.{precision} — "
+                "tools/estep_probe.py sweeps it",
+        ),
+        Knob(
+            "sparse_estep_l", LDAConfig.sparse_min_bucket_len,
+            candidates=(128, 256), valid=_pos_int,
+            doc="minimum packed tile length (lane-tile floor) for the "
+                "sparse engine's bucketed corpus layout "
+                "(Corpus.bucketed_layout via sparse_estep."
+                "resolve_layout_len)",
+        ),
+        Knob(
+            "estep_engine", None, valid=_engine_dict,
+            doc="measured dense-vs-sparse E-step engine crossover "
+                "(sparse_estep.engine_crossover record, minus its "
+                "source/shape fields), keyed by exact shape and by "
+                "density band — the dispatch_calibration pattern for "
+                "the EM engines",
         ),
         Knob(
             "score_device_chunk", ScoringConfig.device_chunk,

@@ -222,13 +222,25 @@ def reset() -> None:
 
 
 def roofline_record(phase: str, wall_s: float, *, entry: "str | None" = None,
-                    dispatches: int = 1, **extra) -> dict:
+                    dispatches: int = 1,
+                    effective_flops: "float | None" = None,
+                    **extra) -> dict:
     """Build one roofline record: the entry's per-dispatch cost times
     `dispatches`, over the measured wall, against the backend's peaks.
 
     Always returns a record.  Without harvested cost: wall-time-only
     (`flops`/`bytes`/`utilization` null).  With cost but no peaks (CPU):
-    achieved FLOP/s / bytes/s, `utilization` null."""
+    achieved FLOP/s / bytes/s, `utilization` null.
+
+    `effective_flops` (total over the wall) is the FLOPs the MATH
+    needed — for the E-step engines, the live-token work
+    (sparse_estep.effective_flops) as opposed to the dense-equivalent
+    FLOPs the program executed.  When given, the record carries
+    `effective_flops`/`effective_flops_per_s` alongside the executed
+    counts, and `utilization` gains `useful_mxu_pct` (effective over
+    peak): "fraction of peak" vs "useful fraction of peak", so padding
+    waste is visible as the gap between `mxu_pct` and
+    `useful_mxu_pct`."""
     cost = cost_for(entry or phase)
     backend = (cost or {}).get("backend") or _backend_fingerprint()
     rec = {
@@ -243,22 +255,29 @@ def roofline_record(phase: str, wall_s: float, *, entry: "str | None" = None,
         "bytes": None,
         "flops_per_s": None,
         "bytes_per_s": None,
+        "effective_flops": None,
+        "effective_flops_per_s": None,
         "peaks": None,
         "utilization": None,
         **extra,
     }
-    if cost is None or wall_s <= 0:
+    if wall_s <= 0:
         return rec
-    flops = cost.get("flops")
-    nbytes = cost.get("bytes")
-    if flops is not None:
-        rec["flops"] = flops * dispatches
-        rec["flops_per_s"] = rec["flops"] / wall_s
-    if nbytes is not None:
-        rec["bytes"] = nbytes * dispatches
-        rec["bytes_per_s"] = rec["bytes"] / wall_s
+    if effective_flops is not None:
+        rec["effective_flops"] = float(effective_flops)
+        rec["effective_flops_per_s"] = float(effective_flops) / wall_s
     spec = peaks_for(backend)
-    if spec is not None:
+    if cost is not None:
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes")
+        if flops is not None:
+            rec["flops"] = flops * dispatches
+            rec["flops_per_s"] = rec["flops"] / wall_s
+        if nbytes is not None:
+            rec["bytes"] = nbytes * dispatches
+            rec["bytes_per_s"] = rec["bytes"] / wall_s
+    if spec is not None and (cost is not None
+                             or rec["effective_flops_per_s"] is not None):
         rec["peaks"] = {
             "flops_per_s": spec.flops_per_s,
             "hbm_bytes_per_s": spec.hbm_bytes_per_s,
@@ -273,19 +292,25 @@ def roofline_record(phase: str, wall_s: float, *, entry: "str | None" = None,
             util["hbm_pct"] = round(
                 100.0 * rec["bytes_per_s"] / spec.hbm_bytes_per_s, 2
             )
+        if rec["effective_flops_per_s"] is not None:
+            util["useful_mxu_pct"] = round(
+                100.0 * rec["effective_flops_per_s"] / spec.flops_per_s, 2
+            )
         rec["utilization"] = util or None
     return rec
 
 
 def emit(phase: str, wall_s: float, *, entry: "str | None" = None,
-         dispatches: int = 1, recorder=None, journal=None, **extra) -> dict:
+         dispatches: int = 1, effective_flops: "float | None" = None,
+         recorder=None, journal=None, **extra) -> dict:
     """Build and publish one roofline record: append to the journal
     (explicit `journal`/RunJournal, else the active Recorder's bound
     journal), set `roofline.<phase>.*` gauges on the Recorder, and keep
     it in the process ledger (`emitted_records()`) for the runner's
     metrics.json / bench payload sections.  Never raises."""
     rec = roofline_record(phase, wall_s, entry=entry,
-                          dispatches=dispatches, **extra)
+                          dispatches=dispatches,
+                          effective_flops=effective_flops, **extra)
     try:
         r = recorder if recorder is not None else current_recorder()
         if r is not None:
@@ -293,6 +318,9 @@ def emit(phase: str, wall_s: float, *, entry: "str | None" = None,
                 r.gauge(f"roofline.{phase}.flops_per_s", rec["flops_per_s"])
             if rec["bytes_per_s"] is not None:
                 r.gauge(f"roofline.{phase}.bytes_per_s", rec["bytes_per_s"])
+            if rec["effective_flops_per_s"] is not None:
+                r.gauge(f"roofline.{phase}.effective_flops_per_s",
+                        rec["effective_flops_per_s"])
             util = rec.get("utilization") or {}
             for k, v in util.items():
                 r.gauge(f"roofline.{phase}.{k}", v)
@@ -365,6 +393,15 @@ HARVEST_COVERAGE: "dict[str, str]" = {
     # entry point: _aot() reads cost_analysis off every program it
     # compiles.  Neither belongs in the registry: the harvest-coverage
     # lint keys entries to real jax.jit AST nodes.
+    "ops/sparse_estep.py": (
+        "estep crossover probes only — measure_crossover's jitted "
+        "engine timers are one-shot sweeps whose result IS the "
+        "measurement (persisted to the plan cache), not a dispatch "
+        "phase; production sparse-engine dispatch is harvested at the "
+        "drivers' entries (em.run_chunk, em.e_step), same as the dense "
+        "kernels, with effective-FLOPs accounting via "
+        "sparse_estep.effective_flops at emit time"
+    ),
     "scoring/pipeline.py": (
         "score.device.{full,filtered,filtered_flow} — harvested by "
         "plans.warmup.warmup_scoring AOT and ensure_harvested at "

@@ -285,6 +285,84 @@ def _patch_phases(bench, monkeypatch):
     )
 
 
+def test_bench_em_engine_pinning_smoke():
+    """bench_em's engine pin: "sparse" runs the fused sparse bucketed
+    kernel, "dense" forces the dense kernel in interpret mode on CPU —
+    the two sides of the dense_vs_sparse crossover measurement — and
+    the payload names what ran plus the effective/dense-equivalent
+    FLOP accounting."""
+    import bench
+
+    em_s = bench.bench_em(4, 256, 32, 16, chunk=2, rounds=1,
+                          var_max_iters=3, engine="sparse",
+                          precision="f32")
+    assert em_s["estep_engine"] == "sparse"
+    assert em_s["use_dense"] is False
+    assert em_s["flops_effective_per_iter"] > 0
+    # 256 pads to the 128-lane tile; L=16 -> 16x dense-equivalent waste.
+    assert em_s["flops_dense_equiv_per_iter"] == \
+        em_s["flops_effective_per_iter"] * (256 / 16)
+    assert em_s["roofline"]["effective_flops"] > 0
+
+    em_d = bench.bench_em(4, 256, 32, 16, chunk=2, rounds=1,
+                          var_max_iters=3, engine="dense",
+                          precision="f32")
+    assert em_d["estep_engine"] == "dense"
+    assert em_d["use_dense"] is True
+    assert np.isfinite(em_d["docs_per_sec"])
+
+
+def test_bench_dense_vs_sparse_records_crossover(monkeypatch, tmp_path):
+    """The dense_vs_sparse section measures both engines, persists the
+    winner to the plan cache, and the RESOLVED engine (what a fresh
+    auto run would pick, source "plan") is never slower than the dense
+    baseline — the crossover proving itself on CPU."""
+    import bench
+    from oni_ml_tpu.ops import sparse_estep
+
+    monkeypatch.setenv("ONI_ML_TPU_PLAN_CACHE",
+                       str(tmp_path / "plans.jsonl"))
+    sparse_estep._CROSSOVER_CACHE.clear()
+    dvs = bench.bench_dense_vs_sparse(4, 256, 32, 16, chunk=2, rounds=1,
+                                      precision="f32")
+    assert dvs["winner"] in ("dense", "sparse")
+    assert dvs["resolved_engine"] == dvs["winner"]
+    assert dvs["resolved_source"] == "plan"
+    assert dvs[dvs["winner"]]["docs_per_sec"] >= \
+        dvs["dense"]["docs_per_sec"]
+    for engine in ("dense", "sparse"):
+        assert dvs[engine]["roofline"]["wall_s"] > 0
+    sparse_estep._CROSSOVER_CACHE.clear()
+
+
+def test_bench_main_diff_gate(capsys, monkeypatch, tmp_path):
+    """BENCH_DIFF_AGAINST wires tools/bench_diff into main() as an
+    opt-in post-run gate: the comparison rides the final record and a
+    regression flips the exit code to 1 for CI."""
+    import bench
+
+    _patch_phases(bench, monkeypatch)
+    base = {"metric": "lda_em_throughput", "value": 10_000.0,
+            "unit": "docs/sec"}
+    path = tmp_path / "base.json"
+    path.write_text(json.dumps(base))
+    monkeypatch.setenv("BENCH_DIFF_AGAINST", str(path))
+    assert bench.main() == 1          # stub headline 1000 << 10000
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["bench_diff"]["regressions"] == 1
+    assert rec["bench_diff"]["against"] == str(path)
+
+    # A compatible baseline exits 0 with the comparison still recorded.
+    base["value"] = 999.0
+    path.write_text(json.dumps(base))
+    assert bench.main() == 0
+    rec = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1]
+    )
+    assert rec["bench_diff"]["regressions"] == 0
+
+
 def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
     """main() re-prints the growing record after each phase (so a
     mid-run wedge can't erase the headline); the driver parses the LAST

@@ -1,8 +1,14 @@
-"""Pallas E-step kernel vs the XLA path (interpret mode on CPU).
+"""Pallas E-step kernel vs the XLA path.
 
 The kernel must agree with estep.e_step to fixed-point tolerance: same
 converged gammas, suff-stats, ELBO.  Also covers the in-kernel digamma
 (jax.scipy's is not a Mosaic primitive) and block-size selection.
+
+Kernel math runs under interpret mode on EVERY CPU suite run (the
+``interpret`` parametrization below); the compiled Mosaic variant of
+each parity test is TPU-marked, so a chip-attached run
+(ONI_ML_TPU_TESTS_ON_TPU=1) exercises the real lowering with the same
+assertions instead of a separate smoke file.
 """
 
 import numpy as np
@@ -11,6 +17,26 @@ import pytest
 from jax.scipy.special import digamma
 
 from oni_ml_tpu.ops import estep, pallas_estep
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+INTERPRET = [
+    pytest.param(True, id="interpret"),
+    pytest.param(
+        False, id="compiled",
+        marks=pytest.mark.skipif(
+            not _on_tpu(), reason="compiled Pallas needs a TPU backend"
+        ),
+    ),
+]
 
 
 @pytest.fixture(scope="module")
@@ -60,12 +86,13 @@ def test_gammaln_matches_scipy():
         )
 
 
-def test_e_step_parity_interpret(problem):
+@pytest.mark.parametrize("interpret", INTERPRET)
+def test_e_step_parity(problem, interpret):
     lb, a, w, c, m = problem
     ref = estep.e_step(lb, a, w, c, m, var_max_iters=50, var_tol=1e-7,
                        backend="xla")
     pal = pallas_estep.e_step(lb, a, w, c, m, var_max_iters=50, var_tol=1e-7,
-                              interpret=True)
+                              interpret=interpret)
     sel = np.asarray(m) == 1
     np.testing.assert_allclose(
         np.asarray(pal.gamma)[sel], np.asarray(ref.gamma)[sel],
@@ -83,10 +110,11 @@ def test_e_step_parity_interpret(problem):
     )
 
 
-def test_iteration_cap_respected(problem):
+@pytest.mark.parametrize("interpret", INTERPRET)
+def test_iteration_cap_respected(problem, interpret):
     lb, a, w, c, m = problem
     pal = pallas_estep.e_step(lb, a, w, c, m, var_max_iters=3, var_tol=0.0,
-                              interpret=True)
+                              interpret=interpret)
     assert int(pal.vi_iters) == 3
 
 
@@ -105,6 +133,24 @@ def test_pick_block():
     bb = pallas_estep.pick_block(4096, 16, 50)
     assert bb is not None
     assert pallas_estep._vmem_estimate(bb, 16, 50) <= pallas_estep._VMEM_BUDGET
+
+
+def test_vmem_estimate_takes_precision():
+    """A bf16-stored slab halves the dominant VMEM term, so bf16 block
+    picks must size against the real footprint — before _vmem_estimate
+    took a precision, bf16 picks sized VMEM as f32 and halved the
+    feasible block space (ISSUE 9 satellite)."""
+    f32 = pallas_estep._vmem_estimate(64, 2048, 20, "f32")
+    b16 = pallas_estep._vmem_estimate(64, 2048, 20, "bf16")
+    assert b16 < f32
+    # At a slab-dominated shape the bf16 pick reaches a strictly larger
+    # block than the f32 pick.
+    bb_f32 = pallas_estep.pick_block(4096, 4096, 20, "f32")
+    bb_b16 = pallas_estep.pick_block(4096, 4096, 20, "bf16")
+    assert bb_b16 is not None
+    assert bb_f32 is None or bb_b16 >= bb_f32
+    # bf16 blocks sit on the 16-sublane tile.
+    assert pallas_estep.pick_block(64, 128, 4, "bf16") % 16 == 0
 
 
 def test_auto_backend_on_cpu_uses_xla(problem):
@@ -148,7 +194,7 @@ def test_warm_start_sparse_paths(problem, backend):
                                   np.asarray(fresh.gamma))
 
 
-@pytest.mark.parametrize("backend", ["xla", "pallas", "dense"])
+@pytest.mark.parametrize("backend", ["xla", "pallas", "sparse", "dense"])
 def test_gamma_prev_without_warm_raises(problem, backend):
     """gamma_prev alone must error identically on every backend — never
     silently warm-start on one and crash on another."""
