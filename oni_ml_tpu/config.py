@@ -314,6 +314,29 @@ class ServingConfig:
     # stream end ("" = off) — CI and piped runs get the scrape bytes
     # without an HTTP listener.
     openmetrics_path: str = ""
+    # -- multi-tenant fleet (serving/fleet.py, `ml_ops serve --fleet`) --
+    # Fleet manifest path: a JSON file declaring the tenants
+    # (serving/tenants.py load_manifest).  "" = single-model serving.
+    fleet_manifest: str = ""
+    # Cross-tenant flush triggers for the FleetScorer — the fleet
+    # analogues of max_batch/max_wait_ms above, resolved through the
+    # plan cache the same way (plan knobs "fleet_max_batch" /
+    # "fleet_max_wait_ms"): the accumulating cross-tenant micro-batch
+    # flushes at this many events total, or when its globally-oldest
+    # event has waited this long.
+    fleet_max_batch: int = 4096
+    fleet_max_wait_ms: float = 50.0
+    # Per-tenant admission-queue bound: a tenant with this many events
+    # pending either blocks its own producers (admission="block" —
+    # backpressure, priced as serve.<tenant>.admission_stall_s) or
+    # sheds them (admission="reject" — AdmissionRejected raised, the
+    # event never enqueued, journaled as admission_reject).  A
+    # manifest entry's queue_max/admission override per tenant.  One
+    # tenant saturating its own bound cannot grow another tenant's
+    # latency: the scorer drains globally oldest-first and every queue
+    # is bounded independently.
+    tenant_queue_max: int = 8192
+    admission: str = "block"
 
 
 @dataclass(frozen=True)

@@ -150,5 +150,20 @@ KNOBS = {
             candidates=(10.0, 25.0, 50.0, 100.0), valid=_pos_num,
             doc="serving micro-batch latency trigger (ms)",
         ),
+        # Fleet flush triggers: host-scoped for the same reason as the
+        # single-model serve knobs above — queueing policy, not device
+        # property.
+        Knob(
+            "fleet_max_batch", ServingConfig.fleet_max_batch,
+            scope="host", candidates=(512, 1024, 2048, 4096, 8192),
+            doc="cross-tenant micro-batch flush size "
+                "(serving/fleet.py FleetScorer)",
+        ),
+        Knob(
+            "fleet_max_wait_ms", ServingConfig.fleet_max_wait_ms,
+            scope="host", candidates=(10.0, 25.0, 50.0, 100.0),
+            valid=_pos_num,
+            doc="cross-tenant micro-batch latency trigger (ms)",
+        ),
     )
 }

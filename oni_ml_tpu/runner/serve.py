@@ -54,9 +54,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--threshold", type=float,
                    default=ScoringConfig.threshold,
                    help="emit events scoring under this as suspicious")
-    p.add_argument("--max-batch", type=int, default=ServingConfig.max_batch)
-    p.add_argument("--max-wait-ms", type=float,
-                   default=ServingConfig.max_wait_ms)
+    # None = "not passed": the flag applies to whichever scorer the
+    # mode runs (BatchScorer max_batch/max_wait_ms, or the
+    # FleetScorer's fleet_max_batch/fleet_max_wait_ms under --fleet),
+    # and a None sentinel — unlike comparing against the default value
+    # — distinguishes 'unset' from 'explicitly set to the default' for
+    # the dry runs' rescaling.
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="micro-batch flush size (default: config/plan; "
+                   "under --fleet this sets the cross-tenant flush "
+                   "size)")
+    p.add_argument("--max-wait-ms", type=float, default=None,
+                   help="micro-batch latency trigger in ms (default: "
+                   "config/plan; under --fleet this sets the "
+                   "cross-tenant trigger)")
     p.add_argument("--device-score-min", type=int,
                    default=ServingConfig.device_score_min,
                    help="batches at/above this size score on device "
@@ -104,13 +115,26 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--dry-run", action="store_true",
                    help="exercise the full serving stack on a synthetic "
                    "in-memory day (no --day-dir needed) and exit")
+    p.add_argument("--fleet", default="", metavar="MANIFEST",
+                   help="multi-tenant fleet mode: serve every tenant in "
+                   "this JSON manifest (serving/tenants.py) through one "
+                   "shared compiled batch family; stream lines are "
+                   "'<tenant>\\t<raw csv line>'.  With --dry-run, the "
+                   "literal value 'synthetic' (or 'synthetic:N') runs "
+                   "the fleet acceptance path on N in-memory tenants "
+                   "(default 2) and exits")
     return p
 
 
 def _serving_config(args) -> ServingConfig:
+    mb, mw = args.max_batch, args.max_wait_ms
     return ServingConfig(
-        max_batch=args.max_batch,
-        max_wait_ms=args.max_wait_ms,
+        max_batch=mb if mb is not None else ServingConfig.max_batch,
+        max_wait_ms=mw if mw is not None else ServingConfig.max_wait_ms,
+        fleet_max_batch=(mb if mb is not None
+                         else ServingConfig.fleet_max_batch),
+        fleet_max_wait_ms=(mw if mw is not None
+                           else ServingConfig.fleet_max_wait_ms),
         device_score_min=args.device_score_min,
         refresh_every=args.refresh_every,
         threshold=args.threshold,
@@ -119,6 +143,7 @@ def _serving_config(args) -> ServingConfig:
         metrics_host=getattr(args, "metrics_host",
                              ServingConfig.metrics_host),
         openmetrics_path=getattr(args, "openmetrics", ""),
+        fleet_manifest=getattr(args, "fleet", ""),
     )
 
 
@@ -158,6 +183,46 @@ def _looks_like_header(line: str, dsource: str) -> bool:
         return True
 
 
+def _make_serve_roofline(metrics, journal):
+    """Serve roofline gauge, computed at SCRAPE time (and once at
+    shutdown): the warmed micro-batch program's harvested cost over the
+    cumulative DEVICE scoring wall (the serve.device_score_ms histogram
+    — device-path flushes only; pricing host flushes as device
+    dispatches would inflate the gauge arbitrarily) — achieved vs peak
+    for the serving phase, utilization null off-TPU.  Shared by the
+    single-model and fleet serve paths (the fleet's per-flush aggregate
+    record feeds the same histograms)."""
+    from ..telemetry import roofline as _roofline
+
+    def _serve_roofline(emit_journal: bool = False):
+        rec = metrics.recorder
+        kw = {"journal": journal} if emit_journal else {}
+        hd = rec.histograms.get("serve.device_score_ms")
+        if hd is not None and hd.count:
+            dev_events = rec.counters.get("serve.device_events")
+            return _roofline.emit(
+                "serve.micro_batch", hd.total / 1e3, dispatches=hd.count,
+                recorder=rec, path="device",
+                events=dev_events.value
+                if dev_events is not None else None, **kw,
+            )
+        # Host-path-only session (every flush under break-even): no
+        # device program ran, so there is no cost to join — emit a
+        # wall-time-only record over the full scoring wall (the entry
+        # name is unharvested by construction), never the device
+        # program's cost times host flushes.
+        h = rec.histograms.get("serve.score_ms")
+        if h is None or not h.count:
+            return None
+        return _roofline.emit(
+            "serve.micro_batch", h.total / 1e3, dispatches=h.count,
+            recorder=rec, entry="serve.micro_batch.host", path="host",
+            **kw,
+        )
+
+    return _serve_roofline
+
+
 def serve_stream(args) -> int:
     from ..config import ScoringConfig as SC
     from ..plans import warmup as plans_warmup
@@ -195,39 +260,7 @@ def serve_stream(args) -> int:
         "vocab": len(snap.model.word_index),
     })
 
-    # Serve roofline gauge, computed at SCRAPE time (and once at
-    # shutdown): the warmed micro-batch program's harvested cost over
-    # the cumulative DEVICE scoring wall (the serve.device_score_ms
-    # histogram — device-path flushes only; pricing host flushes as
-    # device dispatches would inflate the gauge arbitrarily) — achieved
-    # vs peak for the serving phase, utilization null off-TPU.
-    from ..telemetry import roofline as _roofline
-
-    def _serve_roofline(emit_journal: bool = False):
-        rec = metrics.recorder
-        kw = {"journal": journal} if emit_journal else {}
-        hd = rec.histograms.get("serve.device_score_ms")
-        if hd is not None and hd.count:
-            dev_events = rec.counters.get("serve.device_events")
-            return _roofline.emit(
-                "serve.micro_batch", hd.total / 1e3, dispatches=hd.count,
-                recorder=rec, path="device",
-                events=dev_events.value
-                if dev_events is not None else None, **kw,
-            )
-        # Host-path-only session (every flush under break-even): no
-        # device program ran, so there is no cost to join — emit a
-        # wall-time-only record over the full scoring wall (the entry
-        # name is unharvested by construction), never the device
-        # program's cost times host flushes.
-        h = rec.histograms.get("serve.score_ms")
-        if h is None or not h.count:
-            return None
-        return _roofline.emit(
-            "serve.micro_batch", h.total / 1e3, dispatches=h.count,
-            recorder=rec, entry="serve.micro_batch.host", path="host",
-            **kw,
-        )
+    _serve_roofline = _make_serve_roofline(metrics, journal)
 
     mserver = None
     if cfg.metrics_port:
@@ -373,14 +406,18 @@ def serve_stream(args) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _synthetic_day(n_events: int = 96, n_clients: int = 8, n_doms: int = 6):
+def _synthetic_day(n_events: int = 96, n_clients: int = 8, n_doms: int = 6,
+                   seed: int = 42):
     """A tiny deterministic DNS day: raw rows + the model trained
     'yesterday' on them (dirichlet-random theta/p over the day's actual
-    IP/word populations, like bench.py's scoring benches)."""
+    IP/word populations, like bench.py's scoring benches).  `seed`
+    varies the day — fleet harnesses use distinct seeds per tenant so
+    cross-tenant demux corruption cannot hide behind identical
+    models."""
     from ..features.dns import featurize_dns
     from ..scoring import ScoringModel
 
-    rng = np.random.default_rng(42)
+    rng = np.random.default_rng(seed)
     rows = [
         [
             "t", str(1454000000 + int(rng.integers(0, 86400))),
@@ -413,15 +450,17 @@ def dry_run(args) -> int:
     rows, model, cuts = _synthetic_day()
     registry = ModelRegistry()
     registry.publish(model, source="dry-run-synthetic")
-    # Flags carry through; only values the operator left at the serving
-    # defaults rescale to the 96-event synthetic day (max_batch=4096
-    # would make one batch and refresh_every=0 no swap — neither
-    # exercises the acceptance path; the max_wait_ms default already
-    # fits the dry run, so it passes through untouched).
+    # Flags carry through; only values the operator did NOT pass
+    # rescale to the 96-event synthetic day (max_batch=4096 would make
+    # one batch and refresh_every=0 no swap — neither exercises the
+    # acceptance path; the max_wait_ms default already fits the dry
+    # run).
     cfg = ServingConfig(
         max_batch=(args.max_batch
-                   if args.max_batch != ServingConfig.max_batch else 32),
-        max_wait_ms=args.max_wait_ms,
+                   if args.max_batch is not None else 32),
+        max_wait_ms=(args.max_wait_ms
+                     if args.max_wait_ms is not None
+                     else ServingConfig.max_wait_ms),
         refresh_every=args.refresh_every or 2,
         threshold=args.threshold,
         device_score_min=args.device_score_min,
@@ -475,6 +514,386 @@ def dry_run(args) -> int:
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# --fleet: multi-tenant serving
+# ---------------------------------------------------------------------------
+
+
+def serve_fleet_stream(args) -> int:
+    """Serve every tenant of a fleet manifest through one FleetScorer:
+    shared device residency + one AOT-warmed compiled batch family,
+    per-tenant admission/metrics/hot-swap.  Stream lines are
+    ``<tenant>\\t<raw csv line>`` (a single-tenant manifest also
+    accepts untagged lines)."""
+    from ..config import ScoringConfig as SC
+    from ..plans import warmup as plans_warmup
+    from ..serving import FleetRegistry, FleetScorer, load_manifest
+
+    cc_rec = plans_warmup.setup_compilation_cache(
+        enabled=not args.no_compilation_cache
+    )
+    cfg = _serving_config(args)
+    specs = load_manifest(args.fleet)
+    journal = None
+    if getattr(args, "journal", ""):
+        from ..telemetry import Journal
+
+        journal = Journal(args.journal)
+    metrics = MetricsEmitter(path=cfg.metrics_path, journal=journal)
+    fleet = FleetRegistry(journal=journal, recorder=metrics.recorder)
+    sc = SC()
+    featurizers: dict = {}
+    for spec in specs:
+        if not spec.day_dir:
+            raise SystemExit(
+                f"fleet manifest tenant {spec.tenant!r} has no day_dir"
+            )
+        fleet.add_tenant(spec)
+        fallback = (sc.flow_fallback if spec.dsource == "flow"
+                    else sc.dns_fallback)
+        snap = fleet.load_day(spec.tenant, spec.day_dir, fallback)
+        fz = _load_featurizer(spec.day_dir, args.top_domains)
+        if fz.dsource != spec.dsource:
+            raise SystemExit(
+                f"tenant {spec.tenant!r} declares dsource "
+                f"{spec.dsource} but {spec.day_dir} holds "
+                f"{fz.dsource} features"
+            )
+        featurizers[spec.tenant] = fz
+        metrics.emit({
+            "stage": "serve", "event": "model_loaded",
+            "tenant": spec.tenant, "source": snap.source,
+            "model_version": snap.version,
+            "ips": len(snap.model.ip_index),
+            "vocab": len(snap.model.word_index),
+        })
+    _serve_roofline = _make_serve_roofline(metrics, journal)
+    mserver = None
+    if cfg.metrics_port:
+        from ..telemetry import MetricsServer
+
+        mserver = MetricsServer(
+            metrics.recorder, port=cfg.metrics_port,
+            host=cfg.metrics_host, refresh=_serve_roofline,
+        )
+        metrics.emit({
+            "stage": "serve", "event": "metrics_endpoint",
+            "port": mserver.port, "path": "/metrics",
+        })
+    try:
+        refreshes: dict = {}
+        for spec in specs:
+            every = spec.refresh_every or cfg.refresh_every
+            if every:
+                k = fleet.active(spec.tenant).model.num_topics
+                refreshes[spec.tenant] = RefreshLoop(
+                    fleet.view(spec.tenant),
+                    OnlineLDAConfig(num_topics=k),
+                    every=every,
+                    total_docs=cfg.refresh_total_docs,
+                )
+
+        def on_batch(tenant, snapshot, feats, scores):
+            # `scorer` binds at call time (defined just below): the
+            # lane's resolved threshold is the one resolution of
+            # spec-override-else-config, shared with the flagged
+            # counters.
+            for i in np.where(
+                    scores < scorer.tenant_threshold(tenant))[0]:
+                print(json.dumps({
+                    "tenant": tenant,
+                    "flagged": feats.featurized_row(int(i)),
+                    "score": float(scores[i]),
+                    "model_version": snapshot.version,
+                }), flush=True)
+            refresh = refreshes.get(tenant)
+            if refresh is not None:
+                from ..serving import event_documents
+
+                ips, words = event_documents(
+                    feats, featurizers[tenant].dsource
+                )
+                new = refresh.observe(snapshot, ips, words)
+                if new is not None:
+                    metrics.emit({
+                        "stage": "serve", "event": "model_refresh",
+                        "tenant": tenant,
+                        "model_version": new.version,
+                        "source": new.source,
+                    })
+
+        scorer = FleetScorer(
+            fleet, featurizers, cfg, metrics=metrics,
+            on_batch=on_batch, journal=journal,
+        )
+        # AOT warmup per pack group: the padded compiled batch family
+        # is shared across every tenant of a K-group, so warming the
+        # STACKED shapes once covers the whole fleet — and because
+        # hot-swaps preserve per-tenant row counts, these are the only
+        # shapes serving will ever dispatch (zero retraces after
+        # warmup, the acceptance criterion the fleet SLO bench pins).
+        warm: "list | dict"
+        try:
+            warm = []
+            for k in sorted({fleet.tenant_k(s.tenant) for s in specs}):
+                stack = fleet.stack(k)
+                mult = 2 if any(
+                    fleet.spec(t).dsource == "flow"
+                    for t in stack.tenants
+                ) else 1
+                warm.append({
+                    "k": k, "tenants": len(stack.tenants),
+                    **plans_warmup.warmup_serving(
+                        stack.model.theta.shape[0],
+                        stack.model.p.shape[0], k,
+                        scorer.max_batch * mult,
+                        cfg.device_score_min,
+                    ),
+                })
+        except Exception as e:  # warmup must never block serving
+            warm = {"error": repr(e)[:200]}
+        metrics.emit({
+            "stage": "serve", "event": "plans",
+            "knobs": scorer.plan,
+            "compilation_cache": cc_rec,
+            "warmup": warm,
+        })
+        from ..serving import AdmissionRejected
+
+        stream = sys.stdin if args.input == "-" else open(args.input)
+        submitted = rejected = header_skipped = 0
+        default_tenant = specs[0].tenant if len(specs) == 1 else None
+        first_seen: dict = {}
+        headers: dict = {}
+        try:
+            for line in stream:
+                if not line.strip():
+                    continue
+                tenant, sep, payload = line.partition("\t")
+                if sep:
+                    tenant = tenant.strip()
+                elif default_tenant is not None:
+                    tenant, payload = default_tenant, line
+                else:
+                    rejected += 1      # untagged line, ambiguous tenant
+                    continue
+                if tenant not in featurizers:
+                    rejected += 1
+                    continue
+                # Per-tenant header handling, batch-parity semantics
+                # (serve_stream): each tenant's FIRST line may be a CSV
+                # header; duplicates of it are dropped too.
+                if first_seen.get(tenant) is None:
+                    first_seen[tenant] = True
+                    if _looks_like_header(
+                            payload, featurizers[tenant].dsource):
+                        headers[tenant] = payload
+                        header_skipped += 1
+                        continue
+                if headers.get(tenant) is not None \
+                        and payload == headers[tenant]:
+                    header_skipped += 1
+                    continue
+                try:
+                    scorer.submit(tenant, payload)
+                    submitted += 1
+                except AdmissionRejected:
+                    rejected += 1      # journaled + counted per tenant
+                except ValueError:
+                    rejected += 1
+        finally:
+            if stream is not sys.stdin:
+                stream.close()
+            scorer.close()
+        metrics.emit({
+            "stage": "serve", "event": "stream_end",
+            "submitted": submitted, "rejected": rejected,
+            "header_skipped": header_skipped,
+            "events_scored": scorer.events_scored,
+            "batches": scorer.batches_flushed,
+            "tenant_stats": scorer.tenant_stats(),
+            "final_versions": {
+                s.tenant: fleet.version(s.tenant) for s in specs
+            },
+        })
+        _serve_roofline(emit_journal=True)
+        if cfg.openmetrics_path:
+            from ..telemetry import write_openmetrics
+
+            try:
+                write_openmetrics(cfg.openmetrics_path, metrics.recorder)
+            except OSError as e:
+                print(f"serve: openmetrics sink failed: {e!r}",
+                      file=sys.stderr)
+        metrics.emit({
+            "stage": "serve", "event": "registry_snapshot",
+            **metrics.snapshot(),
+        })
+        if submitted == 0 and rejected > 0:
+            # A whole stream of rejects means the FRAMING is wrong
+            # (untagged lines into a multi-tenant fleet, or tenant tags
+            # not in the manifest) — rc 0 here would let a CI smoke
+            # call "success" on zero scored events.
+            print(
+                f"serve: all {rejected} stream lines rejected — check "
+                "the '<tenant>\\t<line>' framing against the manifest "
+                "tenant ids", file=sys.stderr,
+            )
+            return 1
+        return 0 if scorer.events_scored == submitted else 1
+    finally:
+        if mserver is not None:
+            mserver.close()
+        metrics.close()
+        if journal is not None:
+            journal.close()
+
+
+def _parse_synthetic_fleet(value: str) -> "int | None":
+    """'synthetic' / 'synthetic:N' -> N (default 2); anything else is a
+    manifest path -> None."""
+    if value == "synthetic":
+        return 2
+    if value.startswith("synthetic:"):
+        try:
+            n = int(value.split(":", 1)[1])
+        except ValueError:
+            raise SystemExit(
+                f"--fleet {value!r}: N in 'synthetic:N' must be an "
+                "integer"
+            ) from None
+        if n < 2:
+            raise SystemExit("--fleet synthetic:N needs N >= 2 (the "
+                             "fleet acceptance path is cross-tenant)")
+        return n
+    return None
+
+
+def dry_run_fleet(args) -> int:
+    """Fleet acceptance path on synthetic in-memory tenants: distinct
+    per-tenant models score a tagged interleaved stream through ONE
+    FleetScorer, tenant 0 hot-swaps mid-stream, and the run verifies
+    per-tenant exactly-once delivery, cross-tenant packing (flushes
+    spanning >= 2 tenants), and swap isolation (the other tenants'
+    versions and futures are untouched).  Runnable anywhere, seconds,
+    no day directory — the fleet half of tools/serve_smoke.py."""
+    from ..serving import (
+        DnsEventFeaturizer,
+        FleetRegistry,
+        FleetScorer,
+        TenantSpec,
+    )
+
+    n_tenants = _parse_synthetic_fleet(args.fleet)
+    if n_tenants is None:
+        # A real manifest under --dry-run must not be SILENTLY replaced
+        # by the synthetic fleet — an operator smoke-testing their
+        # production manifest would get "ok" without it ever being
+        # opened.
+        raise SystemExit(
+            "--dry-run --fleet takes 'synthetic[:N]' (the dry run "
+            "builds in-memory tenants); to serve a real manifest, run "
+            "without --dry-run"
+        )
+    tenants = [f"t{i}" for i in range(n_tenants)]
+    days = {
+        t: _synthetic_day(seed=42 + i)
+        for i, t in enumerate(tenants)
+    }
+    fleet = FleetRegistry()
+    featurizers = {}
+    for t in tenants:
+        rows, model, cuts = days[t]
+        fleet.add_tenant(TenantSpec(tenant=t, dsource="dns"))
+        fleet.publish(t, model, source=f"dry-run-{t}")
+        featurizers[t] = DnsEventFeaturizer(cuts)
+    cfg = ServingConfig(
+        fleet_max_batch=(args.max_batch
+                         if args.max_batch is not None else 32),
+        fleet_max_wait_ms=(args.max_wait_ms
+                           if args.max_wait_ms is not None
+                           else ServingConfig.fleet_max_wait_ms),
+        threshold=args.threshold,
+        device_score_min=args.device_score_min,
+        metrics_path=args.metrics,
+    )
+    metrics = MetricsEmitter(path=cfg.metrics_path)
+    scorer = FleetScorer(fleet, featurizers, cfg, metrics=metrics)
+    futures: dict = {t: [] for t in tenants}
+    # First half of every tenant's day, interleaved round-robin — the
+    # packed flushes must span tenants.
+    half = {t: len(days[t][0]) // 2 for t in tenants}
+    for i in range(max(half.values())):
+        for t in tenants:
+            if i < half[t]:
+                futures[t].append(scorer.submit(t, days[t][0][i]))
+    scorer.flush()
+    first_results = {
+        t: [f.result(timeout=30.0) for f in futures[t]] for t in tenants
+    }
+    # Mid-stream hot-swap of tenant 0 ONLY, then the second half.
+    swapped = tenants[0]
+    fleet.publish(swapped, _perturbed_model(days[swapped][1]),
+                  source="dry-run-refresh")
+    for t in tenants:
+        for row in days[t][0][half[t]:]:
+            futures[t].append(scorer.submit(t, row))
+    scorer.flush()
+    results = {
+        t: [f.result(timeout=30.0) for f in futures[t]] for t in tenants
+    }
+    scorer.close()
+    versions = {t: sorted({v for _, v in results[t]}) for t in tenants}
+    packed_flushes = sum(
+        1 for r in metrics.records
+        if "tenants" in r and isinstance(r.get("tenants"), int)
+        and r["tenants"] >= 2
+    )
+    ok = (
+        all(len(results[t]) == len(days[t][0]) for t in tenants)
+        and scorer.events_scored == sum(
+            len(days[t][0]) for t in tenants
+        )
+        and packed_flushes >= 1                      # cross-tenant packing
+        and versions[swapped][-1] >= 2               # swap served traffic
+        and all(versions[t] == [1] for t in tenants[1:])  # isolation
+        and all(
+            np.isfinite(s) for t in tenants for s, _ in results[t]
+        )
+    )
+    summary = {
+        "serve_fleet_dry_run": "ok" if ok else "FAILED",
+        "tenants": n_tenants,
+        "events": sum(len(days[t][0]) for t in tenants),
+        "events_scored": scorer.events_scored,
+        "batches": scorer.batches_flushed,
+        "packed_flushes": packed_flushes,
+        "versions_served": versions,
+        "first_flush_events": sum(len(v) for v in first_results.values()),
+        "tenant_stats": scorer.tenant_stats(),
+    }
+    print(json.dumps(summary), flush=True)
+    metrics.close()
+    return 0 if ok else 1
+
+
+def _perturbed_model(model):
+    """A validly-normalized variant of `model` — the dry run's stand-in
+    for a refreshed publish (same shapes, different values, so the
+    stacked snapshot rebuilds without a retrace)."""
+    from ..scoring import ScoringModel
+
+    rng = np.random.default_rng(7)
+    theta = model.theta * rng.uniform(0.5, 1.5, model.theta.shape)
+    theta[:-1] /= theta[:-1].sum(1, keepdims=True)
+    p = model.p * rng.uniform(0.5, 1.5, model.p.shape)
+    p[:-1] /= p[:-1].sum(0, keepdims=True)
+    return ScoringModel(
+        ip_index=model.ip_index, theta=theta,
+        word_index=model.word_index, p=p,
+    )
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = build_serve_parser().parse_args(argv)
     # --no-plans binds BOTH entry paths here, once: a BatchScorer
@@ -491,7 +910,16 @@ def main(argv: "list[str] | None" = None) -> int:
     )
     with ctx:
         if args.dry_run:
+            if args.fleet:
+                return dry_run_fleet(args)
             return dry_run(args)
+        if args.fleet:
+            if _parse_synthetic_fleet(args.fleet) is not None:
+                raise SystemExit(
+                    "--fleet synthetic is a --dry-run mode; a live "
+                    "serve needs a manifest file"
+                )
+            return serve_fleet_stream(args)
         return serve_stream(args)
 
 

@@ -12,9 +12,35 @@ served as a long-running, continuously-refreshed scorer.
 
 `python -m oni_ml_tpu.runner.ml_ops serve` is the CLI front end
 (runner/serve.py); ServingConfig (config.py) holds the knobs.
+
+Multi-tenant fleet (serving/fleet.py + serving/tenants.py): the same
+stack scaled to N tenants sharing device residency and one compiled
+batch family —
+
+        -> FleetRegistry      per-tenant hot-swap registries + stacked
+                              per-K snapshots (shared residency)
+        -> FleetScorer        cross-tenant micro-batch multiplexing with
+                              bounded per-tenant admission, async demux
+                              to per-tenant ScoreFutures, and
+                              serve.<tenant>.* metrics
+
+`ml_ops serve --fleet manifest.json` is the fleet front end.
 """
 
 from .batcher import BatchScorer, ScoreFuture
+from .fleet import (
+    FleetRegistry,
+    FleetScorer,
+    StackedSnapshot,
+    demux_scores,
+    tenant_pairs,
+)
+from .tenants import (
+    AdmissionRejected,
+    TenantSpec,
+    load_manifest,
+    parse_manifest,
+)
 from .events import (
     DnsEventFeaturizer,
     FlowEventFeaturizer,
@@ -29,6 +55,15 @@ from .registry import ModelRegistry, ModelSnapshot, validate_model
 __all__ = [
     "BatchScorer",
     "ScoreFuture",
+    "FleetRegistry",
+    "FleetScorer",
+    "StackedSnapshot",
+    "demux_scores",
+    "tenant_pairs",
+    "AdmissionRejected",
+    "TenantSpec",
+    "load_manifest",
+    "parse_manifest",
     "DnsEventFeaturizer",
     "FlowEventFeaturizer",
     "event_documents",

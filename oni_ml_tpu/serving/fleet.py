@@ -1,0 +1,846 @@
+"""Multi-tenant serving fleet: shared device residency, cross-tenant
+micro-batch multiplexing, per-tenant SLO isolation.
+
+`ml_ops serve` hosted exactly one model and one stream; a production
+deployment scores many tenants/days concurrently on the same devices.
+The scarce resources are the device-resident weights and the padded
+AOT-warmed compiled-program family (plans/warmup.warmup_serving) — so
+the fleet shares THOSE while isolating everything per-tenant:
+
+`FleetRegistry`
+    N hot models with per-tenant atomic hot-swap: one
+    serving/registry.py `ModelRegistry` per tenant (validation +
+    double-buffered publish + monotonic versions, unchanged), plus a
+    *stacked snapshot* per topic-count K — every member tenant's
+    [D_t+1, K] theta and [V_t+1, K] p concatenated row-wise with
+    per-tenant base offsets.  The stack is itself double-buffered: a
+    publish rebuilds it OUTSIDE the registry lock and swaps one
+    reference, so tenant A's `RefreshLoop` publish never stalls tenant
+    B's scoring path, and because every tenant's row count is stable
+    across swaps the stacked shape — and therefore the compiled program
+    — survives every hot-swap (keyed by shape, not tenant: zero
+    retraces).
+
+`FleetScorer`
+    Cross-tenant micro-batch multiplexing into ONE compiled dispatch:
+    events from every tenant's admission queue drain globally
+    oldest-first into a shared micro-batch; each tenant segment
+    featurizes with its own day's quantile cuts, maps onto its own
+    model slice via `tenant base offset + local row` — the tenant-id
+    column driving the on-device gather — and all segments of a
+    K-group score as one `batched_scores` call at a shared padded
+    shape.  Tenants whose K diverges form their own pack group
+    (per-tenant segment dispatch), so heterogeneous fleets degrade to
+    more dispatches, never to wrong scores.  Results demux back to
+    per-tenant `ScoreFuture`s (journaled as `{"kind": "demux"}`),
+    with per-tenant `serve.<tenant>.*` histograms/counters on the
+    shared metrics plane and bounded per-tenant admission
+    (serving/tenants.py) for ingress isolation.
+
+Correctness invariant, pinned by tests/test_fleet.py: a packed
+cross-tenant flush produces bit-identical scores to scoring each
+tenant's events alone through `score_features` — packing changes WHICH
+dispatch a row rides, never its arithmetic.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import ServingConfig
+from ..scoring import ScoringModel
+from ..scoring.score import (
+    _dns_client_strings,
+    _flow_endpoint_strings,
+    batched_scores,
+    use_device_path,
+)
+from .metrics import MetricsEmitter
+from .registry import ModelRegistry, ModelSnapshot
+from .tenants import (
+    AdmissionRejected,
+    TenantLane,
+    TenantSpec,
+    _PendingEvent,
+)
+
+
+@dataclass(frozen=True)
+class StackedSnapshot:
+    """One pack group's shared-residency view: every member tenant's
+    theta/p concatenated row-wise (each slice INCLUDES its own fallback
+    row, so per-tenant fallback semantics survive packing).  Readers
+    treat every field as immutable; a publish installs a fresh instance
+    (so the device cache `scoring.score._device_model` hangs off re-
+    uploads the new weights exactly once, while in-flight flushes
+    finish on the instance — and device buffers — they started with)."""
+
+    k: int
+    tenants: tuple[str, ...]
+    model: ScoringModel            # stacked [sum(D_t+1), K] / [sum(V_t+1), K]
+    members: dict                  # tenant -> ModelSnapshot the stack was built from
+    ip_base: dict                  # tenant -> row offset into stacked theta
+    word_base: dict                # tenant -> row offset into stacked p
+    stack_version: int             # monotonic per K-group build counter
+
+    def version_of(self, tenant: str) -> int:
+        return self.members[tenant].version
+
+
+def _build_stack(k: int, tenants: "list[str]", snaps: dict,
+                 stack_version: int) -> StackedSnapshot:
+    """Concatenate member models into one stacked ScoringModel.  Pure
+    function of the member snapshots — called OUTSIDE any lock."""
+    thetas, ps = [], []
+    ip_base: dict = {}
+    word_base: dict = {}
+    ip_off = word_off = 0
+    for t in tenants:
+        m = snaps[t].model
+        ip_base[t] = ip_off
+        word_base[t] = word_off
+        thetas.append(np.asarray(m.theta, np.float64))
+        ps.append(np.asarray(m.p, np.float64))
+        ip_off += m.theta.shape[0]
+        word_off += m.p.shape[0]
+    stacked = ScoringModel(
+        ip_index={}, theta=np.concatenate(thetas),
+        word_index={}, p=np.concatenate(ps),
+    )
+    return StackedSnapshot(
+        k=k, tenants=tuple(tenants), model=stacked, members=dict(snaps),
+        ip_base=ip_base, word_base=word_base, stack_version=stack_version,
+    )
+
+
+class _TenantRegistryView:
+    """ModelRegistry facade for ONE tenant of a FleetRegistry — what a
+    per-tenant RefreshLoop binds to, so the refresh machinery works
+    unchanged while its publishes route through the fleet's stack
+    rebuild."""
+
+    def __init__(self, fleet: "FleetRegistry", tenant: str) -> None:
+        self._fleet = fleet
+        self._tenant = tenant
+
+    def publish(self, model: ScoringModel, source: str) -> ModelSnapshot:
+        return self._fleet.publish(self._tenant, model, source)
+
+    def active(self) -> ModelSnapshot:
+        return self._fleet.active(self._tenant)
+
+    def previous(self) -> "ModelSnapshot | None":
+        return self._fleet.previous(self._tenant)
+
+    @property
+    def version(self) -> int:
+        return self._fleet.version(self._tenant)
+
+
+class FleetRegistry:
+    """N per-tenant ModelRegistries + per-K stacked snapshots with
+    double-buffered installs.  `journal`/`recorder` are optional
+    telemetry hooks: every publish journals a `{"kind":
+    "fleet_publish"}` record and bumps `serve.<tenant>.publishes`."""
+
+    def __init__(self, journal=None, recorder=None) -> None:
+        self._lock = threading.Lock()
+        self._registries: dict[str, ModelRegistry] = {}
+        self._specs: dict[str, TenantSpec] = {}
+        self._order: list[str] = []
+        self._tenant_k: dict[str, int] = {}
+        self._stacks: dict[int, StackedSnapshot] = {}
+        self._stack_builds: dict[int, int] = {}
+        self._journal = getattr(journal, "journal", journal)
+        self._recorder = recorder
+
+    # -- tenant membership --------------------------------------------------
+
+    def add_tenant(self, spec: TenantSpec) -> None:
+        with self._lock:
+            if spec.tenant in self._registries:
+                raise ValueError(f"tenant {spec.tenant!r} already added")
+            self._registries[spec.tenant] = ModelRegistry()
+            self._specs[spec.tenant] = spec
+            self._order.append(spec.tenant)
+
+    def tenants(self) -> "list[str]":
+        with self._lock:
+            return list(self._order)
+
+    def spec(self, tenant: str) -> TenantSpec:
+        with self._lock:
+            return self._specs[tenant]
+
+    def view(self, tenant: str) -> _TenantRegistryView:
+        self._registry(tenant)          # raise early on unknown tenant
+        return _TenantRegistryView(self, tenant)
+
+    def _registry(self, tenant: str) -> ModelRegistry:
+        with self._lock:
+            reg = self._registries.get(tenant)
+        if reg is None:
+            raise KeyError(
+                f"unknown tenant {tenant!r} (known: {self.tenants()})"
+            )
+        return reg
+
+    # -- publish / read -----------------------------------------------------
+
+    def publish(self, tenant: str, model: ScoringModel,
+                source: str) -> ModelSnapshot:
+        """Validate and atomically promote `model` for ONE tenant, then
+        install a rebuilt stacked snapshot for its K-group.  The
+        per-tenant swap has registry.py semantics (validation failure
+        leaves the active snapshot untouched); the stack rebuild runs
+        outside the lock and never blocks another tenant's scoring."""
+        reg = self._registry(tenant)
+        snap = reg.publish(model, source)     # validates; per-tenant swap
+        k = model.theta.shape[1]
+        with self._lock:
+            old_k = self._tenant_k.get(tenant)
+            self._tenant_k[tenant] = k
+            stale = old_k if old_k is not None and old_k != k else None
+        if stale is not None:
+            self._refresh_stack(stale)
+        self._refresh_stack(k)
+        if self._journal is not None:
+            self._journal.append({
+                "kind": "fleet_publish", "tenant": tenant,
+                "version": snap.version, "source": source, "k": k,
+                "ip_rows": model.theta.shape[0],
+                "word_rows": model.p.shape[0],
+            })
+        if self._recorder is not None:
+            self._recorder.counter(f"serve.{tenant}.publishes").add(1)
+        return snap
+
+    def load_day(self, tenant: str, day_dir: str,
+                 fallback: float) -> ModelSnapshot:
+        """registry.load_day for one tenant — read the artifacts
+        through the per-tenant registry's loader, publish through the
+        fleet so the stack rebuilds."""
+        doc = ModelRegistry()
+        snap = doc.load_day(day_dir, fallback)
+        return self.publish(tenant, snap.model, source=day_dir)
+
+    def active(self, tenant: str) -> ModelSnapshot:
+        return self._registry(tenant).active()
+
+    def previous(self, tenant: str) -> "ModelSnapshot | None":
+        return self._registry(tenant).previous()
+
+    def version(self, tenant: str) -> int:
+        return self._registry(tenant).version
+
+    # -- stacked snapshots --------------------------------------------------
+
+    def tenant_k(self, tenant: str) -> int:
+        with self._lock:
+            k = self._tenant_k.get(tenant)
+        if k is None:
+            raise RuntimeError(
+                f"tenant {tenant!r} has no published model yet"
+            )
+        return k
+
+    def stack(self, k: int) -> StackedSnapshot:
+        with self._lock:
+            snap = self._stacks.get(k)
+        if snap is None:
+            raise RuntimeError(f"no stacked snapshot for K={k}")
+        return snap
+
+    def stack_for(self, tenant: str) -> StackedSnapshot:
+        return self.stack(self.tenant_k(tenant))
+
+    def _refresh_stack(self, k: int) -> None:
+        """Rebuild the K-group's stacked snapshot from the members'
+        CURRENT actives and install it — concatenation runs outside the
+        lock; the install re-checks that no member published meanwhile
+        (loop until the built stack matches the live member versions,
+        so concurrent publishes converge on a stack containing both)."""
+        while True:
+            with self._lock:
+                members = [
+                    t for t in self._order if self._tenant_k.get(t) == k
+                ]
+                regs = {t: self._registries[t] for t in members}
+            snaps = {t: regs[t].active() for t in members}
+            if not snaps:
+                with self._lock:
+                    self._stacks.pop(k, None)
+                return
+            with self._lock:
+                self._stack_builds[k] = self._stack_builds.get(k, 0) + 1
+                build = self._stack_builds[k]
+            built = _build_stack(k, members, snaps, build)
+            with self._lock:
+                live = {
+                    t: self._registries[t].version
+                    for t in members
+                    if self._tenant_k.get(t) == k
+                }
+                if live == {t: s.version for t, s in snaps.items()}:
+                    cur = self._stacks.get(k)
+                    if cur is None or cur.stack_version < build:
+                        self._stacks[k] = built
+                    return
+            # a member published while we concatenated — rebuild.
+
+
+def tenant_pairs(feats, dsource: str, model: ScoringModel,
+                 ip_base: int, word_base: int):
+    """One tenant segment's (ip_rows, word_rows) in STACKED coordinates
+    plus its pairs-per-event multiplicity: flow events contribute two
+    (endpoint, word) pairs each — src block then dst block, min-combined
+    at demux (flow_post_lda.scala:227-239) — DNS events one.  Row
+    lookups go through the tenant's OWN index maps (misses land on the
+    tenant's fallback row), then shift by the tenant's base offset into
+    the stacked matrices: the tenant-id column realized as an index
+    offset, which is what lets one compiled gather serve every tenant."""
+    n = feats.num_raw_events
+    if dsource == "flow":
+        sips, dips = _flow_endpoint_strings(feats, n)
+        ip = np.concatenate(
+            [model.ip_rows(sips), model.ip_rows(dips)]
+        ) + np.int32(ip_base)
+        w = np.concatenate(
+            [model.word_rows(feats.src_word[:n]),
+             model.word_rows(feats.dest_word[:n])]
+        ) + np.int32(word_base)
+        return ip.astype(np.int32), w.astype(np.int32), 2
+    ip = model.ip_rows(_dns_client_strings(feats, n)) + np.int32(ip_base)
+    w = model.word_rows(list(feats.word[:n])) + np.int32(word_base)
+    return ip.astype(np.int32), w.astype(np.int32), 1
+
+
+def demux_scores(scores_seg: np.ndarray, mult: int) -> np.ndarray:
+    """Per-event scores from a tenant's pair-score segment: flow
+    (mult=2) min-combines the src/dst halves, DNS passes through."""
+    if mult == 2:
+        n = scores_seg.shape[0] // 2
+        return np.minimum(scores_seg[:n], scores_seg[n:])
+    return scores_seg
+
+
+class FleetScorer:
+    """Cross-tenant micro-batching front end over a FleetRegistry.
+
+    `featurizers` maps tenant -> serving featurizer (serving/events.py
+    semantics: validate one event, featurize a list, name its dsource).
+    `on_batch(tenant, snapshot, feats, scores)` runs per tenant segment
+    after each flush — per-tenant refresh loops and flagged-event sinks
+    hang off it.  Flush triggers (`fleet_max_batch` /
+    `fleet_max_wait_ms`) resolve through the plan layer exactly like
+    the single-model scorer's serve_max_batch/serve_max_wait_ms."""
+
+    def __init__(
+        self,
+        fleet: FleetRegistry,
+        featurizers: dict,
+        config: "ServingConfig | None" = None,
+        metrics: "MetricsEmitter | None" = None,
+        on_batch=None,
+        journal=None,
+    ) -> None:
+        self.fleet = fleet
+        self.config = config or ServingConfig()
+        from ..plans import resolve
+
+        mb, mb_src = resolve("fleet_max_batch", self.config.fleet_max_batch)
+        mw, mw_src = resolve("fleet_max_wait_ms",
+                             self.config.fleet_max_wait_ms)
+        self.metrics = metrics
+        self.on_batch = on_batch
+        self._journal = getattr(journal, "journal", journal) \
+            if journal is not None \
+            else (metrics._journal if metrics is not None else None)
+        self._lanes: dict[str, TenantLane] = {}
+        for tenant in fleet.tenants():
+            spec = fleet.spec(tenant)
+            fz = featurizers.get(tenant)
+            if fz is None:
+                raise ValueError(f"no featurizer for tenant {tenant!r}")
+            if getattr(fz, "dsource", None) != spec.dsource:
+                raise ValueError(
+                    f"tenant {tenant!r} declares dsource "
+                    f"{spec.dsource!r} but its featurizer is "
+                    f"{getattr(fz, 'dsource', None)!r}"
+                )
+            self._lanes[tenant] = TenantLane(
+                spec=spec,
+                featurizer=fz,
+                queue_max=spec.queue_max or self.config.tenant_queue_max,
+                admission=spec.admission or self.config.admission,
+                threshold=(spec.threshold
+                           if spec.threshold is not None
+                           else self.config.threshold),
+            )
+        if not self._lanes:
+            raise ValueError("FleetScorer needs at least one tenant")
+        total_capacity = sum(l.queue_max for l in self._lanes.values())
+        if mb_src == "plan" and int(mb) > total_capacity:
+            # Same degradation guard as BatchScorer: a plan flush size
+            # above the fleet's total admission capacity would make the
+            # max_batch trigger unreachable (every flush silently
+            # becomes the latency timer) — fall back to the default.
+            mb, mb_src = self.config.fleet_max_batch, "default"
+        self.max_batch = int(mb)
+        self.max_wait_ms = float(mw)
+        self.plan = {
+            "max_batch": {"value": self.max_batch, "source": mb_src},
+            "max_wait_ms": {"value": self.max_wait_ms, "source": mw_src},
+        }
+        if self.max_batch < 1:
+            raise ValueError(f"fleet_max_batch ({self.max_batch}) must "
+                             "be >= 1")
+        if self.max_wait_ms <= 0:
+            raise ValueError(
+                f"fleet_max_wait_ms must be > 0, got {self.max_wait_ms}"
+            )
+        for lane in self._lanes.values():
+            if lane.queue_max < 1:
+                raise ValueError(
+                    f"tenant {lane.spec.tenant!r} queue_max must be "
+                    ">= 1"
+                )
+        if self.config.device_score_min in (0, "auto"):
+            # Pay the one-time host-vs-device calibration at
+            # construction, never inside a latency-bounded flush
+            # (BatchScorer's contract).
+            from ..scoring import dispatch_calibration
+
+            dispatch_calibration()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._force_flush = False
+        self._batch_seq = 0
+        self._events_scored = 0
+        import contextvars
+
+        ctx = contextvars.copy_context()
+        self._worker = threading.Thread(
+            target=lambda: ctx.run(self._run),
+            name="oni-fleet-scorer", daemon=True,
+        )
+        self._worker.start()
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, tenant: str, raw):
+        """Enqueue one raw event for `tenant`.  Raises ValueError on a
+        malformed event (never enqueued), KeyError on an unknown
+        tenant, RuntimeError after close().  A full tenant queue either
+        BLOCKS (admission="block" — backpressure, the stall priced into
+        `serve.<tenant>.admission_stall_s` and journaled like a
+        dataplane edge) or raises AdmissionRejected
+        (admission="reject" — load shedding, journaled as
+        `{"kind": "admission_reject"}`)."""
+        lane = self._lanes.get(tenant)
+        if lane is None:
+            raise KeyError(
+                f"unknown tenant {tenant!r} "
+                f"(known: {sorted(self._lanes)})"
+            )
+        validated = lane.featurizer.validate(raw)
+        reject_info = None
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("FleetScorer is closed")
+            if lane.full_locked() and lane.admission == "reject":
+                lane.rejected += 1
+                reject_info = (len(lane.pending), lane.queue_max)
+            else:
+                wait_ns = 0
+                t0 = None
+                while not self._closed and lane.full_locked():
+                    if t0 is None:
+                        t0 = time.perf_counter_ns()
+                    self._cond.wait()
+                if t0 is not None:
+                    wait_ns = time.perf_counter_ns() - t0
+                    lane.admission_stall_ns += wait_ns
+                if self._closed:
+                    raise RuntimeError("FleetScorer is closed")
+                p = _PendingEvent(validated, time.perf_counter())
+                lane.pending.append(p)
+                lane.submitted += 1
+                depth = len(lane.pending)
+                self._cond.notify_all()
+        if reject_info is not None:
+            depth, capacity = reject_info
+            self._journal_safe({
+                "kind": "admission_reject", "tenant": tenant,
+                "depth": depth, "capacity": capacity,
+            })
+            if self.metrics is not None:
+                self.metrics.recorder.counter(
+                    f"serve.{tenant}.admission_rejects"
+                ).add(1)
+            raise AdmissionRejected(tenant, depth, capacity)
+        if wait_ns and self.metrics is not None:
+            self.metrics.recorder.histogram(
+                f"serve.{tenant}.admission_stall_s"
+            ).observe(wait_ns / 1e9)
+        if wait_ns:
+            # The dataplane's stall-pricing record shape (channel.py
+            # _note), on the admission edge: the fleet's ingress
+            # backpressure shows up in trace_view next to every other
+            # priced stall.
+            self._journal_safe({
+                "kind": "dataplane", "event": "depth",
+                "edge": f"admit.{tenant}", "side": "put",
+                "depth": depth, "wait_s": round(wait_ns / 1e9, 6),
+            })
+        return p.future
+
+    def flush(self) -> None:
+        """Flush whatever is queued without waiting for either trigger
+        (no-op on an empty fleet queue — BatchScorer semantics)."""
+        with self._cond:
+            if any(lane.pending for lane in self._lanes.values()):
+                self._force_flush = True
+                self._cond.notify_all()
+
+    def close(self, timeout: "float | None" = None) -> bool:
+        """Drain every tenant queue, then stop the worker.  With a
+        finite timeout, an overlong drain FAILS the still-queued
+        futures and returns False instead of abandoning them."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout)
+        if not self._worker.is_alive():
+            return True
+        undrained: list = []
+        with self._cond:
+            for lane in self._lanes.values():
+                undrained.extend(lane.pending)
+                lane.pending.clear()
+        err = RuntimeError(
+            f"FleetScorer.close timed out after {timeout}s with "
+            f"{len(undrained)} events undrained"
+        )
+        for p in undrained:
+            p.future._fail(err)
+        return False
+
+    @property
+    def events_scored(self) -> int:
+        with self._cond:
+            return self._events_scored
+
+    @property
+    def batches_flushed(self) -> int:
+        with self._cond:
+            return self._batch_seq
+
+    def tenant_stats(self) -> "list[dict]":
+        with self._cond:
+            return [self._lanes[t].stats_locked()
+                    for t in sorted(self._lanes)]
+
+    def tenant_threshold(self, tenant: str) -> float:
+        """The resolved suspicion threshold for one tenant (spec
+        override, else the fleet config) — the ONE resolution, so
+        flagged-event consumers can't drift from the lane's own
+        flagged accounting."""
+        return self._lanes[tenant].threshold
+
+    # -- worker side --------------------------------------------------------
+
+    def _take_batch(self):
+        """Block until a flush trigger fires; returns (batch, trigger,
+        total_depth_after) where batch is [(tenant, _PendingEvent)]
+        drained GLOBALLY OLDEST-FIRST across tenant queues — the
+        no-head-of-line-blocking drain: a bursty tenant fills its own
+        bounded queue, but cannot delay an older event of another
+        tenant.  Empty batch means shutdown."""
+        max_wait_s = self.max_wait_ms / 1e3
+        lanes = self._lanes
+        with self._cond:
+            while not self._closed and not any(
+                    l.pending for l in lanes.values()):
+                self._cond.wait()
+            if not any(l.pending for l in lanes.values()):
+                return [], "shutdown", 0
+            trigger = "close" if self._closed else None
+            while trigger is None:
+                if self._force_flush:
+                    trigger = "flush"
+                    break
+                total = sum(len(l.pending) for l in lanes.values())
+                if total >= self.max_batch:
+                    trigger = "max_batch"
+                    break
+                oldest = min(
+                    l.pending[0].t_enqueue
+                    for l in lanes.values() if l.pending
+                )
+                waited = time.perf_counter() - oldest
+                if waited >= max_wait_s:
+                    trigger = "max_wait"
+                    break
+                self._cond.wait(max_wait_s - waited)
+                if self._closed:
+                    trigger = "close"
+            self._force_flush = False
+            # K-way merge on enqueue time via a heap of lane heads:
+            # O(batch log tenants) while holding the lock every
+            # submitter shares — a linear scan per taken event would
+            # make admission stalls scale with tenant count.
+            heads = [
+                (lane.pending[0].t_enqueue, t)
+                for t, lane in lanes.items() if lane.pending
+            ]
+            heapq.heapify(heads)
+            batch: list = []
+            while heads and len(batch) < self.max_batch:
+                _, t = heapq.heappop(heads)
+                lane = lanes[t]
+                batch.append((t, lane.pending.popleft()))
+                if lane.pending:
+                    heapq.heappush(
+                        heads, (lane.pending[0].t_enqueue, t)
+                    )
+            depth = sum(len(l.pending) for l in lanes.values())
+            self._cond.notify_all()   # release blocked submitters
+            return batch, trigger, depth
+
+    def _run(self) -> None:
+        while True:
+            batch, trigger, depth = self._take_batch()
+            if not batch:
+                return
+            try:
+                self._score_batch(batch, trigger, depth)
+            except Exception as e:
+                # The worker survives anything a batch throws; futures
+                # already resolved keep their scores, the rest fail
+                # with the cause (BatchScorer contract).
+                for _, p in batch:
+                    p.future._fail(e)
+
+    def _score_batch(self, batch, trigger: str, depth: int) -> None:
+        cfg = self.config
+        t0 = time.perf_counter()
+        # Segment the drained batch per tenant (submit order preserved
+        # inside each segment), then group tenants by topic count K:
+        # one stacked snapshot — one compiled dispatch — per group.
+        segments: dict[str, list] = {}
+        for tenant, p in batch:
+            segments.setdefault(tenant, []).append(p)
+        stacks: dict[int, StackedSnapshot] = {}
+        tenant_scores: dict[str, np.ndarray] = {}
+        failures: dict[str, Exception] = {}
+        groups: dict[int, list] = {}
+        feats_by_tenant: dict = {}
+        # Each tenant's K is read ONCE here and reused at demux/emit:
+        # a concurrent publish may change a tenant's K mid-flush, and a
+        # re-read after scoring would look up a stack this flush never
+        # grabbed (KeyError failing OTHER tenants' futures too).
+        tenant_ks: dict[str, int] = {}
+        for tenant, items in segments.items():
+            lane = self._lanes[tenant]
+            try:
+                k = self.fleet.tenant_k(tenant)
+                tenant_ks[tenant] = k
+                if k not in stacks:
+                    stacks[k] = self.fleet.stack(k)
+                feats = lane.featurizer([p.raw for p in items])
+                if feats.num_raw_events != len(items):
+                    raise RuntimeError(
+                        f"tenant {tenant!r} featurizer returned "
+                        f"{feats.num_raw_events} rows for "
+                        f"{len(items)} events"
+                    )
+                feats_by_tenant[tenant] = feats
+                groups.setdefault(k, []).append(tenant)
+            except Exception as e:
+                # Tenant-scoped failure isolation: a tenant whose
+                # featurization (or stack lookup) fails takes down ITS
+                # futures only — the rest of the flush still scores.
+                failures[tenant] = e
+        dispatches = 0
+        device_dispatches = 0
+        group_device: dict[int, bool] = {}
+        for k, group in sorted(groups.items()):
+            stack = stacks[k]
+            try:
+                parts = []
+                mults = {}
+                for tenant in group:
+                    ip, w, mult = tenant_pairs(
+                        feats_by_tenant[tenant],
+                        self._lanes[tenant].spec.dsource,
+                        stack.members[tenant].model,
+                        stack.ip_base[tenant],
+                        stack.word_base[tenant],
+                    )
+                    parts.append((tenant, ip, w))
+                    mults[tenant] = mult
+                ip_all = np.concatenate([ip for _, ip, _ in parts])
+                w_all = np.concatenate([w for _, _, w in parts])
+                # ONE dispatch for the whole K-group: every tenant's
+                # pairs ride the same padded compiled program.  The
+                # device-path decision is made on the packed PAIR
+                # count, not the flush's event count (flow events pack
+                # two pairs each, and each K group decides
+                # independently); device dispatches feed the serve
+                # roofline histograms per GROUP — exact wall, exact
+                # events — so a flush mixing device and host groups
+                # can never price host scoring as device dispatches.
+                is_device = use_device_path(
+                    len(ip_all), cfg.device_score_min
+                )
+                group_device[k] = is_device
+                t_g0 = time.perf_counter()
+                pair_scores = batched_scores(
+                    stack.model, ip_all, w_all, cfg.device_score_min
+                )
+                dispatches += 1
+                if is_device:
+                    device_dispatches += 1
+                    if self.metrics is not None:
+                        rec = self.metrics.recorder
+                        rec.histogram("serve.device_score_ms").observe(
+                            (time.perf_counter() - t_g0) * 1e3
+                        )
+                        rec.counter("serve.device_events").add(sum(
+                            feats_by_tenant[t].num_raw_events
+                            for t in group
+                        ))
+                off = 0
+                for tenant, ip, _ in parts:
+                    seg = pair_scores[off:off + len(ip)]
+                    off += len(ip)
+                    tenant_scores[tenant] = demux_scores(
+                        seg, mults[tenant]
+                    )
+            except Exception as e:
+                for tenant in group:
+                    failures.setdefault(tenant, e)
+        t1 = time.perf_counter()
+        # Demux: resolve per-tenant futures against the stack the
+        # segment actually scored on (version isolation: tenant B's
+        # futures carry B's version even while A hot-swaps).
+        flagged: dict[str, int] = {}
+        for tenant, items in segments.items():
+            if tenant in failures:
+                for p in items:
+                    p.future._fail(failures[tenant])
+                continue
+            scores = tenant_scores[tenant]
+            version = stacks[tenant_ks[tenant]].version_of(tenant)
+            for p, s in zip(items, scores):
+                p.future._resolve(float(s), version)
+            flagged[tenant] = int(
+                np.sum(scores < self._lanes[tenant].threshold)
+            )
+        t2 = time.perf_counter()
+        scored_n = sum(
+            len(items) for t, items in segments.items()
+            if t not in failures
+        )
+        with self._cond:
+            seq = self._batch_seq
+            self._batch_seq += 1
+            self._events_scored += scored_n
+            for tenant, items in segments.items():
+                if tenant in failures:
+                    continue
+                self._lanes[tenant].scored += len(items)
+                self._lanes[tenant].flagged += flagged[tenant]
+        self._journal_safe({
+            "kind": "demux", "batch": seq, "events": len(batch),
+            "tenants": len(segments), "segments": dispatches,
+            "score_ms": round((t1 - t0) * 1e3, 3),
+            "demux_ms": round((t2 - t1) * 1e3, 3),
+        })
+        # Per-tenant consumers + metrics, then the aggregate record.
+        # "device" only when at least one K-group's packed dispatch
+        # actually took the device path (metrics._count feeds the
+        # device roofline histogram off this label, flush-level records
+        # only).
+        score_s = t1 - t0
+        n = len(batch)
+        scorer_label = "device" if device_dispatches else "host"
+        for tenant, items in sorted(segments.items()):
+            if tenant in failures:
+                self._emit_safe({
+                    "stage": "serve", "tenant": tenant, "batch": seq,
+                    "events": len(items),
+                    "error": repr(failures[tenant]), "trigger": trigger,
+                })
+                continue
+            k = tenant_ks[tenant]
+            snap = stacks[k].members[tenant]
+            if self.on_batch is not None:
+                try:
+                    self.on_batch(tenant, snap, feats_by_tenant[tenant],
+                                  tenant_scores[tenant])
+                except Exception as e:
+                    # Consumer failures never take down scoring.
+                    self._emit_safe({
+                        "stage": "serve", "tenant": tenant,
+                        "batch": seq, "on_batch_error": repr(e),
+                    })
+            oldest = items[0].t_enqueue
+            self._emit_safe({
+                "stage": "serve", "tenant": tenant, "batch": seq,
+                "events": len(items), "trigger": trigger,
+                "model_version": snap.version,
+                "stack_version": stacks[k].stack_version,
+                # The tenant's OWN K-group's dispatch decision — in a
+                # mixed-K flush a host-scored tenant must not be
+                # labeled by another group's device dispatch.
+                "scorer": ("device" if group_device.get(k)
+                           else "host"),
+                "latency_ms": round((t1 - oldest) * 1e3, 3),
+                "queue_wait_ms": round((t0 - oldest) * 1e3, 3),
+                "score_ms": round(score_s * 1e3, 3),
+                "demux_ms": round((t2 - t1) * 1e3, 3),
+                "flagged": flagged[tenant],
+            })
+        oldest_all = batch[0][1].t_enqueue
+        self._emit_safe({
+            "stage": "serve", "batch": seq, "events": n,
+            "tenants": len(segments), "segments": dispatches,
+            "segments_device": device_dispatches,
+            "trigger": trigger, "scorer": scorer_label,
+            "latency_ms": round((t1 - oldest_all) * 1e3, 3),
+            "queue_wait_ms": round((t0 - oldest_all) * 1e3, 3),
+            "score_ms": round(score_s * 1e3, 3),
+            "demux_ms": round((t2 - t1) * 1e3, 3),
+            "events_per_sec": round(n / score_s, 1) if score_s else None,
+            "queue_depth": depth,
+            "flagged": sum(flagged.values()),
+        })
+
+    # -- telemetry sinks ----------------------------------------------------
+
+    def _emit_safe(self, record: dict) -> None:
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.emit(record)
+        except Exception as e:
+            import sys
+
+            print(f"fleet metrics emit failed: {e!r}", file=sys.stderr)
+
+    def _journal_safe(self, record: dict) -> None:
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(record)
+        except Exception as e:
+            import sys
+
+            print(f"fleet journal append failed: {e!r}", file=sys.stderr)

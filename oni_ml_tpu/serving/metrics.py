@@ -49,6 +49,13 @@ _HIST_FIELDS = (
 )
 
 
+def _scoped(name: str, tenant: "str | None") -> str:
+    """serve.X -> serve.<tenant>.X for tenant-scoped records."""
+    if not tenant:
+        return name
+    return f"serve.{tenant}.{name[len('serve.'):]}"
+
+
 class MetricsEmitter:
     """Thread-safe JSON-lines emitter over the shared telemetry
     registry.  `path` appends each line to a file as it is emitted
@@ -93,20 +100,40 @@ class MetricsEmitter:
             self._journal.append({"kind": "serve", **record})
 
     def _count(self, record: dict) -> None:
-        """Fold one record into the shared registry's aggregates."""
+        """Fold one record into the shared registry's aggregates.
+
+        Tenant-scoped records (the fleet scorer emits one per tenant
+        segment per flush, carrying a `tenant` field) feed a per-tenant
+        namespace — `serve.<tenant>.latency_ms`, `serve.<tenant>.events`,
+        ... — while tenant-less records (single-model serving, and the
+        fleet's per-flush aggregate) keep feeding the fleet-wide
+        `serve.*` names; routing by the field means per-tenant and
+        aggregate numbers can never double-count each other.  The
+        OpenMetrics exporter picks both namespaces up with no further
+        wiring."""
         rec = self.recorder
-        rec.counter("serve.emits").add(1)
+        tenant = record.get("tenant")
+        prefix = f"serve.{tenant}" if tenant else "serve"
+        rec.counter(f"{prefix}.emits").add(1)
         if "error" in record or "on_batch_error" in record:
-            rec.counter("serve.errors").add(1)
+            rec.counter(f"{prefix}.errors").add(1)
         for field, name in _COUNT_FIELDS:
             v = record.get(field)
             if isinstance(v, (int, float)):
-                rec.counter(name).add(int(v))
+                rec.counter(_scoped(name, tenant)).add(int(v))
         for field, name in _HIST_FIELDS:
             v = record.get(field)
             if isinstance(v, (int, float)):
-                rec.histogram(name).observe(float(v))
-        if record.get("scorer") == "device":
+                rec.histogram(_scoped(name, tenant)).observe(float(v))
+        if record.get("scorer") == "device" and not tenant \
+                and "segments" not in record:
+            # Flush-level single-model records only: the fleet's
+            # per-tenant records repeat the flush's score_ms per tenant
+            # segment, and its aggregate records (field `segments`)
+            # span host AND device pack groups — either would price
+            # host scoring as device dispatches.  The fleet scorer
+            # feeds serve.device_score_ms / serve.device_events
+            # directly, per device dispatch, with the exact group wall.
             # Device-dispatch flushes only: the serve roofline joins the
             # warmed device program's cost with THIS histogram's
             # count/sum — host-path flushes observing into it would

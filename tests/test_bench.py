@@ -283,6 +283,24 @@ def _patch_phases(bench, monkeypatch):
                        "p99_ms": 30.0, "p999_ms": 55.0},
         },
     )
+    monkeypatch.setattr(
+        bench, "bench_serving_slo_fleet",
+        lambda *a, **k: {
+            "n_tenants": 4, "mix": "poisson:1,bursty:1",
+            "n_events": 4096, "offered_eps": 4000.0,
+            "aggregate": {"sustained_eps": 3700.0, "p50_ms": 7.0,
+                          "p99_ms": 21.0, "p999_ms": 40.0,
+                          "resolved": 4096, "errors": 0},
+            "tenants": {
+                f"t{i}": {"pattern": "poisson" if i % 2 == 0
+                          else "bursty",
+                          "sustained_eps": 925.0, "p50_ms": 7.0,
+                          "p99_ms": 22.0, "p999_ms": 41.0}
+                for i in range(4)
+            },
+            "plans": {"retraces_after_warmup": 0},
+        },
+    )
 
 
 def test_bench_em_engine_pinning_smoke():
@@ -390,6 +408,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "flow_scoring",
         "scoring_e2e",
         "serving_slo",
+        "serving_slo_fleet",
         "pipeline_e2e",
         "pipeline_e2e_dns",
     }

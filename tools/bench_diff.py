@@ -33,6 +33,61 @@ import sys
 # Phases whose payloads carry an overlap_efficiency headline.
 _OVERLAP_PHASES = ("pipeline_e2e", "pipeline_e2e_dns")
 
+# Serving SLO phases: their payloads carry nested latency quantiles and
+# sustained rates whose regression DIRECTIONS differ per key —
+# sustained_eps is higher-better (events/sec), the pNN_ms quantiles are
+# lower-better (ms) — so each key compares under its own unit instead
+# of riding the phase's single headline value.  serving_slo nests per
+# arrival pattern; serving_slo_fleet nests an aggregate plus one
+# summary per tenant.
+_SERVING_PHASES = ("serving_slo", "serving_slo_fleet")
+_SERVING_KEYS = (
+    ("sustained_eps", "events/sec"),     # higher-better
+    ("p50_ms", "ms"),                    # lower-better
+    ("p99_ms", "ms"),
+    ("p999_ms", "ms"),
+)
+
+
+def _serving_groups(payload: dict) -> "dict[str, dict]":
+    """label -> latency-summary dict for every comparable group in a
+    serving SLO payload: arrival patterns (serving_slo), the fleet
+    aggregate, and each tenant (serving_slo_fleet)."""
+    groups: dict = {}
+    for pattern in ("poisson", "bursty"):
+        g = payload.get(pattern)
+        if isinstance(g, dict):
+            groups[pattern] = g
+    agg = payload.get("aggregate")
+    if isinstance(agg, dict):
+        groups["aggregate"] = agg
+    tenants = payload.get("tenants")
+    if isinstance(tenants, dict):
+        for tid in sorted(tenants):
+            if isinstance(tenants[tid], dict):
+                groups[f"tenant.{tid}"] = tenants[tid]
+    return groups
+
+
+def _serving_rows(name: str, old: dict, new: dict,
+                  threshold_pct: float) -> "list[dict]":
+    """Per-group, per-key comparison rows for one serving SLO phase
+    present in both payloads: a p99/p999 blowup gates exit 1 exactly
+    like a throughput drop, each under its own direction."""
+    rows = []
+    old_groups = _serving_groups(old)
+    new_groups = _serving_groups(new)
+    for label in sorted(set(old_groups) & set(new_groups)):
+        for key, unit in _SERVING_KEYS:
+            r = _rel_row(
+                f"{name}:{label}.{key}",
+                old_groups[label].get(key), new_groups[label].get(key),
+                unit, threshold_pct,
+            )
+            if r:
+                rows.append(r)
+    return rows
+
 
 def load_payload(path: str) -> dict:
     """A bench payload from either container: the driver's capture
@@ -121,6 +176,17 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
                      new_util.get(key), "pct", util_drop_pct)
         if r:
             rows.append(r)
+    # Serving SLO latency/throughput keys (direction per key: rates
+    # higher-better, millisecond quantiles lower-better) — from the
+    # secondary phase payloads, and from the headline payload itself
+    # when the compared run IS a serving phase capture.
+    for name in _SERVING_PHASES:
+        o, n = old_sec.get(name), new_sec.get(name)
+        if isinstance(o, dict) and isinstance(n, dict):
+            rows.extend(_serving_rows(f"phase:{name}", o, n,
+                                      threshold_pct))
+    if _serving_groups(old) and _serving_groups(new):
+        rows.extend(_serving_rows("headline", old, new, threshold_pct))
     # Streaming-dataplane overlap efficiency (absolute fraction).
     for name in _OVERLAP_PHASES:
         o, n = old_sec.get(name), new_sec.get(name)

@@ -152,6 +152,300 @@ def run_load(scorer, raws, offsets: np.ndarray, *, recorder=None,
     }
 
 
+# ---------------------------------------------------------------------------
+# multi-tenant fleet harness (bench.py serving_slo_fleet)
+# ---------------------------------------------------------------------------
+
+
+def parse_mix(mix: str) -> "list[tuple[str, float]]":
+    """``"poisson:2,bursty:1"`` -> [("poisson", 2.0), ("bursty", 1.0)]
+    — the weighted per-tenant arrival mixing directive.  A bare pattern
+    name means weight 1."""
+    out: list = []
+    for part in mix.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        if name not in PATTERNS:
+            raise ValueError(
+                f"unknown pattern {name!r} in mix {mix!r} "
+                f"(want {PATTERNS})"
+            )
+        weight = float(w) if w else 1.0
+        if weight <= 0:
+            raise ValueError(f"mix weight must be > 0 in {mix!r}")
+        out.append((name, weight))
+    if not out:
+        raise ValueError(f"empty mix {mix!r}")
+    return out
+
+
+def fleet_mix(n_tenants: int, mix: str,
+              rate_eps: float) -> "list[dict]":
+    """Assign every tenant a (pattern, weight, rate share) by cycling
+    the parsed mix: weights split the aggregate offered rate, so
+    ``--tenants 4 --mix poisson:3,bursty:1`` offers 3/8 of the load to
+    each Poisson tenant and 1/8 to each bursty one."""
+    if n_tenants < 1:
+        raise ValueError(f"n_tenants must be >= 1, got {n_tenants}")
+    pats = parse_mix(mix)
+    assigned = [pats[i % len(pats)] for i in range(n_tenants)]
+    total_w = sum(w for _, w in assigned)
+    return [
+        {"tenant": f"t{i}", "pattern": p, "weight": w,
+         "rate_eps": rate_eps * w / total_w}
+        for i, (p, w) in enumerate(assigned)
+    ]
+
+
+def _fleet_stack(tenant_mix, n_events_per_tenant: int, *,
+                 fleet_max_batch: int, fleet_max_wait_ms: float,
+                 device_score_min):
+    """N synthetic tenant days (distinct seeds -> distinct models, same
+    K -> ONE pack group / ONE compiled batch family) behind the real
+    fleet stack (FleetRegistry -> FleetScorer)."""
+    from oni_ml_tpu.config import ServingConfig
+    from oni_ml_tpu.runner.serve import _synthetic_day
+    from oni_ml_tpu.serving import (
+        DnsEventFeaturizer,
+        FleetRegistry,
+        FleetScorer,
+        TenantSpec,
+    )
+
+    fleet = FleetRegistry()
+    featurizers: dict = {}
+    rows_by_tenant: dict = {}
+    for i, tm in enumerate(tenant_mix):
+        rows, model, cuts = _synthetic_day(
+            n_events=n_events_per_tenant, n_clients=64, n_doms=16,
+            seed=100 + i,
+        )
+        fleet.add_tenant(TenantSpec(
+            tenant=tm["tenant"], dsource="dns", weight=tm["weight"],
+        ))
+        fleet.publish(tm["tenant"], model, source="load-gen-fleet")
+        featurizers[tm["tenant"]] = DnsEventFeaturizer(cuts)
+        rows_by_tenant[tm["tenant"]] = rows
+    cfg = ServingConfig(
+        fleet_max_batch=fleet_max_batch,
+        fleet_max_wait_ms=fleet_max_wait_ms,
+        device_score_min=device_score_min,
+    )
+    scorer = FleetScorer(fleet, featurizers, cfg)
+    return rows_by_tenant, fleet, scorer
+
+
+def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
+                  *, n_events: int = 4096, rate_eps: float = 4000.0,
+                  burst_len: int = 64, max_batch: int = 256,
+                  max_wait_ms: float = 10.0, device_score_min=0,
+                  seed: int = 0, recorder=None,
+                  timeout_s: float = 120.0) -> dict:
+    """The serving_slo_fleet measurement: >= `n_tenants` tenants with
+    weighted mixed Poisson/bursty arrivals multiplexed through ONE
+    FleetScorer (one shared compiled batch family), per-tenant
+    enqueue->resolved latency measured by one FIFO collector per tenant
+    (a tenant's futures resolve in its own submit order, so per-tenant
+    waits wake promptly), plus the aggregate.  The returned "plans"
+    section carries compile-trace counters around the MEASURED window —
+    after the warmup burst, a healthy fleet shows
+    retraces_after_warmup == 0: the zero-per-tenant-retrace proof the
+    acceptance criteria name."""
+    from oni_ml_tpu.plans import warmup as plans_warmup
+    from oni_ml_tpu.telemetry.spans import Recorder
+
+    tenant_mix = fleet_mix(n_tenants, mix, rate_eps)
+    n_per = max(1, n_events // n_tenants)
+    rows_by_tenant, fleet, scorer = _fleet_stack(
+        tenant_mix, n_per, fleet_max_batch=max_batch,
+        fleet_max_wait_ms=max_wait_ms,
+        device_score_min=device_score_min,
+    )
+    rec = recorder or Recorder()
+    agg_hist = rec.histogram("loadgen.fleet.latency_ms")
+    tenant_hists = {
+        tm["tenant"]: rec.histogram(
+            f"loadgen.fleet.{tm['tenant']}.latency_ms"
+        )
+        for tm in tenant_mix
+    }
+    try:
+        # Warmup burst OUTSIDE the measured window: every compiled
+        # shape the packed dispatch family needs traces here, so the
+        # timed replay measures steady-state serving, and the
+        # compile-counter delta across the replay proves zero retraces.
+        plans_warmup._ensure_listener()
+        warm_futs = []
+        for i, tm in enumerate(tenant_mix):
+            rows = rows_by_tenant[tm["tenant"]]
+            for r in rows[:max(1, min(len(rows), max_batch))]:
+                warm_futs.append(scorer.submit(tm["tenant"], r))
+        scorer.flush()
+        for f in warm_futs:
+            f.result(timeout=timeout_s)
+        counts_before = plans_warmup.compile_counts()
+        # Scope the "packed" section to the MEASURED window: the warmup
+        # burst's events/batches must not inflate scored-vs-offered
+        # cross-checks against n_events/aggregate.resolved.
+        events_before = scorer.events_scored
+        batches_before = scorer.batches_flushed
+
+        # Per-tenant schedules, merged into one globally-ordered
+        # submission timeline.
+        schedules: dict = {}
+        merged: list = []
+        for i, tm in enumerate(tenant_mix):
+            t = tm["tenant"]
+            n_t = len(rows_by_tenant[t])
+            offs = arrival_offsets(
+                tm["pattern"], n_t, tm["rate_eps"],
+                seed=seed + i, burst_len=burst_len,
+            )
+            schedules[t] = offs
+            merged.extend(
+                (float(offs[j]), t, j) for j in range(n_t)
+            )
+        merged.sort()
+        fifo = {t: [None] * len(rows_by_tenant[t]) for t in schedules}
+        done = threading.Event()
+        states = {
+            t: {"resolved": 0, "errors": 0, "t_last": None}
+            for t in schedules
+        }
+
+        def collect(tenant):
+            slots = fifo[tenant]
+            state = states[tenant]
+            hist = tenant_hists[tenant]
+            for i in range(len(slots)):
+                while slots[i] is None:
+                    if done.wait(0.0005):
+                        if slots[i] is None:
+                            return
+                        break
+                fut, t_submit = slots[i]
+                try:
+                    fut.result(timeout=timeout_s)
+                    t_now = time.perf_counter()
+                    state["t_last"] = t_now
+                    lat_ms = (t_now - t_submit) * 1e3
+                    hist.observe(lat_ms)
+                    agg_hist.observe(lat_ms)
+                    state["resolved"] += 1
+                except Exception:
+                    state["errors"] += 1
+
+        collectors = [
+            threading.Thread(target=collect, args=(t,),
+                             name=f"loadgen-fleet-{t}", daemon=True)
+            for t in schedules
+        ]
+        for c in collectors:
+            c.start()
+        t0 = time.perf_counter()
+        behind_s = 0.0
+        try:
+            for off, tenant, j in merged:
+                target = t0 + off
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                else:
+                    behind_s = max(behind_s, now - target)
+                t_submit = time.perf_counter()
+                fut = scorer.submit(tenant, rows_by_tenant[tenant][j])
+                fifo[tenant][j] = (fut, t_submit)
+            scorer.flush()
+        finally:
+            done.set()
+            for c in collectors:
+                c.join(timeout=timeout_s + 30.0)
+        counts_after = plans_warmup.compile_counts()
+        t_last_all = max(
+            (s["t_last"] for s in states.values()
+             if s["t_last"] is not None),
+            default=None,
+        )
+        wall = (t_last_all or time.perf_counter()) - t0
+        resolved = sum(s["resolved"] for s in states.values())
+        errors = sum(s["errors"] for s in states.values())
+
+        def _quant(h):
+            s = h.summary()
+            return {
+                "p50_ms": s["p50"] and round(s["p50"], 3),
+                "p99_ms": s["p99"] and round(s["p99"], 3),
+                "p999_ms": s["p999"] and round(s["p999"], 3),
+                "mean_ms": s["mean"] and round(s["mean"], 3),
+                "max_ms": s["max"] and round(s["max"], 3),
+            }
+
+        tenants_out = {}
+        for tm in tenant_mix:
+            t = tm["tenant"]
+            state = states[t]
+            span = float(schedules[t][-1]) if len(schedules[t]) else 0.0
+            t_wall = (state["t_last"] or t0) - t0
+            tenants_out[t] = {
+                "pattern": tm["pattern"],
+                "weight": tm["weight"],
+                "events": len(rows_by_tenant[t]),
+                "offered_eps": round(len(schedules[t]) / span, 1)
+                if span > 0 else None,
+                "sustained_eps": round(state["resolved"] / t_wall, 1)
+                if t_wall > 0 else None,
+                "resolved": state["resolved"],
+                "errors": state["errors"],
+                **_quant(tenant_hists[t]),
+            }
+        return {
+            "n_tenants": n_tenants,
+            "mix": mix,
+            "n_events": sum(len(r) for r in rows_by_tenant.values()),
+            "offered_eps": rate_eps,
+            "burst_len": burst_len,
+            "fleet_max_batch": scorer.max_batch,
+            "fleet_max_wait_ms": scorer.max_wait_ms,
+            "aggregate": {
+                "sustained_eps": round(resolved / wall, 1)
+                if wall > 0 else None,
+                "wall_s": round(wall, 3),
+                "resolved": resolved,
+                "errors": errors,
+                "max_sched_lag_s": round(behind_s, 3),
+                **_quant(agg_hist),
+            },
+            "tenants": tenants_out,
+            "packed": {
+                # Measured window only (warmup deltas subtracted);
+                # tenant_stats stays cumulative — its per-tenant
+                # submitted/scored include the warmup burst.
+                "batches": scorer.batches_flushed - batches_before,
+                "events_scored": scorer.events_scored - events_before,
+                "tenant_stats": scorer.tenant_stats(),
+            },
+            # The zero-retrace proof: compile requests the persistent
+            # cache could not serve DURING the measured window.  After
+            # the warmup burst every padded shape is compiled, so a
+            # healthy fleet reports 0 here — per-tenant hot paths ride
+            # one shared program family, keyed by shape, not tenant.
+            "plans": {
+                "warmup_events": len(warm_futs),
+                "counting": plans_warmup._ensure_listener(),
+                "traces_before": counts_before.get("traces"),
+                "traces_after": counts_after.get("traces"),
+                "retraces_after_warmup": (
+                    counts_after.get("traces", 0)
+                    - counts_before.get("traces", 0)
+                ),
+            },
+        }
+    finally:
+        scorer.close()
+
+
 def _stack(n_events: int, *, max_batch: int, max_wait_ms: float,
            device_score_min):
     """Synthetic day + the real serving stack over it (the dry-run
@@ -208,11 +502,21 @@ def run_slo(patterns=PATTERNS, *, n_events: int = 4096,
 
 
 def emit_lines(pattern: str, n_events: int, rate_eps: float, *,
-               burst_len: int = 64, seed: int = 0, out=sys.stdout) -> int:
+               burst_len: int = 64, seed: int = 0, out=sys.stdout,
+               tenants: int = 0,
+               tenant_ids: "list[str] | None" = None) -> int:
     """Stream mode: pace raw CSV lines to `out` under the pattern —
-    feedstock for a real `ml_ops serve` behind a pipe."""
+    feedstock for a real `ml_ops serve` behind a pipe.  With
+    `tenants=N` (or an explicit `tenant_ids` list — required to match
+    a real manifest's ids, since the synthetic default is ``t<i>``),
+    lines round-robin across the tenant ids in the fleet stream
+    framing (``<tenant>\\t<line>``) for piping into
+    `ml_ops serve --fleet`."""
     from oni_ml_tpu.runner.serve import _synthetic_day
 
+    ids = tenant_ids or (
+        [f"t{i}" for i in range(tenants)] if tenants else []
+    )
     rows, _, _ = _synthetic_day(n_events=n_events, n_clients=64,
                                 n_doms=16)
     offsets = arrival_offsets(pattern, len(rows), rate_eps, seed=seed,
@@ -223,7 +527,8 @@ def emit_lines(pattern: str, n_events: int, rate_eps: float, *,
         now = time.perf_counter()
         if target > now:
             time.sleep(target - now)
-        out.write(",".join(row) + "\n")
+        prefix = f"{ids[i % len(ids)]}\t" if ids else ""
+        out.write(prefix + ",".join(row) + "\n")
         out.flush()
     return len(rows)
 
@@ -244,6 +549,20 @@ def main(argv=None) -> int:
     ap.add_argument("--host-only", action="store_true",
                     help="pin the host scorer (skip the device "
                     "dispatch calibration)")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="fleet mode: drive N tenants with mixed "
+                    "arrivals through one FleetScorer and report "
+                    "per-tenant SLO summaries alongside the aggregate "
+                    "(0 = single-model mode)")
+    ap.add_argument("--mix", default="poisson:1,bursty:1",
+                    metavar="PAT:W,...",
+                    help="fleet arrival mix: weighted patterns cycled "
+                    "across tenants; weights split the offered rate "
+                    "(default poisson:1,bursty:1)")
+    ap.add_argument("--tenant-ids", default="", metavar="ID,ID,...",
+                    help="with --emit-lines: explicit tenant ids for "
+                    "the fleet framing, matching a real manifest "
+                    "(default: synthetic t0..tN-1 from --tenants)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--emit-lines", action="store_true",
                     help="pace raw CSV lines to stdout instead of "
@@ -255,9 +574,22 @@ def main(argv=None) -> int:
             print("load_gen: --emit-lines needs a single --pattern",
                   file=sys.stderr)
             return 2
+        ids = [t.strip() for t in args.tenant_ids.split(",")
+               if t.strip()] or None
         n = emit_lines(args.pattern, args.events, args.rate,
-                       burst_len=args.burst_len, seed=args.seed)
+                       burst_len=args.burst_len, seed=args.seed,
+                       tenants=args.tenants, tenant_ids=ids)
         print(f"load_gen: emitted {n} events", file=sys.stderr)
+        return 0
+    if args.tenants:
+        res = run_fleet_slo(
+            args.tenants, args.mix, n_events=args.events,
+            rate_eps=args.rate, burst_len=args.burst_len,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            device_score_min=None if args.host_only else 0,
+            seed=args.seed,
+        )
+        print(json.dumps(res), flush=True)
         return 0
     patterns = PATTERNS if args.pattern == "both" else (args.pattern,)
     res = run_slo(

@@ -1,13 +1,18 @@
-"""Fast serving sanity check: run `ml_ops serve --dry-run` in a clean
-subprocess (CPU pinned) and verify its summary line.
+"""Fast serving sanity check: run `ml_ops serve --dry-run` (single
+model) AND `ml_ops serve --dry-run --fleet synthetic` (2-tenant fleet)
+in clean subprocesses (CPU pinned) and verify both summary lines.
 
-The dry run exercises the whole serving stack — registry publish,
-micro-batch flush triggers, host scoring, mid-stream online-LDA refresh
-hot-swap, per-batch metrics — against a synthetic in-memory day, so
-this is the one-command check that the streaming path still works on a
-box with no chip grant and no day data.  tests/test_serving.py carries
-the same path as a tier-1 test; this wrapper is the operator/CI
-front door:
+The single dry run exercises the whole serving stack — registry
+publish, micro-batch flush triggers, host scoring, mid-stream
+online-LDA refresh hot-swap, per-batch metrics.  The fleet dry run
+exercises the multi-tenant path end to end — FleetRegistry stacked
+snapshots, cross-tenant packed flushes, per-tenant demux, and
+hot-swap isolation (tenant 0 republish leaves tenant 1's versions and
+futures untouched).  Both run against synthetic in-memory days, so
+this is the one-command check that the streaming paths still work on a
+box with no chip grant and no day data.  tests/test_serving.py /
+tests/test_fleet.py carry the same paths as tier-1 tests; this wrapper
+is the operator/CI front door:
 
     python tools/serve_smoke.py
 """
@@ -17,13 +22,19 @@ import os
 import subprocess
 import sys
 
+MODES = {
+    "single": ["serve", "--dry-run"],
+    "fleet": ["serve", "--dry-run", "--fleet", "synthetic"],
+}
+_OK_KEYS = {"single": "serve_dry_run", "fleet": "serve_fleet_dry_run"}
 
-def run_smoke(timeout_s: float = 300.0) -> dict:
+
+def run_smoke(mode: str = "single", timeout_s: float = 300.0) -> dict:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "oni_ml_tpu.runner.ml_ops",
-         "serve", "--dry-run"],
+         *MODES[mode]],
         capture_output=True, text=True, timeout=timeout_s, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
@@ -42,13 +53,19 @@ def run_smoke(timeout_s: float = 300.0) -> dict:
 
 
 def main() -> int:
-    out = run_smoke()
-    ok = (
-        out["rc"] == 0
-        and isinstance(out["summary"], dict)
-        and out["summary"].get("serve_dry_run") == "ok"
-    )
-    print(json.dumps({"serve_smoke": "ok" if ok else "FAILED", **out}))
+    out = {}
+    ok = True
+    for mode in MODES:
+        res = run_smoke(mode)
+        mode_ok = (
+            res["rc"] == 0
+            and isinstance(res["summary"], dict)
+            and res["summary"].get(_OK_KEYS[mode]) == "ok"
+        )
+        ok = ok and mode_ok
+        out[mode] = {"ok": mode_ok, **res}
+    print(json.dumps({"serve_smoke": "ok" if ok else "FAILED",
+                      "modes": out}))
     return 0 if ok else 1
 
 
