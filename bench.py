@@ -1777,6 +1777,189 @@ def phase_serving_slo_fleet():
             **res}
 
 
+# -- distributed EM (host-local shards + explicit allreduce) ------------
+
+
+def _dist_em_corpus(docs=2048, v=2048, seed=7, mean_len=48):
+    """Deterministic synthetic corpus for the distributed-EM scaling
+    run — built directly in CSR so every worker process reconstructs
+    the identical corpus from the seed (the shard plan, and therefore
+    the reduction tree, must match across the baseline and the
+    cluster run)."""
+    from oni_ml_tpu.io.corpus import Corpus
+
+    rng = np.random.default_rng(seed)
+    lengths = np.clip(rng.poisson(mean_len, docs), 4, None).astype(np.int64)
+    ptr = np.zeros(docs + 1, np.int64)
+    np.cumsum(lengths, out=ptr[1:])
+    nnz = int(ptr[-1])
+    return Corpus(
+        [f"d{i}" for i in range(docs)],
+        [f"w{i}" for i in range(v)],
+        ptr,
+        rng.integers(0, v, nnz).astype(np.int32),
+        rng.integers(1, 4, nnz).astype(np.int32),
+    )
+
+
+def run_distributed_worker(argv) -> int:
+    """`bench.py --distributed-worker PORT RANK NPROCS OUT MODE`: one
+    rank of the distributed_em phase.  MODE "dist" trains through the
+    host-local-shards + allreduce path; "plain" is the single-process
+    fused-driver baseline on the same corpus/config.  The fit runs
+    twice and the SECOND wall is reported, so both sides measure
+    steady-state execution, not tracing."""
+    port, rank, nprocs, out_path, mode = (
+        argv[0], int(argv[1]), int(argv[2]), argv[3], argv[4]
+    )
+    docs = int(argv[5]) if len(argv) > 5 else 2048
+    em_iters = int(argv[6]) if len(argv) > 6 else 6
+    if nprocs > 1:
+        from oni_ml_tpu.parallel import initialize_distributed
+
+        initialize_distributed(f"localhost:{port}", nprocs, rank)
+    from oni_ml_tpu.config import LDAConfig
+    from oni_ml_tpu.models import train_corpus
+
+    corpus = _dist_em_corpus(docs=docs)
+    cfg = LDAConfig(num_topics=10, em_max_iters=em_iters, em_tol=0.0,
+                    batch_size=512, min_bucket_len=16,
+                    checkpoint_every=0, estimate_alpha=True)
+    distributed = mode == "dist"
+    res = None
+    walls = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        res = train_corpus(corpus, cfg, distributed=distributed)
+        walls.append(time.perf_counter() - t0)
+    out = {
+        "rank": rank,
+        "mode": mode,
+        "wall_s": walls[-1],
+        "warm_wall_s": walls[0],
+        "em_iters": res.em_iters,
+        "docs": corpus.num_docs,
+        "final_ll": res.likelihoods[-1][0],
+        "allreduce": res.plan.get("allreduce"),
+        "em_shards": (res.plan.get("em_shards") or {}).get("value"),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    print(f"DIST_WORKER_OK {rank}", flush=True)
+    return 0
+
+
+def _spawn_dist_workers(workdir, nprocs, mode, timeout=300.0,
+                        docs=2048, em_iters=6):
+    """Launch the worker ranks as fresh CPU processes (the phase may
+    itself be running under a TPU-pinned env; the scaling proof is a
+    CPU cluster) and collect their result JSONs."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
+                     "ONI_ML_TPU_ESTEP")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    outs = [os.path.join(workdir, f"{mode}{r}.json") for r in range(nprocs)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distributed-worker", str(port), str(r), str(nprocs),
+             outs[r], mode, str(docs), str(em_iters)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(nprocs)
+    ]
+    logs = []
+    try:
+        for p in procs:
+            log, _ = p.communicate(timeout=timeout)
+            logs.append(log)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"distributed_em worker failed (rc={p.returncode}): "
+                f"{log[-800:]}"
+            )
+    results = []
+    for path in outs:
+        with open(path) as f:
+            results.append(json.load(f))
+    return results
+
+
+def bench_distributed_em(nprocs=2, docs=2048, em_iters=6):
+    """2-process CPU scaling run of pod-scale distributed EM
+    (models/lda.py `_train_corpus_distributed`: host-local E-step
+    shards, KV-ring suff-stats allreduce) against the single-process
+    fused-driver baseline on the identical corpus/config.
+
+    Reports per-host E-step wall, allreduce bytes + wall per EM
+    iteration, and scaling efficiency = T_1 / (P * T_P) — the numbers
+    the billion-event-day claim needs tracked per round.  CPU walls;
+    ICI-transport numbers are projections until the next TPU grant."""
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="oni_dist_em_")
+    try:
+        base = _spawn_dist_workers(workdir, 1, "plain",
+                                   docs=docs, em_iters=em_iters)[0]
+        dist = _spawn_dist_workers(workdir, nprocs, "dist",
+                                   docs=docs, em_iters=em_iters)
+    finally:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    per_host_wall = max(w["wall_s"] for w in dist)
+    iters = max(dist[0]["em_iters"], 1)
+    ar = dist[0]["allreduce"] or {}
+    ar_bytes = ar.get("bytes_out", 0) + ar.get("bytes_in", 0)
+    return {
+        "nprocs": nprocs,
+        "docs": dist[0]["docs"],
+        "em_iters": dist[0]["em_iters"],
+        "em_shards": dist[0]["em_shards"],
+        "transport": ar.get("transport"),
+        "docs_per_sec": dist[0]["docs"] * iters / per_host_wall,
+        "per_host_estep_wall_s": per_host_wall,
+        "single_proc_wall_s": base["wall_s"],
+        "single_proc_docs_per_sec": (
+            base["docs"] * max(base["em_iters"], 1) / base["wall_s"]
+        ),
+        "scaling_efficiency": base["wall_s"] / (nprocs * per_host_wall),
+        "allreduce_bytes_per_iter": ar_bytes / iters,
+        "allreduce_wall_s_per_iter": ar.get("wall_s", 0.0) / iters,
+        "allreduce_ops": ar.get("ops", 0),
+        # Rank parity is part of the phase's contract, not just the
+        # test suite's: identical reduced stats => identical ll.
+        "rank_ll_spread": float(
+            max(w["final_ll"] for w in dist)
+            - min(w["final_ll"] for w in dist)
+        ),
+    }
+
+
+def phase_distributed_em():
+    """Distributed-EM scaling: headline value is the 2-process run's
+    docs/sec; the payload carries scaling efficiency (higher-better)
+    and per-iteration allreduce bytes/wall (wall lower-better) for the
+    bench_diff direction gates."""
+    res = bench_distributed_em()
+    return {"value": round(res["docs_per_sec"], 1), "unit": "docs/sec",
+            **res}
+
+
 def phase_pipeline_e2e():
     """The reference's actual unit of work: one full day start-to-finish
     (`./ml_ops.sh YYYYMMDD flow`, ml_ops.sh:57-108), with the stage
@@ -1829,6 +2012,9 @@ PHASES = [
     ("scoring_e2e", phase_scoring_e2e, 480.0, True),
     ("serving_slo", phase_serving_slo, 480.0, True),
     ("serving_slo_fleet", phase_serving_slo_fleet, 480.0, True),
+    # CPU-cluster scaling proof: fresh JAX_PLATFORMS=cpu worker
+    # processes, so it stays runnable while the chip grant is wedged.
+    ("distributed_em", phase_distributed_em, 600.0, False),
     ("lda_em_throughput_k50_v50k", phase_k50_v50k, 720.0, True),
     ("lda_em_throughput_config4_v512k", phase_config4, 720.0, True),
     ("pipeline_e2e", phase_pipeline_e2e, 900.0, True),
@@ -1983,6 +2169,8 @@ def _bench_diff_gate(record: "_Record", base_path: str) -> int:
 def main() -> int:
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         return run_phase(sys.argv[2])
+    if len(sys.argv) >= 7 and sys.argv[1] == "--distributed-worker":
+        return run_distributed_worker(sys.argv[2:])
 
     record = _Record()
     _COMPLETED_PHASES.clear()   # tests drive main() repeatedly in-process

@@ -181,6 +181,15 @@ class LDAConfig:
     # so [K, BB, L] slab blocks never pad lanes; resolves through the
     # plan cache (knob "sparse_estep_l") when left at the default.
     sparse_min_bucket_len: int = 128
+    # Distributed EM document shard count (parallel/shard_plan.py).
+    # 0 = auto: DEFAULT_EM_SHARDS (8), grown to the next power of two
+    # covering the process count.  The shard plan — and with it the
+    # sufficient-statistics reduction tree — is derived from the corpus
+    # and THIS number, never from the process count, which is what
+    # makes a 2-rank run's coordinator artifacts byte-identical to a
+    # 1-rank run's (the reduction applies the same fixed pairwise tree
+    # either way).  ONI_ML_TPU_EM_SHARDS overrides.
+    em_shards: int = 0
 
     @property
     def k(self) -> int:

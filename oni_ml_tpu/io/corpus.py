@@ -171,6 +171,28 @@ class Corpus:
         ]
         return cls(docs, vocab, ptr, widx, cnts)
 
+    def shard(self, start: int, stop: int) -> "Corpus":
+        """Contiguous document slice [start, stop) — the distributed-EM
+        shard view (parallel/shard_plan.py).  Zero-copy: CSR arrays are
+        numpy views and the vocabulary is shared (word ids stay GLOBAL,
+        so per-shard suff-stats land in the same [V, K] layout and the
+        cross-process allreduce sums them directly).  Doc ids are
+        shard-local; callers that scatter into global buffers offset
+        `Batch.doc_index` by `start`."""
+        if not (0 <= start <= stop <= self.num_docs):
+            raise ValueError(
+                f"shard [{start}, {stop}) out of range for "
+                f"{self.num_docs} documents"
+            )
+        lo, hi = int(self.doc_ptr[start]), int(self.doc_ptr[stop])
+        return Corpus(
+            self.doc_names[start:stop],
+            self.vocab,
+            self.doc_ptr[start:stop + 1] - self.doc_ptr[start],
+            self.word_idx[lo:hi],
+            self.counts[lo:hi],
+        )
+
     def select(self, doc_indices) -> "Corpus":
         """Sub-corpus of the given documents (shared vocabulary, same
         word ids — models trained on a subset stay comparable/usable

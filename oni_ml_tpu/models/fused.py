@@ -273,49 +273,30 @@ def initial_gammas(groups_arrays, k: int, dtype, dense_wmajor=False):
     )
 
 
-class ChunkResult(NamedTuple):
-    log_beta: jax.Array
-    alpha: jax.Array
-    ll_prev: jax.Array          # scalar; nan before the first EM iteration
-    lls: jax.Array              # [chunk] likelihood per executed step
-    steps_done: jax.Array       # int32 scalar in [0, n_steps]
-    converged: jax.Array        # bool scalar
-    gammas: tuple               # per group: [NB, B, K] from the final E-step
-    vi_iters: jax.Array         # [chunk] max inner fixed-point iterations
-                                # per executed EM step (observability:
-                                # shows the var_tol early exit + warm
-                                # start collapsing the inner loop)
-
-
-def make_chunk_runner(
+def make_em_accumulator(
     *,
-    num_docs: int,
     num_topics: int,
     num_terms: int,
-    chunk: int,
     var_max_iters: int,
     var_tol: float,
-    em_tol: float,
-    estimate_alpha: bool,
     e_step_fn: Callable | None = None,
-    m_step_fn: Callable | None = None,
-    compiler_options: dict | None = None,
-    dense_wmajor: bool = False,
-    warm_start: bool = False,
     dense_e_step_fn: Callable | None = None,
+    dense_wmajor: bool = False,
     dense_precision: str = "f32",
-    alpha_max_iters: int = 100,
+    warm_start: bool = False,
 ):
-    """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
-    n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
+    """Build `accumulate(log_beta, alpha, groups, gammas_prev, warm) ->
+    (suff_stats [V, K], likelihood, alpha_ss, gammas, vi_max)` — one EM
+    iteration's E-step over stacked groups WITHOUT the M-step tail.
 
-    `n_steps` is a traced scalar, so checkpoint boundaries and the final
-    partial chunk reuse the single compiled program.
-    """
-    from .lda import update_alpha  # local import: lda.py imports this module
-
+    This is the partial-sufficient-statistics return path: the chunk
+    runner composes it with the M-step/alpha update inside one compiled
+    program (single-process EM), while the distributed driver
+    (models/lda.py `_distributed_loop`) jits it alone per document
+    shard (`make_partial_runner`), reduces the partials across
+    processes through parallel/allreduce, and only then runs the
+    identical M-step on every rank from the reduced stats."""
     e_fn = e_step_fn or estep.e_step
-    m_fn = m_step_fn or estep.m_step
     # Sparse groups warm-start only through callables that declare the
     # gamma_prev/warm kwargs (this package's e_step and its sharded
     # wrappers); a user-supplied custom e_step_fn stays fresh-start
@@ -359,7 +340,7 @@ def make_chunk_runner(
         )
         return res._replace(suff_stats=ss)
 
-    def em_iteration(log_beta, alpha, groups, gammas_prev, warm):
+    def accumulate(log_beta, alpha, groups, gammas_prev, warm):
         dtype = log_beta.dtype
         total_ss = jnp.zeros((v, k), dtype)
         total_ll = jnp.zeros((), dtype)
@@ -421,6 +402,79 @@ def make_chunk_runner(
                 (group, g_prev)
             )
             gammas.append(g)
+        return total_ss, total_ll, total_ass, tuple(gammas), vi_max
+
+    return accumulate
+
+
+def make_partial_runner(*, compiler_options: dict | None = None, **kw):
+    """The distributed driver's per-shard E-step program: one jitted
+    call of the accumulator above, emitting the partial suff-stats /
+    ELBO / alpha-ss for ONE document shard so the explicit allreduce
+    (parallel/allreduce.py) can combine them across processes between
+    the E and M steps.  `warm` is a traced scalar, so warm-start
+    toggling never retraces."""
+    acc = make_em_accumulator(**kw)
+    return jax.jit(acc, compiler_options=compiler_options)
+
+
+class ChunkResult(NamedTuple):
+    log_beta: jax.Array
+    alpha: jax.Array
+    ll_prev: jax.Array          # scalar; nan before the first EM iteration
+    lls: jax.Array              # [chunk] likelihood per executed step
+    steps_done: jax.Array       # int32 scalar in [0, n_steps]
+    converged: jax.Array        # bool scalar
+    gammas: tuple               # per group: [NB, B, K] from the final E-step
+    vi_iters: jax.Array         # [chunk] max inner fixed-point iterations
+                                # per executed EM step (observability:
+                                # shows the var_tol early exit + warm
+                                # start collapsing the inner loop)
+
+
+def make_chunk_runner(
+    *,
+    num_docs: int,
+    num_topics: int,
+    num_terms: int,
+    chunk: int,
+    var_max_iters: int,
+    var_tol: float,
+    em_tol: float,
+    estimate_alpha: bool,
+    e_step_fn: Callable | None = None,
+    m_step_fn: Callable | None = None,
+    compiler_options: dict | None = None,
+    dense_wmajor: bool = False,
+    warm_start: bool = False,
+    dense_e_step_fn: Callable | None = None,
+    dense_precision: str = "f32",
+    alpha_max_iters: int = 100,
+):
+    """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
+    n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
+
+    `n_steps` is a traced scalar, so checkpoint boundaries and the final
+    partial chunk reuse the single compiled program.
+    """
+    from .lda import update_alpha  # local import: lda.py imports this module
+
+    m_fn = m_step_fn or estep.m_step
+    k, v = num_topics, num_terms
+    # The E-step callable itself now lives inside the accumulator (the
+    # shared partial-stats path the distributed driver also jits).
+    accumulate = make_em_accumulator(
+        num_topics=num_topics, num_terms=num_terms,
+        var_max_iters=var_max_iters, var_tol=var_tol,
+        e_step_fn=e_step_fn, dense_e_step_fn=dense_e_step_fn,
+        dense_wmajor=dense_wmajor, dense_precision=dense_precision,
+        warm_start=warm_start,
+    )
+
+    def em_iteration(log_beta, alpha, groups, gammas_prev, warm):
+        total_ss, total_ll, total_ass, gammas, vi_max = accumulate(
+            log_beta, alpha, groups, gammas_prev, warm
+        )
         new_beta = m_fn(total_ss)
         new_alpha = (
             update_alpha(total_ass, alpha, num_docs, k,

@@ -243,16 +243,26 @@ class LDATrainer:
         m_step_fn: Callable | None = None,
         mesh=None,
         vocab_sharded: bool = False,
+        collective=None,
+        shard_plan=None,
+        shard_batches=None,
     ):
         """When `mesh` is set, batches are device_put ONCE with the
         data-axis layout (and beta with the vocab-sharded layout if
-        requested) — without this, every EM iteration re-shards the
-        host-committed arrays, and on multi-host meshes the computation
-        would fail outright on non-addressable devices."""
+        requested).  Since the distributed-EM restructure the mesh is
+        HOST-LOCAL only (parallel.local_mesh): cross-process training
+        runs the E-step locally per document shard and reduces the
+        sufficient statistics through `collective`
+        (parallel/allreduce.py) — `shard_plan`/`shard_batches` (shard
+        index -> that shard's batches, doc_index GLOBAL) switch fit()
+        onto the distributed driver (`_distributed_loop`)."""
         self.config = config
         self.num_terms = num_terms
         self.mesh = mesh
         self.vocab_sharded = vocab_sharded
+        self.collective = collective
+        self.shard_plan = shard_plan
+        self._shard_batches = shard_batches
         base = e_step_fn or estep.e_step
         self._e_base = base
         self._m_base = m_step_fn or estep.m_step
@@ -366,10 +376,18 @@ class LDATrainer:
             for ll_r, conv_r in likelihoods:
                 formats.append_likelihood(ll_file, ll_r, conv_r)
         ll_prev = likelihoods[-1][0] if likelihoods else None
-        self._em_chunk, self._em_sync = self._resolve_em_plan(batches)
-        loop = (
-            self._fused_loop if self._em_chunk > 1 else self._stepwise_loop
-        )
+        if self._shard_batches is not None:
+            # Distributed EM: one explicit reduce per EM iteration, so
+            # the chunk/host-sync knobs don't apply — the reduce IS the
+            # host sync.
+            self.plan_record = {}
+            loop = self._distributed_loop
+        else:
+            self._em_chunk, self._em_sync = self._resolve_em_plan(batches)
+            loop = (
+                self._fused_loop if self._em_chunk > 1
+                else self._stepwise_loop
+            )
         try:
             log_beta, alpha, it = loop(
                 batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
@@ -591,6 +609,197 @@ class LDATrainer:
             g = to_host(g, self.mesh)
             sel = b.doc_mask == 1
             gamma_out[b.doc_index[sel]] = g[sel]
+        return log_beta, alpha, it
+
+    def _distributed_loop(
+        self, batches, put, log_beta, alpha, ll_prev, start_it, num_docs,
+        likelihoods, ll_file, progress, checkpoint_path, gamma_out,
+    ):
+        """Pod-scale EM: host-local E-step per document shard, explicit
+        sufficient-statistics allreduce, identical M-step everywhere.
+
+        Each owned shard's stacked groups run through ONE jitted
+        partial-stats program (fused.make_partial_runner — the full
+        E-step, including the sparse Pallas engine over the shard's
+        bucketed layout with its per-bucket segment-sum already folded
+        into the [V, K] factor).  The per-shard partials cross
+        processes through parallel/allreduce.reduce_partials — whose
+        fixed pairwise tree over the corpus-derived shard plan makes
+        the reduced bytes identical on every rank AND invariant to the
+        rank count — and then every rank runs the same M-step, alpha
+        Newton, and float64 convergence check from the reduced stats.
+        Rank parity of the final model is ASSERTED (digest allgather),
+        not assumed."""
+        import hashlib
+
+        from ..parallel.allreduce import reduce_partials
+
+        cfg = self.config
+        k = cfg.num_topics
+        dtype = jnp.dtype(cfg.compute_dtype)
+        coll, plan = self.collective, self.shard_plan
+        owned = sorted(self._shard_batches)
+
+        put_stacked = put
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS
+
+            stacked_sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+
+            def put_stacked(x):
+                return jax.device_put(jnp.asarray(x), stacked_sh)
+
+        compiler_options = None
+        if (
+            getattr(self._e_base, "_oni_sparse_engine", False)
+            and jax.default_backend() == "tpu"
+        ):
+            from ..ops import sparse_estep
+
+            # Same scoped-VMEM forwarding the fused driver needs: XLA
+            # drops a fusion-wrapped pallas_call's own CompilerParams
+            # limit inside the jitted program.
+            kibs = [
+                sparse_estep.scoped_vmem_kib(
+                    b.word_idx.shape[0], b.word_idx.shape[1], k,
+                    getattr(self._e_base, "precision", "f32"),
+                )
+                for bs in self._shard_batches.values() for b in bs
+            ]
+            if any(kibs):
+                compiler_options = {
+                    "xla_tpu_scoped_vmem_limit_kib": str(
+                        max(filter(None, kibs))
+                    )
+                }
+        runner = fused.make_partial_runner(
+            num_topics=k, num_terms=self.num_terms,
+            var_max_iters=cfg.var_max_iters, var_tol=cfg.var_tol,
+            e_step_fn=self._e_base, warm_start=cfg.warm_start_gamma,
+            compiler_options=compiler_options,
+        )
+        shard_groups = [
+            fused.stack_batches(
+                self._shard_batches[s], np.dtype(cfg.compute_dtype),
+                put_stacked,
+            )
+            for s in owned
+        ]
+        gammas_prev = [
+            tuple(
+                put_stacked(g)
+                for g in fused.initial_gammas(sg.arrays, k, dtype)
+            )
+            for sg in shard_groups
+        ]
+        have_prev = False
+        ar0 = dict(coll.stats)
+        t_loop0 = now_ns()
+        n_reduce = 0
+        it = start_it
+        for it in range(start_it + 1, cfg.em_max_iters + 1):
+            warm = jnp.asarray(
+                1 if (have_prev and cfg.warm_start_gamma) else 0, jnp.int32
+            )
+            shard_stats = {}
+            new_gammas = []
+            for si, sg, gp in zip(owned, shard_groups, gammas_prev):
+                ss, ll, ass, gammas, _ = runner(
+                    log_beta, alpha, sg.arrays, gp, warm
+                )
+                new_gammas.append(gammas)
+                # The partial transfer is THE deliberate device sync of
+                # the distributed driver (one per shard per iteration);
+                # span it so the flight recorder prices it next to the
+                # allreduce wait instead of it hiding in iteration wall.
+                with maybe_span("em.host_sync", it=it, shard=si):
+                    shard_stats[si] = dict(zip(
+                        estep.PARTIAL_STAT_FIELDS,
+                        (np.asarray(ss), np.asarray(ll), np.asarray(ass)),
+                    ))
+            gammas_prev, have_prev = new_gammas, True
+            reduced = reduce_partials(coll, plan, shard_stats, f"em{it}")
+            n_reduce += 1
+            log_beta = self._m_step(jnp.asarray(reduced["suff_stats"]))
+            if cfg.estimate_alpha:
+                alpha = update_alpha(
+                    jnp.asarray(reduced["alpha_ss"], dtype), alpha,
+                    num_docs, k, max_iters=cfg.alpha_max_iters,
+                )
+            # reduced[...] is a HOST array (the allreduce output); the
+            # span prices the implicit alpha/beta dependency drain.
+            with maybe_span("em.host_sync", it=it):
+                ll = float(reduced["likelihood"])
+            conv = self._log_iteration(
+                it, ll, ll_prev, likelihoods, ll_file, progress
+            )
+            self._maybe_checkpoint(
+                checkpoint_path, log_beta, alpha, it, likelihoods
+            )
+            if ll_prev is not None and conv < cfg.em_tol:
+                break
+            ll_prev = ll
+
+        if current_recorder() is not None and n_reduce:
+            # The comms side of the roofline: measured allreduce bytes
+            # and wall over the whole fit ({"kind": "roofline"},
+            # cost_source "measured_comms" — interconnect traffic, so
+            # no HBM utilization fraction is claimed).
+            from ..telemetry import roofline
+
+            d = coll.stats
+            roofline.emit(
+                "em.allreduce", (now_ns() - t_loop0) / 1e9,
+                dispatches=n_reduce,
+                measured_bytes=float(
+                    d["bytes_out"] - ar0["bytes_out"]
+                    + d["bytes_in"] - ar0["bytes_in"]
+                ),
+                transport=coll.transport, nprocs=coll.num_processes,
+                allreduce_wall_s=round(d["wall_s"] - ar0["wall_s"], 6),
+            )
+
+        # Scatter owned shards' final posteriors (global doc ids), then
+        # merge across ranks: unowned rows are exact zeros, so the sum
+        # is a disjoint union whatever the combine order.
+        for si, sg, gms in zip(owned, shard_groups, gammas_prev):
+            bs = self._shard_batches[si]
+            for g_arr, slots in zip(gms, sg.batch_slots):
+                g_group = to_host(g_arr, self.mesh)
+                for j, bi in enumerate(slots):
+                    b = bs[bi]
+                    sel = b.doc_mask == 1
+                    gamma_out[b.doc_index[sel]] = g_group[j][sel]
+        if coll.num_processes > 1:
+            # Ship only the OWNED contiguous row blocks (a rank owns
+            # 1/P of the documents; gathering the full mostly-zero
+            # [D, K] from every rank would move P× the bytes) and place
+            # them by shard bounds — pure placement into disjoint
+            # ranges, no arithmetic, so the merged gamma is exact and
+            # rank-identical.
+            payload = {
+                s: gamma_out[plan.bounds[s][0]:plan.bounds[s][1]]
+                for s in owned
+            }
+            for g in coll.allgather_arrays(payload, "em_gamma"):
+                for s, rows in g.items():
+                    st, en = plan.bounds[s]
+                    gamma_out[st:en] = rows
+
+        # Rank parity: every rank derived its model from the same
+        # reduced stats; divergence (mixed configs, a nondeterministic
+        # kernel) must fail loudly here, not ship mismatched artifacts.
+        beta_host = to_host(log_beta, self.mesh)
+        digest = hashlib.sha256(beta_host.tobytes()).hexdigest()
+        digests = coll.allgather_obj(
+            (digest, float(alpha), it), "em_parity"
+        )
+        if any(d != digests[0] for d in digests):
+            raise RuntimeError(
+                f"distributed EM rank parity violated: {digests}"
+            )
         return log_beta, alpha, it
 
     def _local_batch(self, batch) -> int:
@@ -1126,7 +1335,8 @@ class LDATrainer:
 
 
 def resolve_estep_engine(
-    corpus: Corpus, config: LDAConfig, mesh=None, vocab_sharded: bool = False
+    corpus: Corpus, config: LDAConfig, mesh=None, vocab_sharded: bool = False,
+    distributed: bool = False, shard_plan=None,
 ) -> "tuple[str, str]":
     """Resolve the E-step engine FAMILY for a batch training run:
     ("sparse" | "dense", source).
@@ -1141,10 +1351,17 @@ def resolve_estep_engine(
     crossover from the plan cache (sparse_estep.engine_crossover —
     source "plan" when a persisted entry serves, "measured" when this
     run sweeps it once) on TPU, else the dense family ("default").
-    Meshes always take the dense family: the sparse engine is
-    single-process (its suff-stats scatter and layout permutation are
-    not sharded yet) and forcing it there is an error, not a silent
-    fallback."""
+
+    The sparse engine is single-process PER RANK — a mesh whose data
+    axis would shard its layout still takes the dense family, and
+    forcing sparse there is an error, not a silent fallback.  But
+    `distributed=True` (host-local E-step shards + explicit allreduce,
+    parallel/allreduce.py) IS a set of single-process programs: with no
+    local mesh the sparse engine is fully allowed, feasibility is
+    checked over every shard's bucket shapes (`shard_plan`), and the
+    crossover is consulted at the dominant LOCAL shard shape — the
+    shapes the kernel will actually see, which per-shard batching makes
+    smaller than the whole-corpus shapes."""
     env = os.environ.get("ONI_ML_TPU_ESTEP", "")
     choice = config.estep_engine
     if choice not in ("auto", "dense", "sparse"):
@@ -1158,7 +1375,10 @@ def resolve_estep_engine(
             raise ValueError(
                 "the sparse bucketed E-step engine is single-process; "
                 "meshes keep the sharded dense/sparse plans "
-                "(unset ONI_ML_TPU_ESTEP=sparse / estep_engine='sparse')"
+                "(unset ONI_ML_TPU_ESTEP=sparse / estep_engine='sparse'"
+                + (" or drop the local mesh — distributed EM runs the "
+                   "sparse engine host-locally without one)"
+                   if distributed else ")")
             )
         return "dense", "default"
     if forced_sparse and config.dense_em == "on":
@@ -1177,14 +1397,31 @@ def resolve_estep_engine(
         return "dense", "default"
     from ..ops import sparse_estep
 
-    l_len, _ = sparse_estep.resolve_layout_len(config.sparse_min_bucket_len)
+    l_len, _ = sparse_estep.resolve_layout_len(
+        config.sparse_min_bucket_len, use_plans=not distributed
+    )
     # Shapes only — the O(tokens) packing pass is deferred to
     # train_corpus's sparse branch, so a dense-winning crossover never
     # pays for (or keeps cached) padded tiles it won't train on.
-    shapes = corpus.bucket_shapes(
-        min_len=l_len, batch_cap=config.batch_size,
-        pad_multiple=sparse_estep.pad_multiple_for(config.dense_precision),
+    # Distributed runs derive them per SHARD: each shard buckets
+    # independently, so the engine must be feasible for every shard's
+    # shapes and the crossover keys on the shapes a rank actually
+    # dispatches.
+    pieces = (
+        [corpus.shard(st, en) for st, en in shard_plan.bounds]
+        if distributed and shard_plan is not None
+        else [corpus]
     )
+    shapes = [
+        s
+        for piece in pieces
+        for s in piece.bucket_shapes(
+            min_len=l_len, batch_cap=config.batch_size,
+            pad_multiple=sparse_estep.pad_multiple_for(
+                config.dense_precision
+            ),
+        )
+    ]
     if not shapes:
         return "dense", "default"
     # EVERY bucket shape must admit a block — the VMEM-worst bucket is
@@ -1212,14 +1449,28 @@ def train_corpus(
     mesh=None,
     vocab_sharded: bool = False,
     save_final: bool = True,
+    distributed: "bool | None" = None,
+    collective=None,
 ) -> LDAResult:
     """Convenience: corpus -> batches -> fit -> (optionally) reference
     output files in `out_dir`.
 
     With `mesh`, documents shard over the mesh's `data` axis (suff-stats
-    psum over ICI — the reference's MPI_Reduce, SURVEY §2.8); with
+    psum — the reference's MPI_Reduce, SURVEY §2.8); with
     `vocab_sharded` additionally, beta/suff-stats shard their vocabulary
-    axis over `model` (BASELINE.json config 4).
+    axis over `model` (BASELINE.json config 4).  Since the distributed
+    restructure the mesh must be HOST-LOCAL (parallel.local_mesh): one
+    global SPMD program spanning processes is not a thing this trainer
+    builds any more (the CPU runtime cannot execute it, and it forced
+    the sparse engine dense).
+
+    `distributed` (default: auto — `jax.process_count() > 1`) switches
+    to pod-scale EM: every rank receives the SAME full corpus, trains
+    only its document shards host-locally (parallel/shard_plan.py),
+    and the sufficient statistics cross processes through the explicit
+    allreduce (parallel/allreduce.py).  Also runnable single-process
+    (the byte-identity baseline, bench distributed_em, the MULTICHIP
+    dryrun topology plans).
 
     `save_final=False` keeps likelihood.dat streaming and checkpoint
     resume (both keyed off `out_dir`) but skips the final.* writes —
@@ -1227,6 +1478,14 @@ def train_corpus(
     sinks that overlap scoring, so the trainer must not also write
     them inline on the critical path.
     """
+    if distributed is None:
+        distributed = jax.process_count() > 1
+    if distributed:
+        return _train_corpus_distributed(
+            corpus, config, out_dir=out_dir, progress=progress,
+            mesh=mesh, vocab_sharded=vocab_sharded,
+            save_final=save_final, collective=collective,
+        )
     e_fn = m_fn = None
     num_terms = corpus.num_terms
     initial_log_beta = None
@@ -1272,44 +1531,11 @@ def train_corpus(
             pad_multiple=pad,
         )
         e_fn = sparse_estep.make_e_step_fn(precision=config.dense_precision)
+    data_size = 1
     if mesh is not None:
-        from ..parallel import sharded
-        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
-
-        if config.batch_size % mesh.shape[DATA_AXIS]:
-            # fit() re-checks per batch; failing here gives the clearer
-            # message before any batching work happens.
-            raise ValueError(
-                f"batch_size {config.batch_size} not divisible by data axis "
-                f"{mesh.shape[DATA_AXIS]}"
-            )
-        if not vocab_sharded and mesh.shape[MODEL_AXIS] > 1:
-            import warnings
-
-            warnings.warn(
-                f"mesh has model axis {mesh.shape[MODEL_AXIS]} but "
-                "vocab_sharded=False: those devices will replicate work",
-                stacklevel=2,
-            )
-        if vocab_sharded:
-            e_fn, m_fn = sharded.make_vocab_sharded_fns(mesh)
-            num_terms = sharded.pad_vocab(corpus.num_terms, mesh.shape[MODEL_AXIS])
-            if num_terms != corpus.num_terms:
-                # Pad init with LOG_ZERO columns so padded words carry ~no
-                # mass and single- vs multi-device runs agree numerically.
-                base = init_log_beta(
-                    jax.random.PRNGKey(config.seed),
-                    config.num_topics,
-                    corpus.num_terms,
-                    jnp.dtype(config.compute_dtype),
-                )
-                initial_log_beta = jnp.pad(
-                    base,
-                    ((0, 0), (0, num_terms - corpus.num_terms)),
-                    constant_values=estep.LOG_ZERO,
-                )
-        else:
-            e_fn = sharded.make_data_parallel_e_step(mesh)
+        e_fn, m_fn, num_terms, initial_log_beta, data_size = (
+            _mesh_trainer_setup(corpus, config, mesh, vocab_sharded)
+        )
 
     if sparse_layout is not None:
         # The sparse engine trains over the bucketed layout's packed
@@ -1321,7 +1547,7 @@ def train_corpus(
         batches = make_batches(
             corpus, batch_size=config.batch_size,
             min_bucket_len=config.min_bucket_len,
-            pad_multiple=mesh.shape[DATA_AXIS] if mesh is not None else 8,
+            pad_multiple=data_size if mesh is not None else 8,
         )
     trainer = LDATrainer(
         config,
@@ -1357,4 +1583,279 @@ def train_corpus(
         # multi-host: the result is identical on every process (to_host
         # gathers collectively) but only the coordinator owns the files.
         result.save(out_dir, num_terms=corpus.num_terms, include_likelihood=False)
+    return result
+
+
+def _mesh_trainer_setup(corpus: Corpus, config: LDAConfig, mesh,
+                        vocab_sharded: bool):
+    """Shared mesh-path trainer setup for train_corpus AND the
+    distributed variant (one copy of the divisibility check, the
+    idle-model-axis warning, and the e_fn/m_fn selection):
+    (e_fn, m_fn, num_terms, initial_log_beta, data_size)."""
+    from ..parallel import sharded
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    if config.batch_size % mesh.shape[DATA_AXIS]:
+        # fit() re-checks per batch; failing here gives the clearer
+        # message before any batching work happens.
+        raise ValueError(
+            f"batch_size {config.batch_size} not divisible by data axis "
+            f"{mesh.shape[DATA_AXIS]}"
+        )
+    if not vocab_sharded and mesh.shape[MODEL_AXIS] > 1:
+        import warnings
+
+        warnings.warn(
+            f"mesh has model axis {mesh.shape[MODEL_AXIS]} but "
+            "vocab_sharded=False: those devices will replicate work",
+            stacklevel=3,
+        )
+    if vocab_sharded:
+        e_fn, m_fn, num_terms, initial_log_beta = _vocab_sharded_setup(
+            corpus, config, mesh
+        )
+    else:
+        e_fn = sharded.make_data_parallel_e_step(mesh)
+        m_fn = None
+        num_terms = corpus.num_terms
+        initial_log_beta = None
+    return e_fn, m_fn, num_terms, initial_log_beta, mesh.shape[DATA_AXIS]
+
+
+def _vocab_sharded_setup(corpus: Corpus, config: LDAConfig, mesh):
+    """(e_fn, m_fn, padded num_terms, initial_log_beta) for a
+    vocab-sharded trainer: the shard_map'd E/M pair with the vocabulary
+    padded to the mesh's model axis, the init padded with LOG_ZERO
+    columns so padded words carry ~no mass and single- vs multi-device
+    runs agree numerically."""
+    from ..parallel import sharded
+    from ..parallel.mesh import MODEL_AXIS
+
+    e_fn, m_fn = sharded.make_vocab_sharded_fns(mesh)
+    num_terms = sharded.pad_vocab(corpus.num_terms, mesh.shape[MODEL_AXIS])
+    initial_log_beta = None
+    if num_terms != corpus.num_terms:
+        base = init_log_beta(
+            jax.random.PRNGKey(config.seed),
+            config.num_topics,
+            corpus.num_terms,
+            jnp.dtype(config.compute_dtype),
+        )
+        initial_log_beta = jnp.pad(
+            base,
+            ((0, 0), (0, num_terms - corpus.num_terms)),
+            constant_values=estep.LOG_ZERO,
+        )
+    return e_fn, m_fn, num_terms, initial_log_beta
+
+
+def _train_corpus_distributed(
+    corpus: Corpus,
+    config: LDAConfig,
+    out_dir: str | None = None,
+    progress: Callable[[int, float, float], None] | None = None,
+    mesh=None,
+    vocab_sharded: bool = False,
+    save_final: bool = True,
+    collective=None,
+) -> LDAResult:
+    """Pod-scale distributed EM (ROADMAP item 1): host-local E-step
+    shards + explicit sufficient-statistics allreduce.
+
+    Every rank holds the SAME full corpus (stage_corpus's shared
+    model.dat, or the in-memory corpus single-process) and the same
+    deterministic shard plan; each trains only its owned contiguous
+    document shards on its own devices — including the PR 9 sparse
+    Pallas engine over a per-shard bucketed layout — and the [V, K]
+    beta factor, alpha suff-stats, and ELBO scalar cross processes
+    through parallel/allreduce.  The M-step, alpha Newton, convergence
+    check, and likelihood journal then run identically on every rank
+    from the reduced stats (parity asserted), so the LDAResult is
+    rank-identical and the coordinator alone writes the shared files.
+
+    The engine decision is made ONCE on the coordinator (crossover
+    consulted at the local shard shapes, plan lookups per-host) and
+    broadcast, so ranks can never train under different engines."""
+    from ..parallel.allreduce import PeerFailure, get_collective
+    from ..parallel.mesh import is_local_mesh
+    from ..parallel.shard_plan import plan_shards, resolve_em_shards
+
+    coll = collective if collective is not None else get_collective()
+    if mesh is not None and not is_local_mesh(mesh):
+        raise ValueError(
+            "distributed EM is host-local: the mesh may span this "
+            "process's devices only (parallel.local_mesh()); the "
+            "cross-process reduction is the explicit suff-stats "
+            "allreduce, not a global mesh spanning processes"
+        )
+    if vocab_sharded and mesh is None:
+        raise ValueError("vocab_sharded=True requires a mesh")
+    nshards = resolve_em_shards(config.em_shards, coll.num_processes)
+    plan = plan_shards(corpus.num_docs, coll.num_processes, nshards)
+    # One engine for the whole process group: the coordinator resolves
+    # (its plan cache, its crossover measurement at the local shard
+    # shapes) and broadcasts — per-host plan caches may legally
+    # disagree, and rank-divergent engines would silently break the
+    # cross-rank-count byte-identity contract.
+    if coll.rank == 0:
+        try:
+            decision = resolve_estep_engine(
+                corpus, config, mesh=mesh, vocab_sharded=vocab_sharded,
+                distributed=True, shard_plan=plan,
+            )
+        except BaseException as e:
+            # Library-level relay (the runner's stage barrier is not in
+            # play for direct train_corpus callers): without this, a
+            # coordinator-only config error leaves every peer blocked
+            # in the broadcast for the full collective timeout with a
+            # misleading "peer stalled or died" message.
+            coll.fail(f"estep engine resolution: {e!r}")
+            raise
+    else:
+        decision = None
+    engine, engine_src = coll.broadcast_obj(decision, "estep_engine")
+
+    e_fn = m_fn = None
+    num_terms = corpus.num_terms
+    initial_log_beta = None
+    sparse_l_record = None
+    owned = plan.owned(coll.rank)
+    shard_corpora = {s: corpus.shard(*plan.bounds[s]) for s in owned}
+    data_size = 1
+    if mesh is not None:
+        e_fn, m_fn, num_terms, initial_log_beta, data_size = (
+            _mesh_trainer_setup(corpus, config, mesh, vocab_sharded)
+        )
+
+    if engine == "sparse":
+        from ..ops import sparse_estep
+
+        # ALWAYS plans-off in distributed mode (matching the engine
+        # resolution's use_plans=not distributed): a measured
+        # sparse_estep_l serving only at some rank counts would give
+        # the 1-rank and N-rank runs different bucketed layouts —
+        # breaking the byte-identical-artifacts contract — and train at
+        # a different L than the coordinator's feasibility/crossover
+        # checks keyed on.
+        sparse_l, sparse_l_src = sparse_estep.resolve_layout_len(
+            config.sparse_min_bucket_len, use_plans=False,
+        )
+        sparse_l_record = {"value": sparse_l, "source": sparse_l_src}
+        pad = sparse_estep.pad_multiple_for(config.dense_precision)
+        # Feasibility over EVERY shard of the GLOBAL plan (not just the
+        # owned ones): the engine decision must be a function of the
+        # plan alone so every rank count trains the same shards the
+        # same way; a forced-sparse corpus whose shard shapes cannot
+        # block fails HERE with the shapes named.
+        bad = [
+            (bb, ll)
+            for st, en in plan.bounds
+            for bb, ll, _ in corpus.shard(st, en).bucket_shapes(
+                min_len=sparse_l, batch_cap=config.batch_size,
+                pad_multiple=pad,
+            )
+            if sparse_estep.pick_block(
+                bb, ll, config.num_topics, config.dense_precision
+            ) is None
+        ]
+        if bad:
+            raise ValueError(
+                f"sparse E-step engine selected but shard bucket shapes "
+                f"{bad} admit no VMEM-feasible doc block at precision "
+                f"{config.dense_precision!r} (K={config.num_topics}); "
+                "use the dense family for this corpus"
+            )
+        e_fn = sparse_estep.make_e_step_fn(precision=config.dense_precision)
+        shard_batches = {
+            s: [
+                Batch(b.word_idx, b.counts,
+                      b.doc_index + plan.bounds[s][0], b.doc_mask)
+                for b in sc.bucketed_layout(
+                    min_len=sparse_l, batch_cap=config.batch_size,
+                    pad_multiple=pad,
+                ).batches
+            ]
+            for s, sc in shard_corpora.items()
+        }
+    else:
+        shard_batches = {
+            s: [
+                Batch(b.word_idx, b.counts,
+                      b.doc_index + plan.bounds[s][0], b.doc_mask)
+                for b in make_batches(
+                    sc, batch_size=config.batch_size,
+                    min_bucket_len=config.min_bucket_len,
+                    pad_multiple=data_size if mesh is not None else 8,
+                )
+            ]
+            for s, sc in shard_corpora.items()
+        }
+
+    trainer = LDATrainer(
+        config,
+        num_terms=num_terms,
+        e_step_fn=e_fn,
+        m_step_fn=m_fn,
+        mesh=mesh,
+        vocab_sharded=vocab_sharded,
+        collective=coll,
+        shard_plan=plan,
+        shard_batches=shard_batches,
+    )
+    ll_path = os.path.join(out_dir, "likelihood.dat") if out_dir else None
+    ckpt_path = (
+        os.path.join(out_dir, "checkpoint.npz")
+        if out_dir and config.checkpoint_every
+        else None
+    )
+    rec = current_recorder()
+    if rec is not None:
+        # The journaled shard plan: enough to reconstruct the exact
+        # split this run trained under ({"kind": "shard_plan"}).
+        rec.journal_record(plan.record(coll.rank))
+    ar0 = dict(coll.stats)
+    flat = [b for s in sorted(shard_batches) for b in shard_batches[s]]
+    try:
+        result = trainer.fit(
+            flat,
+            corpus.num_docs,
+            likelihood_file=ll_path,
+            progress=progress,
+            initial_log_beta=initial_log_beta,
+            checkpoint_path=ckpt_path,
+        )
+    except PeerFailure:
+        raise          # already relayed by whoever actually failed
+    except BaseException as e:
+        # Same library-level relay for mid-fit failures (a rank's OOM
+        # or IO error): peers stuck in the next iteration's allreduce
+        # see the key within one poll slice instead of the timeout.
+        coll.fail(f"distributed fit rank {coll.rank}: {e!r}")
+        raise
+    result.plan["estep_engine"] = {"value": engine, "source": engine_src}
+    if sparse_l_record is not None:
+        result.plan["sparse_estep_l"] = sparse_l_record
+    # Provenance mirrors resolve_em_shards' precedence: env beats
+    # config beats the auto default.
+    result.plan["em_shards"] = {
+        "value": plan.num_shards,
+        "source": (
+            "env" if os.environ.get("ONI_ML_TPU_EM_SHARDS")
+            else "config" if config.em_shards else "default"
+        ),
+    }
+    d = coll.stats
+    result.plan["allreduce"] = {
+        "transport": coll.transport,
+        "nprocs": coll.num_processes,
+        "ops": d["ops"] - ar0["ops"],
+        "bytes_out": d["bytes_out"] - ar0["bytes_out"],
+        "bytes_in": d["bytes_in"] - ar0["bytes_in"],
+        "wall_s": round(d["wall_s"] - ar0["wall_s"], 6),
+    }
+    if num_terms != corpus.num_terms:
+        result.log_beta = result.log_beta[:, : corpus.num_terms]
+    if out_dir and save_final and _is_coordinator():
+        result.save(out_dir, num_terms=corpus.num_terms,
+                    include_likelihood=False)
     return result

@@ -135,6 +135,8 @@ class OnlineLDATrainer:
         e_step_fn: Callable | None = None,
         mesh=None,
         checkpoint_path: str | None = None,
+        collective=None,
+        distributed: "bool | None" = None,
     ):
         self.config = config
         self.num_terms = num_terms
@@ -144,6 +146,30 @@ class OnlineLDATrainer:
         self.step_count = 0
         self.history: list[StreamStepInfo] = []
         dtype = jnp.dtype(config.compute_dtype)
+
+        # Distributed streaming (parallel/allreduce.py): each rank runs
+        # the local E-step on its contiguous row slice of EVERY
+        # micro-batch and the suff-stats allreduce feeds the identical
+        # natural-gradient blend on every rank — the host-local
+        # restructure of the old global-mesh data sharding, which the
+        # CPU runtime could not execute at all.  lambda stays
+        # rank-identical (asserted by the multihost suite).
+        if distributed is None:
+            distributed = jax.process_count() > 1
+        self._coll = None
+        if distributed:
+            from ..parallel.allreduce import get_collective
+            from ..parallel.mesh import is_local_mesh
+
+            if mesh is not None and not is_local_mesh(mesh):
+                raise ValueError(
+                    "distributed streaming LDA is host-local: the mesh "
+                    "may span this process's devices only "
+                    "(parallel.local_mesh())"
+                )
+            self._coll = (
+                collective if collective is not None else get_collective()
+            )
 
         if mesh is not None and e_step_fn is None:
             from ..parallel.mesh import MODEL_AXIS
@@ -416,8 +442,94 @@ class OnlineLDATrainer:
         sh = batch_sharding(self.mesh)
         return tuple(jax.device_put(a, sh) for a in arrays)
 
+    def _get_update_dist(self, b: int, l: int):
+        """The distributed split of `_get_update`: a jitted local
+        partial program (this rank's row slice -> suff-stats + ELBO)
+        and a jitted blend program consuming the REDUCED stats — the
+        explicit allreduce runs on the host between them, so the
+        natural-gradient update is computed identically on every rank
+        from identical inputs."""
+        key = ("dist", b, l)
+        got = self._cache_get(key)
+        if got is not None:
+            return got
+        cfg = self.config
+        total_docs = self.total_docs
+        e_fn, compiler_options = self._make_e_fn(b)
+
+        def local_part(lam, word_idx, counts, doc_mask):
+            res = e_fn(expected_log_beta(lam), self._alpha, word_idx,
+                       counts, doc_mask)
+            return res.suff_stats, res.likelihood
+
+        def blend(lam, rho, ss, batch_docs):
+            lam_hat = cfg.eta + (total_docs / batch_docs) * ss.T
+            return (1.0 - rho) * lam + rho * lam_hat
+
+        pair = (
+            jax.jit(local_part, compiler_options=compiler_options),
+            jax.jit(blend, donate_argnums=(0,)),
+        )
+        return self._cache_update(key, pair)
+
+    def _step_distributed(self, batch: Batch) -> StreamStepInfo:
+        """One update with the micro-batch row-split across ranks and
+        the suff-stats crossing processes through the collective.
+        `batch_docs` stays the GLOBAL real-doc count (each rank sees
+        the full batch host-side; only the device work splits), so the
+        update equals the single-process step up to reduction order."""
+        from ..parallel.allreduce import tree_combine
+
+        cfg = self.config
+        coll = self._coll
+        p, r = coll.num_processes, coll.rank
+        b, l = batch.word_idx.shape
+        if b % p:
+            raise ValueError(
+                f"micro-batch of {b} docs not divisible by {p} "
+                "processes (make_batches pad_multiple must cover the "
+                "process count)"
+            )
+        t = self.step_count
+        rho = float((cfg.tau0 + t) ** (-cfg.kappa))
+        dtype = jnp.dtype(cfg.compute_dtype)
+        lo, hi = r * b // p, (r + 1) * b // p
+        if self.mesh is not None:
+            # The PER-RANK slice is what the host-local mesh shards.
+            self._check_data_divisible(hi - lo)
+        part_prog, blend_prog = self._get_update_dist(hi - lo, l)
+        ss, ll = part_prog(
+            self._lam,
+            jnp.asarray(batch.word_idx[lo:hi]),
+            jnp.asarray(batch.counts[lo:hi], dtype),
+            jnp.asarray(batch.doc_mask[lo:hi], dtype),
+        )
+        reduced = tree_combine(coll.allgather_arrays(
+            {"suff_stats": np.asarray(ss), "likelihood": np.asarray(ll)},
+            f"svi{t}",
+        ))
+        self._lam = blend_prog(
+            self._lam,
+            jnp.asarray(rho, dtype),
+            jnp.asarray(reduced["suff_stats"], dtype),
+            jnp.asarray(max(float(batch.doc_mask.sum()), 1.0), dtype),
+        )
+        self.step_count += 1
+        info = StreamStepInfo(
+            step=self.step_count,
+            rho=rho,
+            batch_docs=int(batch.doc_mask.sum()),
+            likelihood=jnp.asarray(reduced["likelihood"], dtype),
+            tokens=int(batch.counts.sum()),
+        )
+        self.history.append(info)
+        self._maybe_stream_checkpoint(prev_count=self.step_count - 1)
+        return info
+
     def step(self, batch: Batch) -> StreamStepInfo:
         """One natural-gradient update from one micro-batch."""
+        if self._coll is not None and self._coll.num_processes > 1:
+            return self._step_distributed(batch)
         cfg = self.config
         t = self.step_count
         rho = float((cfg.tau0 + t) ** (-cfg.kappa))
@@ -531,6 +643,11 @@ class OnlineLDATrainer:
         applied to each micro-batch in sequence (modulo the rho
         schedule's f32 evaluation); only the dispatch granularity and
         checkpoint timing coarsen."""
+        if self._coll is not None and self._coll.num_processes > 1:
+            # Chunked device-resident scans cannot host-reduce between
+            # steps; distributed streams take the per-step path (the
+            # allreduce IS the per-step host boundary).
+            return [self.step(b) for b in batches]
         if chunk < 2:
             return [self.step(b) for b in batches]
         infos: list[StreamStepInfo] = []
@@ -673,10 +790,19 @@ def train_corpus_online(
     """
     from ..io import make_batches
 
+    # Distributed streams row-split every micro-batch across ranks, so
+    # the batch axis must divide by the process count AND each rank's
+    # row slice must still divide by the (host-local) mesh's data axis
+    # — i.e. pad to a multiple of base_pad * nproc, not merely their
+    # rounding (ceil(base/nproc)*nproc would hand shard_map an uneven
+    # per-rank slice on tail batches).
+    nproc = jax.process_count()
+    base_pad = mesh.shape["data"] if mesh is not None else 8
+    pad = base_pad if nproc <= 1 else base_pad * nproc
     batches = make_batches(
         corpus, batch_size=config.batch_size,
         min_bucket_len=config.min_bucket_len,
-        pad_multiple=(mesh.shape["data"] if mesh is not None else 8),
+        pad_multiple=pad,
     )
     ckpt_path = (
         os.path.join(out_dir, "checkpoint.npz")

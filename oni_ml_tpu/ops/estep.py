@@ -52,6 +52,19 @@ class EStepResult(NamedTuple):
     vi_iters: jnp.ndarray     # scalar: fixed-point iterations used
 
 
+# The fields of an EStepResult that are PARTIAL sufficient statistics:
+# additive across document subsets, so per-shard/per-rank results
+# combine into the global result by summation alone (gamma is per-doc
+# state and vi_iters a max — neither reduces by sum).  This is the
+# payload contract of the distributed suff-stats allreduce — the named
+# arrays models/lda.py's _distributed_loop hands parallel/allreduce:
+# word-topic counts for the M-step, the ELBO for the convergence
+# check, and the E[log theta] total for the alpha Newton.  The order
+# matches fused.make_partial_runner's return tuple (suff, ll, ass,
+# gammas, vi) with the non-reducible tail dropped.
+PARTIAL_STAT_FIELDS = ("suff_stats", "likelihood", "alpha_ss")
+
+
 def e_log_dirichlet(param: jnp.ndarray) -> jnp.ndarray:
     """Dirichlet expectation E_q[log x] = digamma(p_i) - digamma(sum p)
     over the last axis.  Used for both E[log theta] (gamma rows) and the

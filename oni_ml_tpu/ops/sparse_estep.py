@@ -176,14 +176,23 @@ def pad_multiple_for(precision: str = "f32") -> int:
     return 16 if precision == "bf16" else 8
 
 
-def resolve_layout_len(config_value=None) -> "tuple[int, str]":
+def resolve_layout_len(config_value=None,
+                       use_plans: bool = True) -> "tuple[int, str]":
     """The bucketed layout's minimum packed tile length (the lane-tile
     floor `Corpus.bucketed_layout` pads buckets up to), resolved
     through the plans cache: knob `sparse_estep_l`, default from
-    LDAConfig.sparse_min_bucket_len.  Returns (length, source)."""
+    LDAConfig.sparse_min_bucket_len.  Returns (length, source).
+
+    `use_plans=False` resolves from config/default only — multi-process
+    distributed EM runs pin it (models/lda.py): per-host plan caches
+    can legally hold different measured winners, and a rank-divergent
+    bucket floor would give ranks different per-shard batch shapes
+    than the 1-rank run, breaking the byte-identical-artifacts
+    contract."""
     from ..plans import resolve
 
-    val, src = resolve("sparse_estep_l", config_value)
+    kw = {} if use_plans else {"store": None}
+    val, src = resolve("sparse_estep_l", config_value, **kw)
     return max(1, int(val)), src
 
 

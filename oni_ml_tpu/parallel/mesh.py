@@ -52,6 +52,24 @@ def make_mesh(data: int = -1, model: int = 1, devices=None) -> Mesh:
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
+def local_mesh(data: int = -1, model: int = 1) -> Mesh:
+    """The (data, model) mesh over THIS process's devices only — the
+    host-local training mesh of distributed EM (parallel/allreduce.py):
+    each rank runs its E-step shards over its own devices and the
+    cross-process reduction is an explicit collective, never a global
+    mesh spanning processes (which the CPU runtime cannot execute and
+    which forced the sparse engine dense).  data=-1 means all local
+    devices."""
+    return make_mesh(data=data, model=model, devices=jax.local_devices())
+
+
+def is_local_mesh(mesh: Mesh) -> bool:
+    """True when every device of `mesh` belongs to this process — the
+    only meshes the distributed host-local trainers accept."""
+    pid = jax.process_index()
+    return all(d.process_index == pid for d in mesh.devices.flat)
+
+
 def mesh_from_spec(spec: str) -> tuple[Mesh, bool]:
     """Parse a "DATA,MODEL" mesh spec (CLI flag / env var) into a mesh
     plus whether the vocabulary should shard (model axis > 1)."""

@@ -19,6 +19,17 @@ Two execution plans, both SPMD over the (data, model) mesh:
 
 Both plans compose: a (8, 4) mesh runs 8-way document parallelism with
 4-way vocabulary sharding.
+
+Scope since the distributed-EM restructure: these shard_map plans are
+HOST-LOCAL — the mesh spans one process's devices
+(`parallel.local_mesh`), and their psums ride that host's ICI only.
+Cross-PROCESS reduction is no longer expressed here at all: one
+global-mesh SPMD program spanning processes is unexecutable on the CPU
+runtime and forced the sparse engine dense, so the process dimension
+moved to the explicit sufficient-statistics allreduce
+(`parallel/allreduce.py`) over corpus-derived document shards
+(`parallel/shard_plan.py`).  A multi-host run composes the two layers:
+shard_map within the host, collective across hosts.
 """
 
 from __future__ import annotations

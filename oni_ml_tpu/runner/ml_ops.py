@@ -208,38 +208,41 @@ def _score_wanted(ctx: RunContext) -> bool:
 def _coord_decision(value: bool) -> bool:
     """Make a per-stage decision on the coordinator and broadcast it, so
     ranks can never desync on filesystem state (a rank skipping a stage
-    whose collectives the others entered would deadlock the mesh).  The
+    the others run would starve their suff-stats allreduce).  The
     broadcast doubles as the inter-stage barrier: non-coordinators wait
-    here until the coordinator has finished the previous stage's writes."""
+    here until the coordinator has finished the previous stage's writes.
+
+    Rides the coordination client's KV store (parallel/allreduce.py) —
+    NOT an XLA collective, which the CPU runtime cannot execute across
+    processes (the old multihost_utils broadcast was exactly that, and
+    the root of the suite's XlaRuntimeError)."""
     import jax
 
     if jax.process_count() == 1:
         return value
-    import numpy as np
-    from jax.experimental import multihost_utils
+    from ..parallel.allreduce import get_collective
 
-    out = multihost_utils.broadcast_one_to_all(
-        np.asarray([1.0 if value else 0.0], np.float32)
-    )
-    return bool(out[0] > 0.5)
+    return bool(get_collective().broadcast_obj(
+        bool(value), "stage_decision"
+    ))
 
 
 def _all_ranks_ok(ok: bool) -> bool:
     """All-gather per-rank outcome flags; True only if EVERY rank
     succeeded.  Unlike a one-to-all broadcast this also relays
     non-coordinator failures (e.g. a rank whose shared-FS read raised
-    before it entered the stage's collectives)."""
+    before it entered the stage's collectives).  KV-store allgather —
+    the wait polls the failure key, so a rank that already posted a
+    structured failure surfaces as PeerFailure here rather than a
+    barrier timeout."""
     import jax
 
     if jax.process_count() == 1:
         return ok
-    import numpy as np
-    from jax.experimental import multihost_utils
+    from ..parallel.allreduce import get_collective
 
-    flags = multihost_utils.process_allgather(
-        np.asarray([1.0 if ok else 0.0], np.float32)
-    )
-    return bool(np.min(flags) > 0.5)
+    flags = get_collective().allgather_obj(bool(ok), "stage_outcome")
+    return all(flags)
 
 
 def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
@@ -1229,11 +1232,25 @@ def run_pipeline(
     # Multi-host contract (--multihost): every rank runs run_pipeline
     # against a SHARED day dir.  Host-only stages (pre/corpus/score) and
     # all file writes execute on the coordinator alone; stage_lda runs
-    # on every rank (its training collectives span the mesh).  Stage
-    # skip/run decisions broadcast from the coordinator so ranks cannot
-    # desync on filesystem state.
+    # on every rank — each trains its document shards HOST-LOCALLY and
+    # the sufficient statistics cross processes through the explicit
+    # allreduce (parallel/allreduce.py), never a global mesh spanning
+    # processes.  Stage skip/run decisions broadcast from the
+    # coordinator (KV store) so ranks cannot desync on filesystem
+    # state.
     multiproc = jax.process_count() > 1
     is_coord = jax.process_index() == 0
+    if multiproc and mesh is not None:
+        from ..parallel.mesh import is_local_mesh
+
+        if not is_local_mesh(mesh):
+            raise ValueError(
+                "multi-process runs take a HOST-LOCAL mesh only "
+                "(parallel.local_mesh(); --mesh under --multihost is "
+                "interpreted per host): distributed EM shards documents "
+                "across processes and allreduces the suff-stats "
+                "explicitly instead of building one global SPMD program"
+            )
     wanted = stages or STAGE_ORDER
     ctx.wanted = list(wanted)
     if not dp.checkpoints and multiproc:
@@ -1487,12 +1504,23 @@ def _run_stages(ctx: RunContext, wanted, force: bool, multiproc: bool,
             except Exception as e:  # relayed to the other ranks below
                 err = e
         if multiproc:
+            if err is not None:
+                # Structured failure relay (parallel/allreduce.py): the
+                # failure key unblocks peers stuck INSIDE the stage's
+                # suff-stats allreduce (their waits poll it between
+                # slices) as well as peers already at the outcome
+                # barrier below — they raise PeerFailure ("failed on
+                # another rank"), a BackendLost subclass, so ml_ops
+                # exits rc=3 with the structured payload instead of a
+                # raw traceback.
+                from ..parallel.allreduce import get_collective
+
+                get_collective().fail(f"stage {stage.value}: {err!r}")
             # Outcome barrier: a stage failure on ANY rank must fail
             # every rank — otherwise the survivors block forever in the
-            # next decision broadcast.  Ranks stuck inside the failed
-            # stage's own collectives are instead unblocked by the
-            # jax.distributed coordination-service heartbeat once the
-            # failed rank's process exits (covered by
+            # next decision broadcast.  A rank that dies WITHOUT posting
+            # (SIGKILL) surfaces on its peers as a bounded PeerFailure
+            # timeout in the collective wait (covered by
             # tests/test_multihost.py's failure-injection tests).
             try:
                 ok = _all_ranks_ok(err is None)
@@ -1505,7 +1533,9 @@ def _run_stages(ctx: RunContext, wanted, force: bool, multiproc: bool,
                     raise err from barrier_err
                 raise
             if not ok and err is None:
-                raise RuntimeError(
+                from ..parallel.allreduce import PeerFailure
+
+                raise PeerFailure(
                     f"stage {stage.value} failed on another rank; "
                     "aborting this rank"
                 )
@@ -1531,6 +1561,7 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
             checkpoint_every=args.checkpoint_every,
             warm_start_gamma=args.warm_start,
             dense_precision=args.dense_precision,
+            em_shards=args.em_shards,
         ),
         online_lda=OnlineLDAConfig(
             num_topics=args.topics,
@@ -1685,12 +1716,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--multihost", action="store_true",
         help="initialize jax.distributed (one controller process per host; "
-        "coordinator/process env via JAX_COORDINATOR_ADDRESS etc.) so the "
-        "mesh spans all hosts' devices over ICI/DCN — the reference's "
-        "mpiexec -f machinefile fan-out (ml_ops.sh:80), minus MPI.  "
-        "Requires --data-dir on a filesystem shared by all hosts: the "
-        "coordinator is the only writer; other ranks join the training "
-        "collectives and read the shared stage outputs",
+        "coordinator/process env via JAX_COORDINATOR_ADDRESS etc.) for "
+        "pod-scale distributed EM — the reference's mpiexec -f "
+        "machinefile fan-out (ml_ops.sh:80), minus MPI: each rank trains "
+        "a deterministic contiguous document shard on ITS OWN devices "
+        "(--mesh is per host: parallel.local_mesh) and the beta/alpha "
+        "sufficient statistics cross processes through an explicit "
+        "allreduce (psum over ICI on real pods, a coordination-service "
+        "KV ring on CPU clusters).  Requires --data-dir on a filesystem "
+        "shared by all hosts: the coordinator is the only writer; other "
+        "ranks read the shared stage outputs and join the reduce",
+    )
+    p.add_argument(
+        "--em-shards", type=int, default=0, metavar="N",
+        help="distributed-EM document shard count (0 = auto: 8, grown "
+        "to cover the process count).  The shard plan — and the "
+        "suff-stats reduction tree — derives from the corpus and N, "
+        "not the rank count, so runs at different rank counts with the "
+        "same N produce byte-identical coordinator artifacts "
+        "(ONI_ML_TPU_EM_SHARDS overrides)",
     )
     p.add_argument(
         "--no-journal", action="store_true",
@@ -1778,17 +1822,40 @@ def main(argv: list[str] | None = None) -> int:
         p.error("fdate must be YYYYMMDD (ml_ops.sh:8-20)")
 
     if args.multihost:
-        import jax
+        from ..parallel import initialize_distributed
 
-        jax.distributed.initialize()
+        # TPU pods / SLURM auto-detect through jax's cluster plugins;
+        # plain CPU clusters (this jax version has no env-var cluster
+        # plugin) bootstrap from the documented explicit env vars.
+        env = os.environ
+        initialize_distributed(
+            env.get("JAX_COORDINATOR_ADDRESS") or None,
+            int(env["JAX_NUM_PROCESSES"])
+            if env.get("JAX_NUM_PROCESSES") else None,
+            int(env["JAX_PROCESS_ID"])
+            if env.get("JAX_PROCESS_ID") else None,
+        )
 
     mesh = None
     vocab_sharded = False
     if args.mesh:
-        from ..parallel.mesh import mesh_from_spec
+        from ..parallel.mesh import local_mesh, mesh_from_spec
 
         try:
-            mesh, vocab_sharded = mesh_from_spec(args.mesh)
+            if args.multihost:
+                # Per-host mesh: distributed EM is host-local; the
+                # cross-process reduce is the explicit allreduce, so
+                # the spec applies to THIS process's devices.
+                parts = args.mesh.split(",")
+                if len(parts) != 2:
+                    raise ValueError(
+                        f"mesh spec must be 'DATA,MODEL', got "
+                        f"{args.mesh!r}"
+                    )
+                mesh = local_mesh(int(parts[0]), int(parts[1]))
+                vocab_sharded = int(parts[1]) > 1
+            else:
+                mesh, vocab_sharded = mesh_from_spec(args.mesh)
         except ValueError as e:
             p.error(str(e))
     stages = (

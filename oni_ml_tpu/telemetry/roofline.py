@@ -224,6 +224,7 @@ def reset() -> None:
 def roofline_record(phase: str, wall_s: float, *, entry: "str | None" = None,
                     dispatches: int = 1,
                     effective_flops: "float | None" = None,
+                    measured_bytes: "float | None" = None,
                     **extra) -> dict:
     """Build one roofline record: the entry's per-dispatch cost times
     `dispatches`, over the measured wall, against the backend's peaks.
@@ -240,7 +241,15 @@ def roofline_record(phase: str, wall_s: float, *, entry: "str | None" = None,
     counts, and `utilization` gains `useful_mxu_pct` (effective over
     peak): "fraction of peak" vs "useful fraction of peak", so padding
     waste is visible as the gap between `mxu_pct` and
-    `useful_mxu_pct`."""
+    `useful_mxu_pct`.
+
+    `measured_bytes` (total over the wall) is for COMMUNICATION phases
+    with no XLA cost to harvest — the distributed-EM suff-stats
+    allreduce (parallel/allreduce.py) prices its cross-process traffic
+    here: the record carries the measured bytes and bytes/s under
+    `cost_source: "measured_comms"`, with `utilization` left null
+    (interconnect bytes are not HBM bytes — the rate is the number,
+    not a fraction of a memory peak)."""
     cost = cost_for(entry or phase)
     backend = (cost or {}).get("backend") or _backend_fingerprint()
     rec = {
@@ -263,6 +272,10 @@ def roofline_record(phase: str, wall_s: float, *, entry: "str | None" = None,
     }
     if wall_s <= 0:
         return rec
+    if measured_bytes is not None and cost is None:
+        rec["cost_source"] = "measured_comms"
+        rec["bytes"] = float(measured_bytes)
+        rec["bytes_per_s"] = float(measured_bytes) / wall_s
     if effective_flops is not None:
         rec["effective_flops"] = float(effective_flops)
         rec["effective_flops_per_s"] = float(effective_flops) / wall_s
@@ -393,6 +406,15 @@ HARVEST_COVERAGE: "dict[str, str]" = {
     # entry point: _aot() reads cost_analysis off every program it
     # compiles.  Neither belongs in the registry: the harvest-coverage
     # lint keys entries to real jax.jit AST nodes.
+    "parallel/allreduce.py": (
+        "exempt: _psum_gather's jitted resharding identity is the "
+        "control-plane collective transport (the explicit suff-stats "
+        "allreduce), not a compute dispatch phase — its traffic is "
+        "priced directly by the {\"kind\": \"allreduce\"} journal "
+        "records and the em.allreduce roofline record's "
+        "measured_bytes path, which is more accurate than an XLA "
+        "cost-analysis harvest of a data-movement-only program"
+    ),
     "ops/sparse_estep.py": (
         "estep crossover probes only — measure_crossover's jitted "
         "engine timers are one-shot sweeps whose result IS the "

@@ -1,20 +1,29 @@
 """Worker process for tests/test_multihost.py.
 
-Runs one rank of a 2-process jax.distributed CPU cluster (2 virtual
-devices per process -> a 4-device global (data, model) mesh spanning
-both) and trains the small synthetic corpus through the REAL multi-host
-code paths the single-process suite cannot reach:
+Runs one rank of an N-process jax.distributed CPU cluster through the
+REAL distributed-EM code paths (host-local E-step shards + explicit
+suff-stats allreduce — parallel/allreduce.py) that the single-process
+suite cannot reach:
 
-- `jax.device_put` onto shardings spanning non-addressable devices,
-- `to_host`'s `process_allgather` branch (models/lda.py) — the arrays
-  are genuinely not fully addressable here,
+- the KV-ring allgather over the coordination client's store (the
+  portable transport: cross-process XLA collectives do not exist on the
+  CPU backend),
+- the corpus-derived shard plan (parallel/shard_plan.py) and the
+  per-shard partial-stats programs (fused.make_partial_runner),
+- the sparse Pallas engine over PER-SHARD bucketed layouts under
+  distribution (estep_engine="sparse", interpret mode on CPU),
+- the distributed streaming trainer (row-split micro-batches, lambda
+  blended from reduced stats on every rank),
 - `_is_coordinator` gating of likelihood.dat / final.* / checkpoint
   writes against a shared day directory,
-- the `initialize_distributed` bootstrap (parallel/mesh.py) that
-  `ml_ops --multihost` calls.
+- run_pipeline's multi-host contract (KV stage-decision broadcasts,
+  coordinator-only writes, every rank joining stage_lda's reduce).
 
-Each rank dumps its LDAResult to proc<pid>.npz; the launcher asserts
-rank parity and compares against a plain single-process run.
+Each rank dumps its results to proc<pid>.npz; the launcher asserts
+bitwise rank parity, compares against plain single-process training,
+and byte-compares the coordinator artifacts of a 1-process run of THIS
+SAME script against the 2-process run's (the shard plan is derived from
+the corpus, not the rank count, so the bytes must match exactly).
 
 Usage: multihost_worker.py <port> <pid> <num_procs> <outdir>
 """
@@ -28,19 +37,25 @@ def main() -> int:
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
     )
     # Backend setup must precede any jax import side effects: CPU-only,
-    # two virtual local devices per process.
+    # two virtual local devices per process (so the host-local mesh
+    # path is exercised, not just mesh=None).
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
-    from oni_ml_tpu.parallel import initialize_distributed, make_mesh
+    from oni_ml_tpu.parallel import initialize_distributed, local_mesh
 
-    initialize_distributed(f"localhost:{port}", nprocs, pid)
+    if nprocs > 1:
+        # The 1-process baseline run needs no cluster at all — the
+        # distributed path degenerates to the local transport, which is
+        # exactly the byte-identity contract under test.
+        initialize_distributed(f"localhost:{port}", nprocs, pid)
+
+    import dataclasses
 
     import jax
     import numpy as np
 
     assert jax.process_count() == nprocs
-    assert len(jax.devices()) == 2 * nprocs
     assert len(jax.local_devices()) == 2
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -56,50 +71,65 @@ def main() -> int:
     corpus = corpus_from_docs(docs, 25)
     cfg = LDAConfig(
         num_topics=3, em_max_iters=6, em_tol=0.0, batch_size=32,
-        min_bucket_len=64, seed=4, checkpoint_every=2, fused_em_chunk=4,
+        min_bucket_len=64, seed=4, checkpoint_every=2,
     )
-    mesh = make_mesh(data=2 * nprocs, model=1)
+
+    # Run 1 — dense family (XLA on CPU) over a HOST-LOCAL data mesh:
+    # each rank's shards run shard_map'd over its own 2 devices, the
+    # cross-process reduce is the explicit allreduce.  Shared day dir:
+    # the coordinator alone writes final.* / likelihood.dat /
+    # checkpoint.npz (checkpoint_every=2 exercises the mid-run path).
     day_dir = os.path.join(outdir, "day")
     os.makedirs(day_dir, exist_ok=True)
-    res = train_corpus(corpus, cfg, out_dir=day_dir, mesh=mesh)
-
-    # Vocab-sharded DENSE plan on a (2, 2) mesh spanning both processes:
-    # the model-axis [B, K] psum inside the fixed point and the
-    # column-sharded beta/suff-stats now genuinely cross hosts
-    # (config 4's multi-chip path, parallel.make_vocab_sharded_dense_e_step).
-    import dataclasses
-
-    vs_mesh = make_mesh(data=nprocs, model=2)
-    vs_res = train_corpus(
-        corpus,
-        # warm start off: the launcher pins this trajectory against the
-        # (fresh-start) sparse data-parallel run above.
-        dataclasses.replace(cfg, dense_em="on", checkpoint_every=0,
-                            warm_start_gamma=False),
-        mesh=vs_mesh,
-        vocab_sharded=True,
+    res = train_corpus(
+        corpus, cfg, out_dir=day_dir, mesh=local_mesh(data=2, model=1),
+        distributed=True,
     )
 
-    # Streaming trainer through the same mesh: its checkpoint path calls
-    # the collective _to_host BEFORE the coordinator gate — the old
-    # gate-first ordering deadlocks exactly here (ADVICE r2 finding).
+    # Run 2 — the SPARSE Pallas engine under distribution (the PR 9
+    # engine was single-process; per-shard bucketed layouts + the
+    # allreduce are what let it survive scale-out).  min_bucket_len
+    # floors at the lane tile via sparse_min_bucket_len; interpret
+    # kernels on CPU.  Fresh-start config so the launcher can pin the
+    # trajectory against a 1-process dense run.
+    sparse_dir = os.path.join(outdir, "day_sparse")
+    os.makedirs(sparse_dir, exist_ok=True)
+    sp = train_corpus(
+        corpus,
+        dataclasses.replace(cfg, estep_engine="sparse",
+                            checkpoint_every=0),
+        out_dir=sparse_dir,
+        distributed=True,
+    )
+    assert sp.plan["estep_engine"]["value"] == "sparse", sp.plan
+
+    # Run 3 — distributed streaming trainer: every micro-batch
+    # row-splits across ranks, lambda blends from the reduced stats on
+    # every rank; the coordinator owns the stream checkpoint.
     from oni_ml_tpu.config import OnlineLDAConfig
     from oni_ml_tpu.io import make_batches
     from oni_ml_tpu.models import OnlineLDATrainer
 
-    stream_ck = os.path.join(outdir, "day", "stream.npz")
+    stream_ck = os.path.join(day_dir, "stream.npz")
     ocfg = OnlineLDAConfig(num_topics=3, batch_size=32, min_bucket_len=64,
                            checkpoint_every=1, seed=4)
-    trainer = OnlineLDATrainer(ocfg, num_terms=25, total_docs=corpus.num_docs,
-                               mesh=mesh, checkpoint_path=stream_ck)
-    for b in make_batches(corpus, ocfg.batch_size, ocfg.min_bucket_len):
+    trainer = OnlineLDATrainer(
+        ocfg, num_terms=25, total_docs=corpus.num_docs,
+        checkpoint_path=stream_ck, distributed=True,
+    )
+    # Same pad rule as train_corpus_online: the batch axis must divide
+    # by the process count AFTER padding (8 * nprocs, not max(8, n) —
+    # 8 is not a multiple of 3).
+    for b in make_batches(corpus, ocfg.batch_size, ocfg.min_bucket_len,
+                          pad_multiple=8 * max(nprocs, 1)):
         trainer.step(b)
     lam = np.asarray(trainer._to_host(trainer.lam))
 
-    # Full runner pipeline against the shared day dir: host-only stages
-    # and all writes are coordinator-only, stage decisions broadcast, and
-    # every rank joins stage_lda's collectives (runner/ml_ops.py
-    # run_pipeline's multi-host contract).
+    # Run 4 — full runner pipeline against the shared day dir:
+    # host-only stages and all writes are coordinator-only, stage
+    # decisions broadcast over the KV store, and every rank joins
+    # stage_lda's suff-stats reduce (runner/ml_ops.py multi-host
+    # contract).
     from oni_ml_tpu.config import PipelineConfig, ScoringConfig
     from oni_ml_tpu.runner.ml_ops import run_pipeline
 
@@ -123,7 +153,10 @@ def main() -> int:
                       batch_size=32, min_bucket_len=64, seed=4),
         scoring=ScoringConfig(threshold=0.5),
     )
-    metrics = run_pipeline(pipe_cfg, "20260101", "flow", mesh=mesh)
+    metrics = run_pipeline(pipe_cfg, "20260101", "flow",
+                           mesh=local_mesh(data=2, model=1))
+    stage_records = [m for m in metrics
+                     if m.get("stage") in ("pre", "corpus", "lda", "score")]
 
     np.savez(
         os.path.join(outdir, f"proc{pid}.npz"),
@@ -131,11 +164,12 @@ def main() -> int:
         gamma=res.gamma,
         alpha=np.float64(res.alpha),
         lls=np.asarray([ll for ll, _ in res.likelihoods], np.float64),
-        vs_log_beta=vs_res.log_beta,
-        vs_lls=np.asarray([ll for ll, _ in vs_res.likelihoods], np.float64),
+        sp_log_beta=sp.log_beta,
+        sp_gamma=sp.gamma,
+        sp_lls=np.asarray([ll for ll, _ in sp.likelihoods], np.float64),
         stream_lam=lam,
         stream_steps=np.int64(trainer.step_count),
-        pipeline_stages=np.int64(len(metrics)),
+        pipeline_stages=np.int64(len(stage_records)),
     )
     print(f"WORKER_OK {pid}", flush=True)
     return 0

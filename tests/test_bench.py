@@ -284,6 +284,19 @@ def _patch_phases(bench, monkeypatch):
         },
     )
     monkeypatch.setattr(
+        bench, "bench_distributed_em",
+        lambda *a, **k: {
+            "nprocs": 2, "docs": 2048, "em_iters": 6, "em_shards": 8,
+            "transport": "kvring", "docs_per_sec": 60000.0,
+            "per_host_estep_wall_s": 0.2, "single_proc_wall_s": 0.35,
+            "single_proc_docs_per_sec": 35000.0,
+            "scaling_efficiency": 0.875,
+            "allreduce_bytes_per_iter": 230000.0,
+            "allreduce_wall_s_per_iter": 0.004,
+            "allreduce_ops": 7, "rank_ll_spread": 0.0,
+        },
+    )
+    monkeypatch.setattr(
         bench, "bench_serving_slo_fleet",
         lambda *a, **k: {
             "n_tenants": 4, "mix": "poisson:1,bursty:1",
@@ -409,6 +422,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "scoring_e2e",
         "serving_slo",
         "serving_slo_fleet",
+        "distributed_em",
         "pipeline_e2e",
         "pipeline_e2e_dns",
     }
@@ -910,3 +924,68 @@ def test_bench_diff_regression_gate(tmp_path):
     bad = tmp_path / "bad.json"
     bad.write_text("[1, 2]")
     assert bench_diff.main([str(bad), str(new_p)]) == 2
+
+
+def test_bench_distributed_em_smoke():
+    """The REAL distributed_em phase machinery at toy scale: spawns the
+    1-process baseline and a 2-rank CPU cluster (fresh worker
+    processes, KV-ring transport) and reports the acceptance payload —
+    allreduce bytes/wall per iteration, scaling efficiency, and zero
+    rank-ELBO spread (parity)."""
+    import bench
+
+    res = bench.bench_distributed_em(nprocs=2, docs=192, em_iters=2)
+    assert res["nprocs"] == 2
+    assert res["transport"] == "kvring"
+    assert res["em_iters"] == 2
+    assert res["docs_per_sec"] > 0
+    assert res["single_proc_docs_per_sec"] > 0
+    assert res["scaling_efficiency"] > 0
+    assert res["allreduce_bytes_per_iter"] > 0
+    assert res["allreduce_wall_s_per_iter"] > 0
+    # em_iters reduces + the gamma merge ride the same collective.
+    assert res["allreduce_ops"] == res["em_iters"] + 1
+    assert res["rank_ll_spread"] == 0.0
+
+
+def test_bench_diff_distributed_em_directions(tmp_path):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ))
+    import bench_diff
+
+    def payload(eff, ar_wall):
+        return {
+            "metric": "lda_em_throughput", "value": 1000.0,
+            "unit": "docs/sec",
+            "secondary": {"distributed_em": {
+                "value": 2000.0, "unit": "docs/sec",
+                "scaling_efficiency": eff,
+                "allreduce_wall_s_per_iter": ar_wall,
+                "allreduce_bytes_per_iter": 219000.0,
+            }},
+        }
+
+    # Efficiency DROP is a regression (fraction = higher-better)...
+    rows = bench_diff.diff_payloads(payload(0.8, 0.01), payload(0.5, 0.01))
+    reg = [r["name"] for r in rows if r["regression"]]
+    assert reg == ["phase:distributed_em.scaling_efficiency"]
+    # ...allreduce-wall GROWTH is a regression (s = lower-better)...
+    rows = bench_diff.diff_payloads(payload(0.8, 0.01), payload(0.8, 0.02))
+    reg = [r["name"] for r in rows if r["regression"]]
+    assert reg == ["phase:distributed_em.allreduce_wall_s_per_iter"]
+    # ...and the same moves in the GOOD direction gate nothing.
+    rows = bench_diff.diff_payloads(payload(0.5, 0.02), payload(0.8, 0.01))
+    assert not [r for r in rows if r["regression"]]
+    # A headline-level distributed_em capture compares directly too.
+    old = {"value": 2000.0, "unit": "docs/sec",
+           "scaling_efficiency": 0.8, "allreduce_wall_s_per_iter": 0.01}
+    new = dict(old, scaling_efficiency=0.4)
+    rows = bench_diff.diff_payloads(old, new)
+    assert any(r["regression"]
+               and r["name"] == "headline.scaling_efficiency"
+               for r in rows)

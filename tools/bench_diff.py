@@ -48,6 +48,28 @@ _SERVING_KEYS = (
     ("p999_ms", "ms"),
 )
 
+# Distributed-EM scaling phase: direction per key — scaling efficiency
+# is a fraction of ideal speedup (higher-better), the per-iteration
+# allreduce wall is dead time on the EM critical path (lower-better).
+# Bytes per iteration are reported but not gated: they change with the
+# payload schema, not with performance.
+_DISTRIBUTED_PHASE = "distributed_em"
+_DISTRIBUTED_KEYS = (
+    ("scaling_efficiency", "fraction"),  # higher-better
+    ("allreduce_wall_s_per_iter", "s"),  # lower-better
+)
+
+
+def _distributed_rows(name: str, old: dict, new: dict,
+                      threshold_pct: float) -> "list[dict]":
+    rows = []
+    for key, unit in _DISTRIBUTED_KEYS:
+        r = _rel_row(f"{name}.{key}", old.get(key), new.get(key), unit,
+                     threshold_pct)
+        if r:
+            rows.append(r)
+    return rows
+
 
 def _serving_groups(payload: dict) -> "dict[str, dict]":
     """label -> latency-summary dict for every comparable group in a
@@ -187,6 +209,16 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
                                       threshold_pct))
     if _serving_groups(old) and _serving_groups(new):
         rows.extend(_serving_rows("headline", old, new, threshold_pct))
+    # Distributed-EM scaling keys (efficiency higher-better, allreduce
+    # wall lower-better) — from the secondary phase payloads, and from
+    # the headline payload when the compared run IS a distributed_em
+    # capture.
+    o, n = old_sec.get(_DISTRIBUTED_PHASE), new_sec.get(_DISTRIBUTED_PHASE)
+    if isinstance(o, dict) and isinstance(n, dict):
+        rows.extend(_distributed_rows(f"phase:{_DISTRIBUTED_PHASE}", o, n,
+                                      threshold_pct))
+    if "scaling_efficiency" in old and "scaling_efficiency" in new:
+        rows.extend(_distributed_rows("headline", old, new, threshold_pct))
     # Streaming-dataplane overlap efficiency (absolute fraction).
     for name in _OVERLAP_PHASES:
         o, n = old_sec.get(name), new_sec.get(name)
