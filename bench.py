@@ -1777,6 +1777,52 @@ def phase_serving_slo_fleet():
             **res}
 
 
+def bench_serving_slo_fleet_paged(n_tenants=256, zipf_s=1.1,
+                                  hot_tenants=32, warm_tenants=64,
+                                  mix="poisson:1,bursty:1",
+                                  n_events=6144, rate_eps=6000.0,
+                                  burst_len=64, max_batch=256,
+                                  max_wait_ms=10.0,
+                                  device_score_min=0):
+    """Thousand-tenant-class serving under tiered model residency
+    (serving/residency.py): a Zipf-distributed census whose working
+    set EXCEEDS the HBM-hot capacity (hot_tenants << n_tenants, the
+    warm tier bounded too so the tail pages through checkpoint-cold
+    spills), driven open-loop through one FleetScorer.  Reports
+    sustained events/s and per-tenant p50/p99/p999 *including*
+    promotion misses (a paging tenant's futures wait out its own
+    promotion), promotion/eviction/cold-load counts with the total
+    priced promotion stall, final tier occupancy — and the
+    plans-counter proof that the whole promote/evict churn performed
+    ZERO post-warmup retraces (the compiled family is keyed by the
+    power-of-two capacity tier, not by which tenants are resident)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    ))
+    import load_gen
+
+    return load_gen.run_fleet_slo(
+        n_tenants, mix, n_events=n_events, rate_eps=rate_eps,
+        burst_len=burst_len, max_batch=max_batch,
+        max_wait_ms=max_wait_ms, device_score_min=device_score_min,
+        zipf_s=zipf_s, hot_tenants=hot_tenants,
+        warm_tenants=warm_tenants,
+    )
+
+
+def phase_serving_slo_fleet_paged():
+    """Paged fleet SLO: headline value is the aggregate sustained
+    events/s over a 256-tenant Zipf census with only 32 HBM-hot slots
+    (working set > HBM-hot capacity by construction); the payload
+    carries the head tenants' quantiles, a distribution summary over
+    every tenant, the residency ledger (promotions / evictions /
+    cold loads / promotion_stall_s), and the zero-retrace proof."""
+    res = bench_serving_slo_fleet_paged()
+    agg = res.get("aggregate", {})
+    return {"value": agg.get("sustained_eps"), "unit": "events/sec",
+            **res}
+
+
 # -- distributed EM (host-local shards + explicit allreduce) ------------
 
 
@@ -2012,6 +2058,8 @@ PHASES = [
     ("scoring_e2e", phase_scoring_e2e, 480.0, True),
     ("serving_slo", phase_serving_slo, 480.0, True),
     ("serving_slo_fleet", phase_serving_slo_fleet, 480.0, True),
+    ("serving_slo_fleet_paged", phase_serving_slo_fleet_paged,
+     480.0, True),
     # CPU-cluster scaling proof: fresh JAX_PLATFORMS=cpu worker
     # processes, so it stays runnable while the chip grant is wedged.
     ("distributed_em", phase_distributed_em, 600.0, False),

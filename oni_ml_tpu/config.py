@@ -346,6 +346,40 @@ class ServingConfig:
     # is bounded independently.
     tenant_queue_max: int = 8192
     admission: str = "block"
+    # -- tiered model residency (serving/residency.py) --
+    # HBM-hot capacity: at most this many tenants per K-group are
+    # members of the stacked device snapshot at once; the rest page
+    # between host-warm (pinned numpy in the per-tenant registry) and
+    # checkpoint-cold (spilled to disk / reloaded from the day dir) by
+    # an admission-driven LRU/LFU policy.  0 = unbounded (legacy: every
+    # published tenant is stack-resident — plan knob
+    # "fleet_hot_tenants" may still supply a measured capacity when
+    # left at 0).  With a capacity set, the stack pads to power-of-two
+    # tenant-capacity TIERS, so the compiled program family is keyed by
+    # capacity, not census: promotion/eviction churn within a tier
+    # retraces nothing.
+    fleet_hot_tenants: int = 0
+    # Host-warm capacity: at most this many NON-hot tenants keep their
+    # theta/p pinned in host RAM; beyond it, the policy's coldest warm
+    # tenants spill to checkpoint-cold (atomic npz under
+    # residency_spill_dir, or reload straight from their day_dir).
+    # 0 = unbounded (cold tier unused).
+    fleet_warm_tenants: int = 0
+    # Eviction victim selection: "lru" (least recently admitted) or
+    # "lfu" (least admissions overall, ties broken by recency).  Both
+    # are admission-aware: a tenant with events currently queued is
+    # never evicted while a quiescent candidate exists.
+    residency_policy: str = "lru"
+    # Cold-tier spill directory for tenants published without a
+    # reloadable day_dir ("" = a per-process temp dir).
+    residency_spill_dir: str = ""
+    # Stacked-snapshot DEVICE storage dtype: "f32" (default) or "bf16".
+    # bf16 stores the stacked theta/p half-width on device — double the
+    # HBM-hot tenant residency per byte — with f32 accumulation in the
+    # gather-dot kernel; scores drift ~2^-8 relative vs the f32 stack
+    # (documented tolerance, pinned in tests/test_residency.py).  The
+    # f32 host path and the golden scoring bytes are untouched.
+    stack_precision: str = "f32"
 
 
 @dataclass(frozen=True)

@@ -165,5 +165,20 @@ KNOBS = {
             valid=_pos_num,
             doc="cross-tenant micro-batch latency trigger (ms)",
         ),
+        # Device-scoped: HBM-hot tenant capacity is a property of the
+        # device's memory, not of the host's queueing policy — a plan
+        # measured against one accelerator's HBM must not survive a
+        # backend swap.  The ServingConfig default of 0 means
+        # "unbounded" and is mapped to None (the pure-plan-knob
+        # convention, like dense_estep_block) by the resolver in
+        # serving/residency.py, so a measured capacity engages only
+        # when the operator left the knob unset.
+        Knob(
+            "fleet_hot_tenants", None,
+            candidates=(4, 8, 16, 32, 64),
+            doc="HBM-hot stacked-snapshot tenant capacity per K-group "
+                "(serving/residency.py tiered paging; 0 in config = "
+                "unbounded legacy residency)",
+        ),
     )
 }

@@ -123,6 +123,36 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    "literal value 'synthetic' (or 'synthetic:N') runs "
                    "the fleet acceptance path on N in-memory tenants "
                    "(default 2) and exits")
+    p.add_argument("--hot-tenants", type=int,
+                   default=ServingConfig.fleet_hot_tenants, metavar="N",
+                   help="tiered residency: at most N tenants per "
+                   "K-group stay HBM-hot (stack-resident); the rest "
+                   "page host-warm/checkpoint-cold on demand "
+                   "(serving/residency.py).  0 = unbounded unless a "
+                   "measured plan supplies a capacity")
+    p.add_argument("--warm-tenants", type=int,
+                   default=ServingConfig.fleet_warm_tenants, metavar="N",
+                   help="at most N non-hot tenants keep host-resident "
+                   "models; beyond that the coldest spill to "
+                   "checkpoint-cold (0 = unbounded)")
+    p.add_argument("--residency-policy",
+                   choices=["lru", "lfu"],
+                   default=ServingConfig.residency_policy,
+                   help="eviction victim selection (admission-aware "
+                   "LRU or LFU)")
+    p.add_argument("--residency-spill",
+                   default=ServingConfig.residency_spill_dir,
+                   metavar="DIR",
+                   help="cold-tier spill dir for tenants without a "
+                   "reloadable day_dir (default: per-process temp "
+                   "dir; manifest tenants reload from their day_dir "
+                   "and never spill)")
+    p.add_argument("--stack-precision", choices=["f32", "bf16"],
+                   default=ServingConfig.stack_precision,
+                   help="stacked-snapshot device storage dtype; bf16 "
+                   "doubles HBM-hot tenant residency per byte with "
+                   "f32 accumulation (~2^-8 relative score drift, "
+                   "documented tolerance)")
     return p
 
 
@@ -144,6 +174,16 @@ def _serving_config(args) -> ServingConfig:
                              ServingConfig.metrics_host),
         openmetrics_path=getattr(args, "openmetrics", ""),
         fleet_manifest=getattr(args, "fleet", ""),
+        fleet_hot_tenants=getattr(args, "hot_tenants",
+                                  ServingConfig.fleet_hot_tenants),
+        fleet_warm_tenants=getattr(args, "warm_tenants",
+                                   ServingConfig.fleet_warm_tenants),
+        residency_policy=getattr(args, "residency_policy",
+                                 ServingConfig.residency_policy),
+        residency_spill_dir=getattr(args, "residency_spill",
+                                    ServingConfig.residency_spill_dir),
+        stack_precision=getattr(args, "stack_precision",
+                                ServingConfig.stack_precision),
     )
 
 
@@ -527,7 +567,13 @@ def serve_fleet_stream(args) -> int:
     accepts untagged lines)."""
     from ..config import ScoringConfig as SC
     from ..plans import warmup as plans_warmup
-    from ..serving import FleetRegistry, FleetScorer, load_manifest
+    from ..serving import (
+        FleetRegistry,
+        FleetScorer,
+        ResidencyManager,
+        load_manifest,
+        resolve_hot_capacity,
+    )
 
     cc_rec = plans_warmup.setup_compilation_cache(
         enabled=not args.no_compilation_cache
@@ -540,7 +586,25 @@ def serve_fleet_stream(args) -> int:
 
         journal = Journal(args.journal)
     metrics = MetricsEmitter(path=cfg.metrics_path, journal=journal)
-    fleet = FleetRegistry(journal=journal, recorder=metrics.recorder)
+    # Tiered residency: an explicit --hot-tenants (or a measured plan
+    # capacity) bounds HBM-hot stack membership; the stack then pads to
+    # power-of-two capacity tiers so paging churn never retraces.
+    hot_cap, hot_src = resolve_hot_capacity(cfg)
+    tiered = hot_cap > 0
+    fleet = FleetRegistry(
+        journal=journal, recorder=metrics.recorder,
+        capacity_tiers=tiered, stack_precision=cfg.stack_precision,
+    )
+    residency = None
+    if tiered:
+        residency = ResidencyManager(
+            fleet, hot_capacity=hot_cap,
+            warm_capacity=cfg.fleet_warm_tenants,
+            policy=cfg.residency_policy,
+            spill_dir=cfg.residency_spill_dir,
+            journal=journal, recorder=metrics.recorder,
+            capacity_source=hot_src,
+        )
     sc = SC()
     featurizers: dict = {}
     for spec in specs:
@@ -548,10 +612,17 @@ def serve_fleet_stream(args) -> int:
             raise SystemExit(
                 f"fleet manifest tenant {spec.tenant!r} has no day_dir"
             )
-        fleet.add_tenant(spec)
+        # Under residency every tenant starts host-warm: a
+        # thousand-tenant census pays ZERO startup stack builds; the
+        # first admissions fill the hot tier.
+        fleet.add_tenant(spec, hot=not tiered)
         fallback = (sc.flow_fallback if spec.dsource == "flow"
                     else sc.dns_fallback)
         snap = fleet.load_day(spec.tenant, spec.day_dir, fallback)
+        if residency is not None:
+            residency.register(
+                spec.tenant, day_source=(spec.day_dir, fallback),
+            )
         fz = _load_featurizer(spec.day_dir, args.top_domains)
         if fz.dsource != spec.dsource:
             raise SystemExit(
@@ -564,6 +635,8 @@ def serve_fleet_stream(args) -> int:
             "stage": "serve", "event": "model_loaded",
             "tenant": spec.tenant, "source": snap.source,
             "model_version": snap.version,
+            "tier": (residency.tier_of(spec.tenant)
+                     if residency is not None else "hot"),
             "ips": len(snap.model.ip_index),
             "vocab": len(snap.model.word_index),
         })
@@ -624,18 +697,35 @@ def serve_fleet_stream(args) -> int:
 
         scorer = FleetScorer(
             fleet, featurizers, cfg, metrics=metrics,
-            on_batch=on_batch, journal=journal,
+            on_batch=on_batch, journal=journal, residency=residency,
         )
+        if residency is not None:
+            residency.set_pending_probe(
+                lambda t: len(scorer._lanes[t].pending) > 0
+            )
         # AOT warmup per pack group: the padded compiled batch family
         # is shared across every tenant of a K-group, so warming the
         # STACKED shapes once covers the whole fleet — and because
-        # hot-swaps preserve per-tenant row counts, these are the only
+        # hot-swaps preserve per-tenant row counts (and paging churn
+        # preserves the capacity-tier shape), these are the only
         # shapes serving will ever dispatch (zero retraces after
         # warmup, the acceptance criterion the fleet SLO bench pins).
+        # Under residency the stack materializes at the FIRST
+        # promotions, so warm the hot tier with the head tenants
+        # before asking for stacked shapes.
         warm: "list | dict"
         try:
             warm = []
-            for k in sorted({fleet.tenant_k(s.tenant) for s in specs}):
+            ks = sorted({fleet.tenant_k(s.tenant) for s in specs})
+            if residency is not None:
+                by_k: dict = {}
+                for s in specs:
+                    by_k.setdefault(
+                        fleet.tenant_k(s.tenant), []).append(s.tenant)
+                for k, group in by_k.items():
+                    for t in group[:max(1, hot_cap)]:
+                        residency.ensure_hot(t)
+            for k in ks:
                 stack = fleet.stack(k)
                 mult = 2 if any(
                     fleet.spec(t).dsource == "flow"
@@ -643,6 +733,7 @@ def serve_fleet_stream(args) -> int:
                 ) else 1
                 warm.append({
                     "k": k, "tenants": len(stack.tenants),
+                    "capacity": stack.capacity or None,
                     **plans_warmup.warmup_serving(
                         stack.model.theta.shape[0],
                         stack.model.p.shape[0], k,
@@ -654,7 +745,10 @@ def serve_fleet_stream(args) -> int:
             warm = {"error": repr(e)[:200]}
         metrics.emit({
             "stage": "serve", "event": "plans",
-            "knobs": scorer.plan,
+            "knobs": (
+                {**scorer.plan, **residency.plan}
+                if residency is not None else scorer.plan
+            ),
             "compilation_cache": cc_rec,
             "warmup": warm,
         })
@@ -712,6 +806,8 @@ def serve_fleet_stream(args) -> int:
             "events_scored": scorer.events_scored,
             "batches": scorer.batches_flushed,
             "tenant_stats": scorer.tenant_stats(),
+            "residency": (residency.stats_snapshot()
+                          if residency is not None else None),
             "final_versions": {
                 s.tenant: fleet.version(s.tenant) for s in specs
             },
@@ -742,6 +838,8 @@ def serve_fleet_stream(args) -> int:
             return 1
         return 0 if scorer.events_scored == submitted else 1
     finally:
+        if residency is not None:
+            residency.close()
         if mserver is not None:
             mserver.close()
         metrics.close()

@@ -104,11 +104,17 @@ def score_dot_rows(theta, p, ip_idx, word_idx):
     dispatch, and parallel.make_sharded_score_fn's per-shard body)
     traces THIS one definition: the pinned bitwise parity between
     chunked / one-shot / sharded scores depends on them not drifting
-    in accumulate dtype or sum order."""
+    in accumulate dtype or sum order.
+
+    The astype is a no-op for the f32 weights every path ships today;
+    it exists for the serving fleet's bf16 stacked snapshots
+    (score._device_model storage marker): gathers stream half-width
+    rows out of HBM, the multiply-accumulate still runs f32 — bf16 is
+    a STORAGE precision here, never an accumulate precision."""
     import jax.numpy as jnp
 
-    a = jnp.take(theta, ip_idx, axis=0)
-    b = jnp.take(p, word_idx, axis=0)
+    a = jnp.take(theta, ip_idx, axis=0).astype(jnp.float32)
+    b = jnp.take(p, word_idx, axis=0).astype(jnp.float32)
     return jnp.sum(a * b, axis=-1)
 
 

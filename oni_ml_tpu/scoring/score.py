@@ -240,18 +240,31 @@ def _device_model(model: ScoringModel, stats=None):
     agrees with the float64 host oracle to ~1e-6 relative
     (tests/test_scoring_pipeline.py::test_f32_transfer_tolerance pins
     the bound) — the golden CSV contract never routes through here.
-    `stats` (pipeline.DispatchStats) records the one-time transfer."""
+
+    A model carrying a `_device_dtype = "bfloat16"` marker (the
+    serving fleet's stacked snapshots under
+    ServingConfig.stack_precision="bf16") stores half-width again —
+    double the HBM-hot tenant residency per byte.  The gather-dot
+    kernel (pipeline.score_dot_rows) casts gathered rows up to f32
+    before accumulating, so only the STORAGE is bf16; scores drift
+    ~2^-8 relative vs the f32 stack (tests/test_residency.py pins the
+    documented tolerance).  `stats` (pipeline.DispatchStats) records
+    the one-time transfer."""
     cached = getattr(model, "_device_cache", None)
     if cached is None:
         import jax.numpy as jnp
 
+        dtype = jnp.dtype(getattr(model, "_device_dtype", None)
+                          or jnp.float32)
         cached = (
-            jnp.asarray(model.theta, jnp.float32),
-            jnp.asarray(model.p, jnp.float32),
+            jnp.asarray(model.theta, dtype),
+            jnp.asarray(model.p, dtype),
         )
         model._device_cache = cached
         if stats is not None:
-            stats.weight_h2d_bytes += 4 * model.theta.size + 4 * model.p.size
+            stats.weight_h2d_bytes += dtype.itemsize * (
+                model.theta.size + model.p.size
+            )
     return cached
 
 

@@ -25,6 +25,19 @@ batch family —
                               serve.<tenant>.* metrics
 
 `ml_ops serve --fleet manifest.json` is the fleet front end.
+
+Tiered residency (serving/residency.py): HBM as a managed cache over
+host RAM and checkpoints —
+
+        -> ResidencyManager   HBM-hot / host-warm / checkpoint-cold
+                              paging with admission-driven LRU/LFU
+                              eviction; promotions rebuild the stack
+                              outside the lock at a capacity-tier
+                              shape, so paging never stalls resident
+                              tenants and never retraces within a tier
+
+`ServingConfig.fleet_hot_tenants` turns it on; the fleet scales from
+"as many tenants as fit in HBM" to "as many tenants as fit on disk".
 """
 
 from .batcher import BatchScorer, ScoreFuture
@@ -51,6 +64,15 @@ from .events import (
 from .metrics import MetricsEmitter
 from .refresh import RefreshLoop, topic_probs_from_log_beta
 from .registry import ModelRegistry, ModelSnapshot, validate_model
+from .residency import (
+    TIER_COLD,
+    TIER_HOT,
+    TIER_WARM,
+    ResidencyManager,
+    load_spill,
+    resolve_hot_capacity,
+    spill_model,
+)
 
 __all__ = [
     "BatchScorer",
@@ -75,4 +97,11 @@ __all__ = [
     "ModelRegistry",
     "ModelSnapshot",
     "validate_model",
+    "ResidencyManager",
+    "TIER_HOT",
+    "TIER_WARM",
+    "TIER_COLD",
+    "resolve_hot_capacity",
+    "spill_model",
+    "load_spill",
 ]

@@ -87,15 +87,40 @@ class StackedSnapshot:
     ip_base: dict                  # tenant -> row offset into stacked theta
     word_base: dict                # tenant -> row offset into stacked p
     stack_version: int             # monotonic per K-group build counter
+    capacity: int = 0              # tenant-slot capacity tier (0 = exact census)
+    precision: str = "f32"         # device storage dtype of the stacked model
 
     def version_of(self, tenant: str) -> int:
         return self.members[tenant].version
 
 
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
 def _build_stack(k: int, tenants: "list[str]", snaps: dict,
-                 stack_version: int) -> StackedSnapshot:
+                 stack_version: int, *, tier: "dict | None" = None,
+                 precision: str = "f32") -> StackedSnapshot:
     """Concatenate member models into one stacked ScoringModel.  Pure
-    function of the member snapshots — called OUTSIDE any lock."""
+    function of the member snapshots — called OUTSIDE any lock.
+
+    `tier` (capacity-tier mode, the tiered-residency path) pads the
+    stacked matrices with zero rows up to ``capacity * slot_rows``:
+    `capacity` is the power-of-two tenant-slot count and the slot row
+    budgets cover the largest tenant the K-group has ever seen, so the
+    stacked SHAPE — and with it the compiled program family — is a
+    function of the capacity tier alone, not of which tenants happen to
+    be resident.  Promotion/eviction churn within a tier then retraces
+    nothing; only crossing a power-of-two census boundary mints one new
+    program family.  The pad rows are never indexed (tenant base
+    offsets only cover real members), so padding cannot change a
+    score.
+
+    `precision="bf16"` marks the stacked model for half-width DEVICE
+    storage (scoring.score._device_model honors the marker): double the
+    HBM-hot residency per byte, f32 accumulation in the gather-dot
+    kernel, ~2^-8 relative score drift vs the f32 stack (documented
+    tolerance).  Host matrices stay float64 either way."""
     thetas, ps = [], []
     ip_base: dict = {}
     word_base: dict = {}
@@ -108,13 +133,30 @@ def _build_stack(k: int, tenants: "list[str]", snaps: dict,
         ps.append(np.asarray(m.p, np.float64))
         ip_off += m.theta.shape[0]
         word_off += m.p.shape[0]
+    capacity = 0
+    if tier is not None:
+        capacity = int(tier["capacity"])
+        pad_ip = capacity * int(tier["ip_slot"]) - ip_off
+        pad_word = capacity * int(tier["word_slot"]) - word_off
+        if pad_ip < 0 or pad_word < 0:
+            raise RuntimeError(
+                f"capacity tier {tier} cannot hold {len(tenants)} "
+                f"members ({ip_off}/{word_off} rows)"
+            )
+        if pad_ip:
+            thetas.append(np.zeros((pad_ip, k)))
+        if pad_word:
+            ps.append(np.zeros((pad_word, k)))
     stacked = ScoringModel(
         ip_index={}, theta=np.concatenate(thetas),
         word_index={}, p=np.concatenate(ps),
     )
+    if precision == "bf16":
+        stacked._device_dtype = "bfloat16"
     return StackedSnapshot(
         k=k, tenants=tuple(tenants), model=stacked, members=dict(snaps),
         ip_base=ip_base, word_base=word_base, stack_version=stack_version,
+        capacity=capacity, precision=precision,
     )
 
 
@@ -148,7 +190,13 @@ class FleetRegistry:
     telemetry hooks: every publish journals a `{"kind":
     "fleet_publish"}` record and bumps `serve.<tenant>.publishes`."""
 
-    def __init__(self, journal=None, recorder=None) -> None:
+    def __init__(self, journal=None, recorder=None, *,
+                 capacity_tiers: bool = False,
+                 stack_precision: str = "f32") -> None:
+        if stack_precision not in ("f32", "bf16"):
+            raise ValueError(
+                f"stack_precision must be f32|bf16, got {stack_precision!r}"
+            )
         self._lock = threading.Lock()
         self._registries: dict[str, ModelRegistry] = {}
         self._specs: dict[str, TenantSpec] = {}
@@ -156,18 +204,44 @@ class FleetRegistry:
         self._tenant_k: dict[str, int] = {}
         self._stacks: dict[int, StackedSnapshot] = {}
         self._stack_builds: dict[int, int] = {}
+        # -- tiered residency state (serving/residency.py drives it) --
+        # _hot: stack membership per tenant (True = HBM-hot).  Legacy
+        # fleets never flip it, so every published tenant stays
+        # stack-resident.  _tenant_rows remembers each tenant's
+        # (theta, p) row counts across cold unloads so the capacity
+        # tier's slot budgets survive paging; _tiers holds the per-K
+        # high-water {capacity, ip_slot, word_slot} — monotone, so
+        # shrinking census never shrinks the compiled shape.
+        self._hot: dict[str, bool] = {}
+        self._tenant_rows: dict[str, tuple] = {}
+        self._tiers: dict[int, dict] = {}
+        self._capacity_tiers = capacity_tiers
+        self._stack_precision = stack_precision
         self._journal = getattr(journal, "journal", journal)
         self._recorder = recorder
 
+    @property
+    def capacity_tiers(self) -> bool:
+        return self._capacity_tiers
+
+    @property
+    def stack_precision(self) -> str:
+        return self._stack_precision
+
     # -- tenant membership --------------------------------------------------
 
-    def add_tenant(self, spec: TenantSpec) -> None:
+    def add_tenant(self, spec: TenantSpec, *, hot: bool = True) -> None:
+        """Register one tenant.  `hot=False` (the tiered-residency
+        startup path) keeps the tenant OUT of the stacked snapshot until
+        a promotion admits it — a thousand-tenant fleet then pays one
+        stack build per hot slot, not one per tenant."""
         with self._lock:
             if spec.tenant in self._registries:
                 raise ValueError(f"tenant {spec.tenant!r} already added")
             self._registries[spec.tenant] = ModelRegistry()
             self._specs[spec.tenant] = spec
             self._order.append(spec.tenant)
+            self._hot[spec.tenant] = hot
 
     def tenants(self) -> "list[str]":
         with self._lock:
@@ -205,10 +279,15 @@ class FleetRegistry:
         with self._lock:
             old_k = self._tenant_k.get(tenant)
             self._tenant_k[tenant] = k
+            self._tenant_rows[tenant] = (
+                model.theta.shape[0], model.p.shape[0],
+            )
             stale = old_k if old_k is not None and old_k != k else None
+            hot = self._hot.get(tenant, True)
         if stale is not None:
             self._refresh_stack(stale)
-        self._refresh_stack(k)
+        if hot:
+            self._refresh_stack(k)
         if self._journal is not None:
             self._journal.append({
                 "kind": "fleet_publish", "tenant": tenant,
@@ -259,19 +338,66 @@ class FleetRegistry:
     def stack_for(self, tenant: str) -> StackedSnapshot:
         return self.stack(self.tenant_k(tenant))
 
+    def _tier_locked(self, k: int, census: int) -> "dict | None":
+        """Caller holds self._lock.  The K-group's capacity tier:
+        power-of-two tenant-slot count covering the hot-census
+        high-water, slot row budgets covering the largest tenant the
+        group KNOWS (hot, warm, or cold — a warm tenant must fit its
+        slot the day it promotes without changing the compiled shape).
+        Monotone: census shrink never shrinks a tier, so the program
+        family only changes when the census first crosses a
+        power-of-two boundary (or a strictly larger tenant joins the
+        group)."""
+        if not self._capacity_tiers:
+            return None
+        ip_slot = word_slot = 1
+        for t in self._order:
+            if self._tenant_k.get(t) != k:
+                continue
+            rows = self._tenant_rows.get(t)
+            if rows is not None:
+                ip_slot = max(ip_slot, _pow2(rows[0]))
+                word_slot = max(word_slot, _pow2(rows[1]))
+        prev = self._tiers.get(k, {})
+        tier = {
+            "capacity": max(_pow2(census), prev.get("capacity", 1)),
+            "ip_slot": max(ip_slot, prev.get("ip_slot", 1)),
+            "word_slot": max(word_slot, prev.get("word_slot", 1)),
+        }
+        self._tiers[k] = tier
+        return tier
+
+    def tier(self, k: int) -> "dict | None":
+        """The K-group's current capacity tier (None when capacity
+        tiers are off) — what the shape-stability tests assert on."""
+        with self._lock:
+            t = self._tiers.get(k)
+            return dict(t) if t is not None else None
+
     def _refresh_stack(self, k: int) -> None:
-        """Rebuild the K-group's stacked snapshot from the members'
+        """Rebuild the K-group's stacked snapshot from the HOT members'
         CURRENT actives and install it — concatenation runs outside the
-        lock; the install re-checks that no member published meanwhile
-        (loop until the built stack matches the live member versions,
-        so concurrent publishes converge on a stack containing both)."""
+        lock; the install re-checks that no member published (or paged)
+        meanwhile (loop until the built stack matches the live member
+        versions, so concurrent publishes converge on a stack
+        containing both)."""
         while True:
             with self._lock:
                 members = [
-                    t for t in self._order if self._tenant_k.get(t) == k
+                    t for t in self._order
+                    if self._tenant_k.get(t) == k
+                    and self._hot.get(t, True)
                 ]
                 regs = {t: self._registries[t] for t in members}
-            snaps = {t: regs[t].active() for t in members}
+                tier = self._tier_locked(k, len(members))
+            try:
+                snaps = {t: regs[t].active() for t in members}
+            except RuntimeError:
+                # A member snapshotted as hot was paged out (and its
+                # registry unloaded) while we held no lock — its
+                # membership flip already re-queued a rebuild; retry
+                # against the fresh census.
+                continue
             if not snaps:
                 with self._lock:
                     self._stacks.pop(k, None)
@@ -279,19 +405,84 @@ class FleetRegistry:
             with self._lock:
                 self._stack_builds[k] = self._stack_builds.get(k, 0) + 1
                 build = self._stack_builds[k]
-            built = _build_stack(k, members, snaps, build)
+            built = _build_stack(k, members, snaps, build, tier=tier,
+                                 precision=self._stack_precision)
             with self._lock:
                 live = {
                     t: self._registries[t].version
                     for t in members
                     if self._tenant_k.get(t) == k
+                    and self._hot.get(t, True)
                 }
                 if live == {t: s.version for t, s in snaps.items()}:
                     cur = self._stacks.get(k)
                     if cur is None or cur.stack_version < build:
                         self._stacks[k] = built
                     return
-            # a member published while we concatenated — rebuild.
+            # a member published (or paged) while we concatenated —
+            # rebuild.
+
+    # -- tiered residency hooks (serving/residency.py) ---------------------
+
+    def is_hot(self, tenant: str) -> bool:
+        with self._lock:
+            return self._hot.get(tenant, True)
+
+    def hot_census(self, k: int) -> "list[str]":
+        """HOT members of the K-group, in registration order."""
+        with self._lock:
+            return [
+                t for t in self._order
+                if self._tenant_k.get(t) == k and self._hot.get(t, True)
+            ]
+
+    def set_hot(self, tenant: str, hot: bool) -> None:
+        """Flip one tenant's stack membership and rebuild its K-group's
+        stacked snapshot — the promotion/eviction primitive.  The
+        rebuild runs OUTSIDE the lock exactly like a hot-swap publish,
+        so resident tenants' scoring never stalls on another tenant's
+        paging; under capacity tiers the stacked shape is unchanged,
+        so the compiled program family survives too."""
+        self.set_hot_many({tenant: hot})
+
+    def set_hot_many(self, changes: "dict[str, bool]") -> None:
+        """Flip several memberships with ONE stack rebuild per affected
+        K-group — a paired promotion+eviction costs one concatenation,
+        not two."""
+        for tenant in changes:
+            self._registry(tenant)      # raise early on unknown tenant
+        ks: set = set()
+        with self._lock:
+            for tenant, hot in changes.items():
+                if self._hot.get(tenant, True) == hot:
+                    continue
+                self._hot[tenant] = hot
+                k = self._tenant_k.get(tenant)
+                if k is not None:
+                    ks.add(k)
+        for k in sorted(ks):
+            self._refresh_stack(k)
+
+    def unload_tenant(self, tenant: str) -> "ModelSnapshot | None":
+        """Drop one NON-hot tenant's host-resident snapshot (keeping
+        its version counter) — the warm→cold demotion.  Returns the
+        snapshot that was active so the caller can checkpoint it."""
+        if self.is_hot(tenant):
+            raise RuntimeError(
+                f"tenant {tenant!r} is stack-resident — evict to warm "
+                "before unloading to cold"
+            )
+        return self._registry(tenant).unload()
+
+    def restore_tenant(self, tenant: str, model: ScoringModel,
+                       source: str, version: int) -> ModelSnapshot:
+        """Reinstall a cold tenant's checkpointed model at its original
+        version — the cold→warm promotion.  Does NOT touch the stack;
+        a subsequent set_hot(tenant, True) completes warm→hot."""
+        return self._registry(tenant).restore(model, source, version)
+
+    def loaded(self, tenant: str) -> bool:
+        return self._registry(tenant).loaded
 
 
 def tenant_pairs(feats, dsource: str, model: ScoringModel,
@@ -348,9 +539,16 @@ class FleetScorer:
         metrics: "MetricsEmitter | None" = None,
         on_batch=None,
         journal=None,
+        residency=None,
     ) -> None:
         self.fleet = fleet
         self.config = config or ServingConfig()
+        # Tiered residency (serving/residency.py): when attached, the
+        # worker drains only HBM-hot tenants' lanes; a non-hot tenant's
+        # admission requests an async promotion and its events wait in
+        # their own bounded lane — the promotion miss shows up as THAT
+        # tenant's latency, never as a stall on a resident tenant.
+        self._residency = residency
         from ..plans import resolve
 
         mb, mb_src = resolve("fleet_max_batch", self.config.fleet_max_batch)
@@ -424,12 +622,21 @@ class FleetScorer:
         self._events_scored = 0
         import contextvars
 
+        if self._residency is not None:
+            # Promotion completions must wake a worker parked on "no
+            # drainable lane"; the waker only touches the condvar, so
+            # the pager thread never nests the manager lock inside it.
+            self._residency.add_waker(self._wake)
         ctx = contextvars.copy_context()
         self._worker = threading.Thread(
             target=lambda: ctx.run(self._run),
             name="oni-fleet-scorer", daemon=True,
         )
         self._worker.start()
+
+    def _wake(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
 
     # -- producer side ------------------------------------------------------
 
@@ -498,6 +705,13 @@ class FleetScorer:
                 "edge": f"admit.{tenant}", "side": "put",
                 "depth": depth, "wait_s": round(wait_ns / 1e9, 6),
             })
+        if self._residency is not None:
+            # Outside _cond: the residency manager has its own lock and
+            # pager thread, and nesting it under the scorer's condvar
+            # would deadlock against the promotion waker.  The touch is
+            # the LRU/LFU admission signal; a non-hot tenant's touch
+            # enqueues an async promotion (idempotent).
+            self._residency.note_admission(tenant)
         return p.future
 
     def flush(self) -> None:
@@ -555,34 +769,78 @@ class FleetScorer:
 
     # -- worker side --------------------------------------------------------
 
+    def _request_stranded_locked(self) -> None:
+        """Caller holds self._cond.  An event admitted while its tenant
+        was hot strands if the tenant is evicted before the drain (no
+        later admission re-triggers paging): re-request promotion for
+        every pending, non-drainable lane.  Lock ordering is safe one
+        way — the manager never acquires the scorer's condvar while
+        holding its own lock (wakers fire lock-free)."""
+        if self._residency is None:
+            return
+        ready = self._residency.drainable
+        stranded = [
+            l.spec.tenant for l in self._lanes.values()
+            if l.pending and l.spec.tenant not in ready
+        ]
+        if stranded:
+            self._residency.request_promotions(stranded)
+
+    def _drainable_locked(self) -> "list[TenantLane]":
+        """Caller holds self._cond.  Lanes the worker may drain NOW:
+        pending events whose tenant is HBM-hot (or residency off).
+        After close() every lane drains — a still-paging tenant's
+        events resolve through the solo fallback instead of wedging
+        shutdown.  A paging tenant's lane is simply invisible to the
+        flush triggers: its events wait out the promotion in their own
+        bounded queue while resident tenants keep flushing."""
+        lanes = self._lanes.values()
+        if self._residency is None or self._closed:
+            return [l for l in lanes if l.pending]
+        ready = self._residency.drainable
+        # Unmanaged tenants (in the fleet but never registered with
+        # the residency manager) keep legacy always-drainable behavior
+        # — they can never be promoted, so gating them on the hot set
+        # would park their events until shutdown.
+        return [l for l in lanes
+                if l.pending and (l.spec.tenant in ready
+                                  or not self._residency.is_managed(
+                                      l.spec.tenant))]
+
     def _take_batch(self):
         """Block until a flush trigger fires; returns (batch, trigger,
         total_depth_after) where batch is [(tenant, _PendingEvent)]
-        drained GLOBALLY OLDEST-FIRST across tenant queues — the
-        no-head-of-line-blocking drain: a bursty tenant fills its own
-        bounded queue, but cannot delay an older event of another
-        tenant.  Empty batch means shutdown."""
+        drained GLOBALLY OLDEST-FIRST across the drainable tenant
+        queues — the no-head-of-line-blocking drain: a bursty tenant
+        fills its own bounded queue, but cannot delay an older event of
+        another tenant.  Empty batch means shutdown."""
         max_wait_s = self.max_wait_ms / 1e3
         lanes = self._lanes
         with self._cond:
-            while not self._closed and not any(
-                    l.pending for l in lanes.values()):
+            while not self._closed and not self._drainable_locked():
+                self._request_stranded_locked()
                 self._cond.wait()
-            if not any(l.pending for l in lanes.values()):
+            if not self._drainable_locked():
                 return [], "shutdown", 0
             trigger = "close" if self._closed else None
             while trigger is None:
+                ready = self._drainable_locked()
+                if not ready:
+                    # Every drainable lane was taken by a promotion
+                    # reversal mid-wait; park again.
+                    self._request_stranded_locked()
+                    self._cond.wait()
+                    if self._closed:
+                        trigger = "close"
+                    continue
                 if self._force_flush:
                     trigger = "flush"
                     break
-                total = sum(len(l.pending) for l in lanes.values())
+                total = sum(len(l.pending) for l in ready)
                 if total >= self.max_batch:
                     trigger = "max_batch"
                     break
-                oldest = min(
-                    l.pending[0].t_enqueue
-                    for l in lanes.values() if l.pending
-                )
+                oldest = min(l.pending[0].t_enqueue for l in ready)
                 waited = time.perf_counter() - oldest
                 if waited >= max_wait_s:
                     trigger = "max_wait"
@@ -596,8 +854,8 @@ class FleetScorer:
             # submitter shares — a linear scan per taken event would
             # make admission stalls scale with tenant count.
             heads = [
-                (lane.pending[0].t_enqueue, t)
-                for t, lane in lanes.items() if lane.pending
+                (lane.pending[0].t_enqueue, lane.spec.tenant)
+                for lane in self._drainable_locked()
             ]
             heapq.heapify(heads)
             batch: list = []
@@ -636,10 +894,12 @@ class FleetScorer:
         segments: dict[str, list] = {}
         for tenant, p in batch:
             segments.setdefault(tenant, []).append(p)
-        stacks: dict[int, StackedSnapshot] = {}
+        stacks: dict[int, "StackedSnapshot | None"] = {}
         tenant_scores: dict[str, np.ndarray] = {}
+        tenant_snaps: dict = {}
         failures: dict[str, Exception] = {}
         groups: dict[int, list] = {}
+        solo: list = []
         feats_by_tenant: dict = {}
         # Each tenant's K is read ONCE here and reused at demux/emit:
         # a concurrent publish may change a tenant's K mid-flush, and a
@@ -652,7 +912,12 @@ class FleetScorer:
                 k = self.fleet.tenant_k(tenant)
                 tenant_ks[tenant] = k
                 if k not in stacks:
-                    stacks[k] = self.fleet.stack(k)
+                    try:
+                        stacks[k] = self.fleet.stack(k)
+                    except RuntimeError:
+                        # No hot member in the K-group at all (every
+                        # tenant paged out) — the group scores solo.
+                        stacks[k] = None
                 feats = lane.featurizer([p.raw for p in items])
                 if feats.num_raw_events != len(items):
                     raise RuntimeError(
@@ -661,7 +926,22 @@ class FleetScorer:
                         f"{len(items)} events"
                     )
                 feats_by_tenant[tenant] = feats
-                groups.setdefault(k, []).append(tenant)
+                stack = stacks[k]
+                if stack is not None and tenant in stack.members:
+                    groups.setdefault(k, []).append(tenant)
+                else:
+                    # Residency miss at scoring time (tenant evicted
+                    # between take and score, or a close-time drain of
+                    # a still-paging lane): score against the tenant's
+                    # OWN registry snapshot.  The gather-dot is per-row
+                    # arithmetic, so on the default f32 stack solo
+                    # scores are bit-identical to packed ones.  Under
+                    # stack_precision="bf16" the solo path scores at
+                    # FULL precision (the registry model carries no
+                    # storage marker), so it agrees with the packed
+                    # path within bf16's documented tolerance, not
+                    # bitwise — strictly more accurate, never wrong.
+                    solo.append(tenant)
             except Exception as e:
                 # Tenant-scoped failure isolation: a tenant whose
                 # featurization (or stack lookup) fails takes down ITS
@@ -669,7 +949,7 @@ class FleetScorer:
                 failures[tenant] = e
         dispatches = 0
         device_dispatches = 0
-        group_device: dict[int, bool] = {}
+        tenant_device: dict[str, bool] = {}
         for k, group in sorted(groups.items()):
             stack = stacks[k]
             try:
@@ -699,7 +979,6 @@ class FleetScorer:
                 is_device = use_device_path(
                     len(ip_all), cfg.device_score_min
                 )
-                group_device[k] = is_device
                 t_g0 = time.perf_counter()
                 pair_scores = batched_scores(
                     stack.model, ip_all, w_all, cfg.device_score_min
@@ -723,13 +1002,59 @@ class FleetScorer:
                     tenant_scores[tenant] = demux_scores(
                         seg, mults[tenant]
                     )
+                    tenant_snaps[tenant] = stack.members[tenant]
+                    tenant_device[tenant] = is_device
             except Exception as e:
                 for tenant in group:
                     failures.setdefault(tenant, e)
+        # Solo fallback dispatches — one per missed tenant, each on the
+        # tenant's own (unstacked) model.
+        for tenant in solo:
+            try:
+                try:
+                    snap = self.fleet.active(tenant)
+                except RuntimeError:
+                    if self._residency is None:
+                        raise
+                    # Checkpoint-cold tenant drained NOW (close-time
+                    # drain, or a demotion racing this flush): read
+                    # the checkpoint through without a tier change —
+                    # the events score against the exact unloaded
+                    # model at its preserved version instead of
+                    # failing.
+                    snap = self._residency.read_through(tenant)
+                ip, w, mult = tenant_pairs(
+                    feats_by_tenant[tenant],
+                    self._lanes[tenant].spec.dsource,
+                    snap.model, 0, 0,
+                )
+                is_device = use_device_path(
+                    len(ip), cfg.device_score_min
+                )
+                t_g0 = time.perf_counter()
+                pair_scores = batched_scores(
+                    snap.model, ip, w, cfg.device_score_min
+                )
+                dispatches += 1
+                if is_device:
+                    device_dispatches += 1
+                    if self.metrics is not None:
+                        rec = self.metrics.recorder
+                        rec.histogram("serve.device_score_ms").observe(
+                            (time.perf_counter() - t_g0) * 1e3
+                        )
+                        rec.counter("serve.device_events").add(
+                            feats_by_tenant[tenant].num_raw_events
+                        )
+                tenant_scores[tenant] = demux_scores(pair_scores, mult)
+                tenant_snaps[tenant] = snap
+                tenant_device[tenant] = is_device
+            except Exception as e:
+                failures.setdefault(tenant, e)
         t1 = time.perf_counter()
-        # Demux: resolve per-tenant futures against the stack the
+        # Demux: resolve per-tenant futures against the snapshot the
         # segment actually scored on (version isolation: tenant B's
-        # futures carry B's version even while A hot-swaps).
+        # futures carry B's version even while A hot-swaps or pages).
         flagged: dict[str, int] = {}
         for tenant, items in segments.items():
             if tenant in failures:
@@ -737,7 +1062,7 @@ class FleetScorer:
                     p.future._fail(failures[tenant])
                 continue
             scores = tenant_scores[tenant]
-            version = stacks[tenant_ks[tenant]].version_of(tenant)
+            version = tenant_snaps[tenant].version
             for p, s in zip(items, scores):
                 p.future._resolve(float(s), version)
             flagged[tenant] = int(
@@ -760,6 +1085,7 @@ class FleetScorer:
         self._journal_safe({
             "kind": "demux", "batch": seq, "events": len(batch),
             "tenants": len(segments), "segments": dispatches,
+            "residency_misses": len(solo),
             "score_ms": round((t1 - t0) * 1e3, 3),
             "demux_ms": round((t2 - t1) * 1e3, 3),
         })
@@ -780,7 +1106,7 @@ class FleetScorer:
                 })
                 continue
             k = tenant_ks[tenant]
-            snap = stacks[k].members[tenant]
+            snap = tenant_snaps[tenant]
             if self.on_batch is not None:
                 try:
                     self.on_batch(tenant, snap, feats_by_tenant[tenant],
@@ -792,15 +1118,22 @@ class FleetScorer:
                         "batch": seq, "on_batch_error": repr(e),
                     })
             oldest = items[0].t_enqueue
+            stack = stacks.get(k)
             self._emit_safe({
                 "stage": "serve", "tenant": tenant, "batch": seq,
                 "events": len(items), "trigger": trigger,
                 "model_version": snap.version,
-                "stack_version": stacks[k].stack_version,
-                # The tenant's OWN K-group's dispatch decision — in a
+                # None = a solo (residency-miss) dispatch: the tenant's
+                # segment never rode a stacked program this flush.
+                "stack_version": (
+                    stack.stack_version
+                    if stack is not None and tenant in stack.members
+                    else None
+                ),
+                # The tenant's OWN segment's dispatch decision — in a
                 # mixed-K flush a host-scored tenant must not be
                 # labeled by another group's device dispatch.
-                "scorer": ("device" if group_device.get(k)
+                "scorer": ("device" if tenant_device.get(tenant)
                            else "host"),
                 "latency_ms": round((t1 - oldest) * 1e3, 3),
                 "queue_wait_ms": round((t0 - oldest) * 1e3, 3),

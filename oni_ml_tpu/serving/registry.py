@@ -128,6 +128,54 @@ class ModelRegistry:
         model = ScoringModel.from_files(doc_path, word_path, fallback)
         return self.publish(model, source=day_dir)
 
+    def unload(self) -> "ModelSnapshot | None":
+        """Release the active (and previous) snapshot's host memory
+        while KEEPING the version counter — the checkpoint-cold demotion
+        of the tiered residency manager (serving/residency.py).  Returns
+        the snapshot that was active so the caller can checkpoint it;
+        `restore` reinstalls a model at the same version, so a tenant
+        paged cold and back serves the identical (model, version) pair
+        it would have served had it never left memory."""
+        with self._lock:
+            snap = self._active
+            self._active = None
+            self._previous = None
+            return snap
+
+    def restore(self, model: ScoringModel, source: str,
+                version: int) -> ModelSnapshot:
+        """Reinstall an unloaded snapshot WITHOUT bumping the version:
+        the inverse of `unload`.  Validates like publish (a corrupt
+        checkpoint must not serve), and refuses to clobber a live
+        snapshot or rewind the version counter."""
+        validate_model(model)
+        with self._lock:
+            if self._active is not None:
+                raise RuntimeError(
+                    "restore() on a loaded registry — unload first "
+                    "(publish is the path that bumps versions)"
+                )
+            if version != self._version:
+                raise ValueError(
+                    f"restore version {version} != registry version "
+                    f"{self._version} — a cold reload must reinstall "
+                    "the exact snapshot that was unloaded"
+                )
+            snap = ModelSnapshot(
+                model=model,
+                version=version,
+                source=source,
+                # lint: ok(monotonic-clock, published_at is a true wall-clock epoch stamp surfaced to operators, never differenced)
+                published_at=time.time(),
+            )
+            self._active = snap
+        return snap
+
+    @property
+    def loaded(self) -> bool:
+        with self._lock:
+            return self._active is not None
+
     def active(self) -> ModelSnapshot:
         with self._lock:
             if self._active is None:

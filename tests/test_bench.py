@@ -314,6 +314,27 @@ def _patch_phases(bench, monkeypatch):
             "plans": {"retraces_after_warmup": 0},
         },
     )
+    monkeypatch.setattr(
+        bench, "bench_serving_slo_fleet_paged",
+        lambda *a, **k: {
+            "n_tenants": 256, "zipf_s": 1.1, "mix": "poisson:1,bursty:1",
+            "n_events": 6144, "offered_eps": 6000.0,
+            "aggregate": {"sustained_eps": 2200.0, "p50_ms": 48.0,
+                          "p99_ms": 1100.0, "p999_ms": 1200.0,
+                          "resolved": 6144, "errors": 0},
+            "tenants": {"t0": {"pattern": "poisson",
+                               "sustained_eps": 1200.0, "p50_ms": 7.0,
+                               "p99_ms": 50.0, "p999_ms": 60.0}},
+            "tenants_truncated": True,
+            "residency": {"policy": "lru", "hot_capacity": 32,
+                          "warm_capacity": 64,
+                          "tiers": {"hot": 32, "warm": 64, "cold": 160},
+                          "promotions": 350, "evictions": 320,
+                          "cold_loads": 250, "spills": 400,
+                          "failures": 0, "promotion_stall_s": 200.0},
+            "plans": {"retraces_after_warmup": 0},
+        },
+    )
 
 
 def test_bench_em_engine_pinning_smoke():
@@ -422,6 +443,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "scoring_e2e",
         "serving_slo",
         "serving_slo_fleet",
+        "serving_slo_fleet_paged",
         "distributed_em",
         "pipeline_e2e",
         "pipeline_e2e_dns",

@@ -39,13 +39,25 @@ _OVERLAP_PHASES = ("pipeline_e2e", "pipeline_e2e_dns")
 # lower-better (ms) — so each key compares under its own unit instead
 # of riding the phase's single headline value.  serving_slo nests per
 # arrival pattern; serving_slo_fleet nests an aggregate plus one
-# summary per tenant.
-_SERVING_PHASES = ("serving_slo", "serving_slo_fleet")
+# summary per tenant; serving_slo_fleet_paged additionally carries the
+# tiered-residency ledger (its aggregate p99 INCLUDES promotion
+# misses, so a paging regression gates through the same keys).
+_SERVING_PHASES = ("serving_slo", "serving_slo_fleet",
+                   "serving_slo_fleet_paged")
 _SERVING_KEYS = (
     ("sustained_eps", "events/sec"),     # higher-better
     ("p50_ms", "ms"),                    # lower-better
     ("p99_ms", "ms"),
     ("p999_ms", "ms"),
+)
+
+# Tiered-residency keys (serving_slo_fleet_paged "residency" section):
+# the total priced promotion stall is dead time on paging tenants'
+# latency paths (lower-better).  Promotion/eviction COUNTS are
+# reported but not gated — they change with the Zipf draw and
+# capacity config, not with performance.
+_RESIDENCY_KEYS = (
+    ("promotion_stall_s", "s"),          # lower-better
 )
 
 # Distributed-EM scaling phase: direction per key — scaling efficiency
@@ -95,7 +107,9 @@ def _serving_rows(name: str, old: dict, new: dict,
                   threshold_pct: float) -> "list[dict]":
     """Per-group, per-key comparison rows for one serving SLO phase
     present in both payloads: a p99/p999 blowup gates exit 1 exactly
-    like a throughput drop, each under its own direction."""
+    like a throughput drop, each under its own direction.  A paged
+    payload's residency ledger contributes its own direction-aware
+    keys (promotion stall lower-better)."""
     rows = []
     old_groups = _serving_groups(old)
     new_groups = _serving_groups(new)
@@ -106,6 +120,13 @@ def _serving_rows(name: str, old: dict, new: dict,
                 old_groups[label].get(key), new_groups[label].get(key),
                 unit, threshold_pct,
             )
+            if r:
+                rows.append(r)
+    old_res, new_res = old.get("residency"), new.get("residency")
+    if isinstance(old_res, dict) and isinstance(new_res, dict):
+        for key, unit in _RESIDENCY_KEYS:
+            r = _rel_row(f"{name}:residency.{key}", old_res.get(key),
+                         new_res.get(key), unit, threshold_pct)
             if r:
                 rows.append(r)
     return rows

@@ -181,16 +181,30 @@ def parse_mix(mix: str) -> "list[tuple[str, float]]":
     return out
 
 
-def fleet_mix(n_tenants: int, mix: str,
-              rate_eps: float) -> "list[dict]":
+def fleet_mix(n_tenants: int, mix: str, rate_eps: float,
+              zipf_s: float = 0.0) -> "list[dict]":
     """Assign every tenant a (pattern, weight, rate share) by cycling
     the parsed mix: weights split the aggregate offered rate, so
     ``--tenants 4 --mix poisson:3,bursty:1`` offers 3/8 of the load to
-    each Poisson tenant and 1/8 to each bursty one."""
+    each Poisson tenant and 1/8 to each bursty one.
+
+    `zipf_s > 0` replaces the cycled mix weights with a Zipf law:
+    tenant i gets weight 1/(i+1)^s (patterns still cycle).  This is
+    the fleet-scale skew model — a few head tenants dominate the
+    offered load while a long tail of cold tenants trickles — exactly
+    the working-set shape the tiered-residency paging bench needs: the
+    head stays HBM-hot, the tail pages."""
     if n_tenants < 1:
         raise ValueError(f"n_tenants must be >= 1, got {n_tenants}")
+    if zipf_s < 0:
+        raise ValueError(f"zipf_s must be >= 0, got {zipf_s}")
     pats = parse_mix(mix)
     assigned = [pats[i % len(pats)] for i in range(n_tenants)]
+    if zipf_s > 0:
+        assigned = [
+            (p, float((i + 1) ** -zipf_s))
+            for i, (p, _) in enumerate(assigned)
+        ]
     total_w = sum(w for _, w in assigned)
     return [
         {"tenant": f"t{i}", "pattern": p, "weight": w,
@@ -199,42 +213,108 @@ def fleet_mix(n_tenants: int, mix: str,
     ]
 
 
+def _tenant_models(base_model, n: int, seed0: int = 1000):
+    """N distinct, validly-normalized models over ONE synthetic day's
+    IP/word populations (same shapes -> one pack group; distinct values
+    -> cross-tenant demux corruption cannot hide).  Sharing the day
+    makes a 1024-tenant census cheap: featurization runs once, only
+    the [D+1,K]/[V+1,K] matrices are per-tenant."""
+    from oni_ml_tpu.scoring import ScoringModel
+
+    ips = sorted(base_model.ip_index, key=base_model.ip_index.get)
+    vocab = sorted(base_model.word_index, key=base_model.word_index.get)
+    k = base_model.num_topics
+    out = []
+    for i in range(n):
+        rng = np.random.default_rng(seed0 + i)
+        out.append(ScoringModel.from_results(
+            ips, rng.dirichlet(np.ones(k), size=len(ips)),
+            vocab, rng.dirichlet(np.ones(len(vocab)), size=k).T,
+            fallback=0.1,
+        ))
+    return out
+
+
 def _fleet_stack(tenant_mix, n_events_per_tenant: int, *,
                  fleet_max_batch: int, fleet_max_wait_ms: float,
-                 device_score_min):
-    """N synthetic tenant days (distinct seeds -> distinct models, same
-    K -> ONE pack group / ONE compiled batch family) behind the real
-    fleet stack (FleetRegistry -> FleetScorer)."""
+                 device_score_min, events_by_tenant=None,
+                 shared_day: bool = False, hot_tenants: int = 0,
+                 warm_tenants: int = 0, residency_policy: str = "lru",
+                 spill_dir: str = "", stack_precision: str = "f32",
+                 recorder=None):
+    """N synthetic tenant days (distinct models, same K -> ONE pack
+    group / ONE compiled batch family) behind the real fleet stack
+    (FleetRegistry -> FleetScorer).
+
+    `hot_tenants > 0` attaches the tiered ResidencyManager
+    (serving/residency.py): capacity-tiered stack, admission-driven
+    paging, `warm_tenants` bounding the host tier (beyond it tenants
+    spill to checkpoint-cold npz under `spill_dir`).  `shared_day`
+    builds ONE synthetic day and distinct per-tenant models over its
+    populations — the only way a 256–1024-tenant census stays cheap
+    enough to bench on CPU.  Returns (rows_by_tenant, fleet, scorer,
+    residency)."""
     from oni_ml_tpu.config import ServingConfig
     from oni_ml_tpu.runner.serve import _synthetic_day
     from oni_ml_tpu.serving import (
         DnsEventFeaturizer,
         FleetRegistry,
         FleetScorer,
+        ResidencyManager,
         TenantSpec,
     )
 
-    fleet = FleetRegistry()
+    tiered = hot_tenants > 0
+    fleet = FleetRegistry(
+        capacity_tiers=tiered, stack_precision=stack_precision,
+        recorder=recorder,
+    )
+    residency = None
+    if tiered:
+        residency = ResidencyManager(
+            fleet, hot_capacity=hot_tenants,
+            warm_capacity=warm_tenants, policy=residency_policy,
+            spill_dir=spill_dir, recorder=recorder,
+        )
     featurizers: dict = {}
     rows_by_tenant: dict = {}
-    for i, tm in enumerate(tenant_mix):
-        rows, model, cuts = _synthetic_day(
+    if shared_day:
+        base_rows, base_model, base_cuts = _synthetic_day(
             n_events=n_events_per_tenant, n_clients=64, n_doms=16,
-            seed=100 + i,
+            seed=100,
         )
+        models = _tenant_models(base_model, len(tenant_mix))
+    for i, tm in enumerate(tenant_mix):
+        if shared_day:
+            rows, model, cuts = base_rows, models[i], base_cuts
+        else:
+            rows, model, cuts = _synthetic_day(
+                n_events=n_events_per_tenant, n_clients=64, n_doms=16,
+                seed=100 + i,
+            )
+        n_t = (events_by_tenant[tm["tenant"]]
+               if events_by_tenant else len(rows))
         fleet.add_tenant(TenantSpec(
             tenant=tm["tenant"], dsource="dns", weight=tm["weight"],
-        ))
+        ), hot=not tiered)
         fleet.publish(tm["tenant"], model, source="load-gen-fleet")
+        if residency is not None:
+            residency.register(tm["tenant"])
         featurizers[tm["tenant"]] = DnsEventFeaturizer(cuts)
-        rows_by_tenant[tm["tenant"]] = rows
+        rows_by_tenant[tm["tenant"]] = [
+            rows[j % len(rows)] for j in range(n_t)
+        ]
     cfg = ServingConfig(
         fleet_max_batch=fleet_max_batch,
         fleet_max_wait_ms=fleet_max_wait_ms,
         device_score_min=device_score_min,
     )
-    scorer = FleetScorer(fleet, featurizers, cfg)
-    return rows_by_tenant, fleet, scorer
+    scorer = FleetScorer(fleet, featurizers, cfg, residency=residency)
+    if residency is not None:
+        residency.set_pending_probe(
+            lambda t: len(scorer._lanes[t].pending) > 0
+        )
+    return rows_by_tenant, fleet, scorer, residency
 
 
 def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
@@ -242,7 +322,11 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                   burst_len: int = 64, max_batch: int = 256,
                   max_wait_ms: float = 10.0, device_score_min=0,
                   seed: int = 0, recorder=None,
-                  timeout_s: float = 120.0) -> dict:
+                  timeout_s: float = 120.0, zipf_s: float = 0.0,
+                  hot_tenants: int = 0, warm_tenants: int = 0,
+                  residency_policy: str = "lru", spill_dir: str = "",
+                  stack_precision: str = "f32",
+                  per_tenant_detail: int = 16) -> dict:
     """The serving_slo_fleet measurement: >= `n_tenants` tenants with
     weighted mixed Poisson/bursty arrivals multiplexed through ONE
     FleetScorer (one shared compiled batch family), per-tenant
@@ -252,18 +336,48 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
     section carries compile-trace counters around the MEASURED window —
     after the warmup burst, a healthy fleet shows
     retraces_after_warmup == 0: the zero-per-tenant-retrace proof the
-    acceptance criteria name."""
+    acceptance criteria name.
+
+    Paged mode (`hot_tenants > 0`, the serving_slo_fleet_paged bench):
+    the fleet runs under the tiered ResidencyManager with a Zipf
+    tenant mix (`zipf_s`) whose working set exceeds the HBM-hot
+    capacity — per-tenant latency then INCLUDES promotion misses (a
+    paging tenant's futures wait out its own promotion), events split
+    across tenants by Zipf weight, the day is shared across tenants
+    (distinct models), and the payload gains a "residency" section:
+    promotions, evictions, cold loads/spills, total priced promotion
+    stall, and final tier occupancy.  Zero-retrace applies unchanged:
+    churn inside a capacity tier never mints a program."""
     from oni_ml_tpu.plans import warmup as plans_warmup
     from oni_ml_tpu.telemetry.spans import Recorder
 
-    tenant_mix = fleet_mix(n_tenants, mix, rate_eps)
-    n_per = max(1, n_events // n_tenants)
-    rows_by_tenant, fleet, scorer = _fleet_stack(
+    rec = recorder or Recorder()
+    paged = hot_tenants > 0
+    tenant_mix = fleet_mix(n_tenants, mix, rate_eps, zipf_s)
+    if paged and zipf_s > 0:
+        # Working-set skew: event counts follow the Zipf weights, so
+        # the head stays hot and the tail pages — every tenant still
+        # sends at least one event (a tenant never touched would not
+        # exercise its paging path).
+        total_w = sum(tm["weight"] for tm in tenant_mix)
+        events_by_tenant = {
+            tm["tenant"]: max(1, int(round(
+                n_events * tm["weight"] / total_w)))
+            for tm in tenant_mix
+        }
+        n_per = max(ev for ev in events_by_tenant.values())
+    else:
+        events_by_tenant = None
+        n_per = max(1, n_events // n_tenants)
+    rows_by_tenant, fleet, scorer, residency = _fleet_stack(
         tenant_mix, n_per, fleet_max_batch=max_batch,
         fleet_max_wait_ms=max_wait_ms,
         device_score_min=device_score_min,
+        events_by_tenant=events_by_tenant, shared_day=paged,
+        hot_tenants=hot_tenants, warm_tenants=warm_tenants,
+        residency_policy=residency_policy, spill_dir=spill_dir,
+        stack_precision=stack_precision, recorder=rec,
     )
-    rec = recorder or Recorder()
     agg_hist = rec.histogram("loadgen.fleet.latency_ms")
     tenant_hists = {
         tm["tenant"]: rec.histogram(
@@ -276,9 +390,19 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
         # shape the packed dispatch family needs traces here, so the
         # timed replay measures steady-state serving, and the
         # compile-counter delta across the replay proves zero retraces.
+        # The compile counters are monitoring events off the persistent
+        # compilation cache — wire it, or the "proof" counts nothing.
+        plans_warmup.setup_compilation_cache()
         plans_warmup._ensure_listener()
         warm_futs = []
-        for i, tm in enumerate(tenant_mix):
+        # Paged mode: warm the HEAD tenants only, enough to fill the
+        # hot tier — the capacity tier (and with it the compiled
+        # stacked shape) reaches its high-water here, so in-window
+        # paging churn swaps stack CONTENT, never shape.  Warming all
+        # 256+ tenants would just thrash the hot tier before the
+        # measurement.
+        warm_mix = tenant_mix[:hot_tenants] if paged else tenant_mix
+        for i, tm in enumerate(warm_mix):
             rows = rows_by_tenant[tm["tenant"]]
             for r in rows[:max(1, min(len(rows), max_batch))]:
                 warm_futs.append(scorer.submit(tm["tenant"], r))
@@ -382,15 +506,15 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                 "max_ms": s["max"] and round(s["max"], 3),
             }
 
-        tenants_out = {}
+        tenants_all = {}
         for tm in tenant_mix:
             t = tm["tenant"]
             state = states[t]
             span = float(schedules[t][-1]) if len(schedules[t]) else 0.0
             t_wall = (state["t_last"] or t0) - t0
-            tenants_out[t] = {
+            tenants_all[t] = {
                 "pattern": tm["pattern"],
-                "weight": tm["weight"],
+                "weight": round(tm["weight"], 6),
                 "events": len(rows_by_tenant[t]),
                 "offered_eps": round(len(schedules[t]) / span, 1)
                 if span > 0 else None,
@@ -400,9 +524,34 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                 "errors": state["errors"],
                 **_quant(tenant_hists[t]),
             }
+        # At fleet scale the full per-tenant dict would dominate the
+        # payload: emit detail for the HEAD tenants (mix order = Zipf
+        # head first) plus a distribution summary over EVERY tenant's
+        # quantiles, and say so — a truncated report must never read
+        # as a complete one.
+        truncated = len(tenants_all) > per_tenant_detail
+        tenants_out = dict(
+            list(tenants_all.items())[:per_tenant_detail])
+
+        def _dist(key):
+            vals = [v[key] for v in tenants_all.values()
+                    if isinstance(v.get(key), (int, float))]
+            if not vals:
+                return None
+            return {
+                "min": round(min(vals), 3),
+                "median": round(float(np.median(vals)), 3),
+                "max": round(max(vals), 3),
+            }
+
+        tenant_summary = {
+            key: _dist(key)
+            for key in ("sustained_eps", "p50_ms", "p99_ms", "p999_ms")
+        }
         return {
             "n_tenants": n_tenants,
             "mix": mix,
+            "zipf_s": zipf_s or None,
             "n_events": sum(len(r) for r in rows_by_tenant.values()),
             "offered_eps": rate_eps,
             "burst_len": burst_len,
@@ -418,6 +567,13 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                 **_quant(agg_hist),
             },
             "tenants": tenants_out,
+            "tenants_truncated": truncated,
+            "tenant_summary": tenant_summary,
+            # Tiered-residency accounting (paged mode): per-tenant
+            # latencies above already INCLUDE promotion misses — a
+            # paging tenant's futures wait out its own promotion.
+            "residency": (residency.stats_snapshot()
+                          if residency is not None else None),
             "packed": {
                 # Measured window only (warmup deltas subtracted);
                 # tenant_stats stays cumulative — its per-tenant
@@ -444,6 +600,8 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
         }
     finally:
         scorer.close()
+        if residency is not None:
+            residency.close()
 
 
 def _stack(n_events: int, *, max_batch: int, max_wait_ms: float,
@@ -559,6 +717,23 @@ def main(argv=None) -> int:
                     help="fleet arrival mix: weighted patterns cycled "
                     "across tenants; weights split the offered rate "
                     "(default poisson:1,bursty:1)")
+    ap.add_argument("--zipf", type=float, default=0.0, metavar="S",
+                    help="fleet mode: Zipf-distributed tenant weights "
+                    "1/(i+1)^S replacing the cycled mix weights — the "
+                    "head dominates the load, the tail trickles "
+                    "(0 = off)")
+    ap.add_argument("--hot-tenants", type=int, default=0, metavar="N",
+                    help="fleet mode: tiered residency with at most N "
+                    "HBM-hot tenants (serving/residency.py); events "
+                    "split by Zipf weight and per-tenant latency "
+                    "includes promotion misses (0 = legacy all-hot)")
+    ap.add_argument("--warm-tenants", type=int, default=0, metavar="N",
+                    help="host-warm capacity beyond hot; coldest "
+                    "tenants spill to checkpoint-cold npz (0 = "
+                    "unbounded)")
+    ap.add_argument("--residency-policy", choices=["lru", "lfu"],
+                    default="lru",
+                    help="eviction victim selection for --hot-tenants")
     ap.add_argument("--tenant-ids", default="", metavar="ID,ID,...",
                     help="with --emit-lines: explicit tenant ids for "
                     "the fleet framing, matching a real manifest "
@@ -587,7 +762,10 @@ def main(argv=None) -> int:
             rate_eps=args.rate, burst_len=args.burst_len,
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             device_score_min=None if args.host_only else 0,
-            seed=args.seed,
+            seed=args.seed, zipf_s=args.zipf,
+            hot_tenants=args.hot_tenants,
+            warm_tenants=args.warm_tenants,
+            residency_policy=args.residency_policy,
         )
         print(json.dumps(res), flush=True)
         return 0

@@ -177,6 +177,41 @@ def journal_to_trace(records: "list[dict]") -> dict:
             # write twice.  Task records feed the terminal summary's
             # background-task table instead; "edge" drain rollups feed
             # the per-edge stall table.
+        elif kind == "residency_promote":
+            # Tier-occupancy counter lane (hot census vs capacity) plus
+            # a promotion-stall lane: paging pressure plotted over time
+            # next to the serve spans — a rising stall curve under a
+            # shrinking census gap is a hot tier sized too small.
+            if rec.get("ok") and isinstance(rec.get("census"), int):
+                args = {"hot_census": rec["census"]}
+                if isinstance(rec.get("capacity"), int):
+                    args["capacity"] = rec["capacity"]
+                events.append({
+                    "name": "residency hot occupancy", "ph": "C",
+                    "ts": us(ns), "pid": pid, "tid": 0, "args": args,
+                })
+            stall = rec.get("stall_s")
+            if isinstance(stall, (int, float)) and stall > 0:
+                events.append({
+                    "name": "residency promotion_stall_ms", "ph": "C",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"stall_ms": stall * 1e3},
+                })
+            if not rec.get("ok"):
+                events.append({
+                    "name": "residency promote FAILED", "ph": "i",
+                    "s": "g", "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"tenant": rec.get("tenant"),
+                             "error": rec.get("error")},
+                })
+        elif kind == "residency_evict":
+            events.append({
+                "name": f"residency evict -> {rec.get('tier_to', '?')}",
+                "ph": "i", "s": "t", "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("tenant", "policy", "for_tenant",
+                          "spill_bytes") if k in rec},
+            })
         elif kind == "backend_lost":
             events.append({
                 "name": "BACKEND LOST", "ph": "i", "s": "g",
@@ -261,6 +296,47 @@ def dataplane_task_table(records: "list[dict]") -> "list[dict]":
     return rows
 
 
+def residency_table(records: "list[dict]") -> "list[dict]":
+    """Per-tenant paging rollup from residency_promote/evict records:
+    how often each tenant paged in, the priced stall it ate, and how
+    often it was evicted (and to which tier) — the terminal answer to
+    'who is thrashing the hot tier'."""
+    acc: dict = {}
+
+    def row(tenant):
+        return acc.setdefault(tenant, {
+            "tenant": tenant, "promotions": 0, "stall_s": 0.0,
+            "evictions": 0, "to_cold": 0, "failures": 0,
+        })
+
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "residency_promote":
+            r = row(rec.get("tenant", "?"))
+            if rec.get("ok"):
+                # A cold tenant's promotion journals two legs:
+                # cold→warm (carries tier_to + load_s) then →hot
+                # (carries stall_s).  Count the →hot leg as THE
+                # promotion; both legs' walls contribute to stall_s.
+                if "tier_to" not in rec:
+                    r["promotions"] += 1
+                    r["stall_s"] += float(rec.get("stall_s") or 0.0)
+                else:
+                    r["stall_s"] += float(rec.get("load_s") or 0.0)
+            else:
+                r["failures"] += 1
+        elif kind == "residency_evict":
+            if rec.get("tenant") is None:
+                continue
+            r = row(rec["tenant"])
+            r["evictions"] += 1
+            if rec.get("tier_to") == "cold":
+                r["to_cold"] += 1
+    for r in acc.values():
+        r["stall_s"] = round(r["stall_s"], 3)
+    return sorted(acc.values(), key=lambda r: -r["stall_s"])
+
+
 def print_summary(records: "list[dict]", dropped: int,
                   out=sys.stdout) -> None:
     rows = stage_summary(records)
@@ -307,6 +383,20 @@ def print_summary(records: "list[dict]", dropped: int,
                   f"{e['gets']:>7} {e['put_stall_s']:>12.3f} "
                   f"{e['get_stall_s']:>12.3f} {e['max_depth']:>9}",
                   file=out)
+    res_rows = residency_table(records)
+    if res_rows:
+        total_stall = sum(r["stall_s"] for r in res_rows)
+        print(f"tiered residency ({total_stall:.3f}s total promotion "
+              "stall; top stalls first):", file=out)
+        print(f"  {'tenant':<16} {'promotions':>10} {'stall_s':>9} "
+              f"{'evictions':>9} {'to_cold':>7} {'failures':>8}",
+              file=out)
+        for r in res_rows[:16]:
+            print(f"  {r['tenant']:<16} {r['promotions']:>10} "
+                  f"{r['stall_s']:>9.3f} {r['evictions']:>9} "
+                  f"{r['to_cold']:>7} {r['failures']:>8}", file=out)
+        if len(res_rows) > 16:
+            print(f"  ... {len(res_rows) - 16} more tenant(s)", file=out)
     tasks = dataplane_task_table(records)
     if tasks:
         hidden = sum(t["wall_s"] for t in tasks if t["ok"])
