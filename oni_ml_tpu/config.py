@@ -448,6 +448,70 @@ class DataplaneConfig:
 
 
 @dataclass(frozen=True)
+class ContinuousConfig:
+    """Continuous ingestion (runner/continuous.py): the standing
+    service that kills the day boundary — raw events stream through
+    featurization into a ring-buffered CSR corpus window
+    (dataplane/window.py), each refresh warm-starts EM from the
+    previous window's topics, and a held-out-likelihood drift detector
+    (models/drift.py) gates every fleet publish.  Time knobs are in
+    SIMULATED event-time seconds (a day replay at ×N wall speed keeps
+    the same window semantics)."""
+
+    # Window span: events older than this (by event time) retire from
+    # the training window at the next advance.  Default: 4 hours.
+    window_s: float = 4 * 3600.0
+    # Refresh cadence: advance + retrain + drift-check + gated publish
+    # every this much event time.  Default: 30 minutes — the freshness
+    # target is "minutes, not next-day".
+    refresh_every_s: float = 1800.0
+    # Hash-split fraction of window documents scored held-out per
+    # refresh (models/evaluate.py document completion) — the drift
+    # detector's input and the warm-vs-fresh quality cross-check.
+    holdout_frac: float = 0.1
+    # Drift declaration: the refresh's held-out per-token likelihood
+    # sitting more than this many nats below the rolling-history
+    # baseline vetoes the publish.
+    drift_tol_nats: float = 0.5
+    # Rolling history depth (refreshes) the baseline medians over, and
+    # the checks required before drift can fire at all.
+    drift_history: int = 8
+    drift_min_history: int = 2
+    # A refresh whose window holds fewer live documents than this
+    # skips training entirely (bootstrap guard).
+    min_refresh_docs: int = 32
+    # The window's vocabulary pads to power-of-two capacity tiers
+    # floored here, so vocab growth inside a tier never changes the
+    # compiled [K, V] beta shape — the training-side twin of the
+    # fleet's pow2 tenant-capacity tiers.  Crossing a tier boundary
+    # mints exactly one new program family.
+    vocab_floor: int = 4096
+    # Docs per E-step batch for window refreshes.  Window batches
+    # always pad to the FULL batch size (not the pipeline's multiple-
+    # of-8 tail padding): a drifting doc census must reuse the same
+    # compiled (B, L) family every refresh.
+    batch_size: int = 256
+    # Length-bucket floor for window batches, raised from the
+    # pipeline's 16: with buckets floored at 64, the pow2 L family is
+    # {64, 128, 256, ...} — a window whose doc-length tail wobbles
+    # refresh-over-refresh stops minting novel (B, L) shapes (each
+    # novel shape is one retrace), at the cost of some pad compute on
+    # short documents.
+    min_bucket_len: int = 64
+    # EM dispatch chunk for window refreshes: 1 = the stepwise driver,
+    # whose compiled unit is one (B, L) E-step — shape-stable across
+    # refreshes whatever the batch COUNT does.  The fused chunk
+    # runner's stacked [NB, B, L] groups re-key on the batch census,
+    # which would retrace on every window that gains a batch.
+    fused_em_chunk: int = 1
+    # Warm-start policy: "auto" seeds EM from the previous published
+    # topics except on the first fit or right after a drift veto
+    # (drift means the old topics stopped describing the stream);
+    # "always"/"never" force.
+    warm_start: str = "auto"
+
+
+@dataclass(frozen=True)
 class PlansConfig:
     """Measured execution plans (oni_ml_tpu/plans/, docs/performance.md
     "Measured execution plans"): the persistent autotune + plan cache
@@ -504,6 +568,7 @@ class PipelineConfig:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     plans: PlansConfig = field(default_factory=PlansConfig)
     dataplane: DataplaneConfig = field(default_factory=DataplaneConfig)
+    continuous: ContinuousConfig = field(default_factory=ContinuousConfig)
     # Mesh shape: (data, model). data shards documents, model shards the
     # vocabulary axis of beta.  (1, 1) = single device.
     mesh_shape: tuple = (1, 1)

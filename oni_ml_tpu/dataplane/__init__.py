@@ -29,6 +29,7 @@ from .corpus_builder import (
 )
 from .plane import Dataplane
 from .scoreprep import ScoringPrep, build_scoring_prep
+from .window import CorpusWindow, WindowSnapshot, pow2_capacity
 from .sinks import (
     CheckpointSinks,
     Task,
@@ -43,6 +44,7 @@ __all__ = [
     "intern_word_counts", "make_word_count_columns", "word_count_columns",
     "StreamingCorpusBuilder", "consume_corpus", "stream_word_counts",
     "Dataplane", "ScoringPrep", "build_scoring_prep",
+    "CorpusWindow", "WindowSnapshot", "pow2_capacity",
     "CheckpointSinks", "Task",
     "atomic_write", "atomic_write_bytes", "clear_stale",
 ]

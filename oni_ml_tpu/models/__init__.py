@@ -1,10 +1,21 @@
-from .lda import LDAResult, LDATrainer, train_corpus
+from .drift import DriftDecision, DriftDetector
+from .lda import (
+    LDAResult,
+    LDATrainer,
+    WindowTrainer,
+    train_corpus,
+    warm_start_log_beta,
+)
 from .online_lda import OnlineLDATrainer, train_corpus_online
 
 __all__ = [
+    "DriftDecision",
+    "DriftDetector",
     "LDAResult",
     "LDATrainer",
     "OnlineLDATrainer",
+    "WindowTrainer",
     "train_corpus",
     "train_corpus_online",
+    "warm_start_log_beta",
 ]

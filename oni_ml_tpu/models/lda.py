@@ -1334,6 +1334,113 @@ class LDATrainer:
         return log_beta, alpha, it
 
 
+def warm_start_log_beta(
+    topic_probs: np.ndarray, num_terms: int
+) -> np.ndarray:
+    """[V0, K] p(word|topic) from a previous fit -> a [K, num_terms]
+    log-beta EM init padded for vocabulary growth.
+
+    Day N's window contains words day N−1 never saw; its beta needs a
+    row for each.  New words get one symmetric-prior quantum of mass
+    (1/num_terms — what a uniform Dirichlet prior would put there) and
+    every topic renormalizes, so the previous topics carry over almost
+    unchanged while unseen words start at small-but-trainable mass
+    rather than the LOG_ZERO floor (a floored word could never grow
+    back under the multiplicative fixed point).  Shrinking the
+    vocabulary is refused: global word ids are first-seen-stable, so a
+    smaller V means the caller mixed id spaces."""
+    p = np.asarray(topic_probs, np.float64)
+    if p.ndim != 2:
+        raise ValueError(f"topic_probs must be [V, K], got {p.shape}")
+    v0, k = p.shape
+    if num_terms < v0:
+        raise ValueError(
+            f"vocabulary cannot shrink: previous topics cover {v0} "
+            f"words, new corpus has {num_terms} — window word ids are "
+            "first-seen-stable, so a smaller V means mixed id spaces"
+        )
+    if not np.isfinite(p).all() or (p < 0).any():
+        raise ValueError("topic_probs must be finite and nonnegative")
+    prior = 1.0 / max(num_terms, 1)
+    full = np.concatenate(
+        [p, np.full((num_terms - v0, k), prior, np.float64)], axis=0
+    )
+    full = full / np.maximum(full.sum(axis=0, keepdims=True), 1e-300)
+    beta = full.T  # [K, num_terms]
+    return np.where(
+        beta > 0, np.log(np.maximum(beta, 1e-300)), estep.LOG_ZERO
+    )
+
+
+class WindowTrainer:
+    """Shape-stable, warm-startable EM driver for continuous window
+    refreshes (runner/continuous.py; ROADMAP item 3).
+
+    One instance lives for the window's whole vocabulary capacity tier
+    and is reused refresh-over-refresh: the jitted E/M programs hang
+    off the inner LDATrainer, so window N+1 re-dispatches the programs
+    window N traced — with the window's pow2 vocab padding and the
+    full-batch-size bucket padding below, a drifting doc census never
+    changes a compiled shape.  Batches always pad to the FULL batch
+    size (make_batches' default padding, not the pipeline's
+    multiple-of-8 tail padding) for exactly that reason.
+
+    `fit()` seeds EM from the previous refresh's topics
+    (warm_start_log_beta pads for vocabulary growth) when given them;
+    the existing float64 convergence check then early-exits after the
+    few iterations the stream actually moved — the warm-start-vs-fresh
+    trade the streaming_freshness bench measures."""
+
+    def __init__(self, config: LDAConfig, num_terms: int) -> None:
+        self.config = config
+        self.num_terms = num_terms
+        self._trainer = LDATrainer(config, num_terms=num_terms)
+        self.fits = 0
+
+    def fit(
+        self,
+        corpus: Corpus,
+        *,
+        topic_probs: "np.ndarray | None" = None,
+        alpha: "float | None" = None,
+        progress: "Callable | None" = None,
+    ) -> LDAResult:
+        """One window refresh: corpus -> LDAResult.  With
+        `topic_probs` (the previous published [V_prev, K] matrix), EM
+        warm-starts from them (rows padded for vocab growth) and
+        `alpha` seeds the Newton; without, the reference's random
+        init.  `result.plan["warm_start"]` records which path ran."""
+        cfg = self.config
+        if corpus.num_terms != self.num_terms:
+            raise ValueError(
+                f"window corpus has V={corpus.num_terms} but this "
+                f"trainer's capacity tier is {self.num_terms} — "
+                "rebuild the trainer at the new tier (one program "
+                "family per tier, by design)"
+            )
+        batches = make_batches(
+            corpus, batch_size=cfg.batch_size,
+            min_bucket_len=cfg.min_bucket_len,
+        )
+        warm = topic_probs is not None
+        init_lb = (
+            warm_start_log_beta(topic_probs, self.num_terms)
+            if warm else None
+        )
+        result = self._trainer.fit(
+            batches,
+            corpus.num_docs,
+            progress=progress,
+            initial_log_beta=init_lb,
+            initial_alpha=alpha if warm else None,
+        )
+        self.fits += 1
+        result.plan["warm_start"] = {
+            "value": bool(warm), "source": "window"
+        }
+        return result
+
+
 def resolve_estep_engine(
     corpus: Corpus, config: LDAConfig, mesh=None, vocab_sharded: bool = False,
     distributed: bool = False, shard_plan=None,

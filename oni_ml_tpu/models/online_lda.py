@@ -373,6 +373,7 @@ class OnlineLDATrainer:
         topic_probs: np.ndarray,
         total_docs: int,
         pseudo_tokens: float = 1e4,
+        num_terms: "int | None" = None,
         **kwargs,
     ) -> "OnlineLDATrainer":
         """Seed the stream from an EXISTING model instead of Hoffman's
@@ -384,7 +385,17 @@ class OnlineLDATrainer:
         topics rather than washing them out (rho at t=0 is already
         < tau0^-kappa).  This is the serving refresh loop's entry point
         (oni_ml_tpu/serving/refresh.py): day artifacts -> streaming
-        updates without a retrain."""
+        updates without a retrain.
+
+        `num_terms` > V seeds a GROWN vocabulary (continuous
+        ingestion: day N's window holds words day N−1 never saw —
+        first-seen word ids are stable, so the new words are exactly
+        rows V..num_terms-1): the new lambda rows start at the
+        symmetric prior eta alone (p's contribution is zero — the old
+        model had no opinion about them), so E_q[beta] for new words
+        begins at the prior and the stream's evidence grows them.
+        Shrinking (num_terms < V) is refused: stable first-seen ids
+        mean a smaller vocabulary is a mixed id space, not growth."""
         p = np.asarray(topic_probs, np.float64)
         if p.ndim != 2 or p.shape[1] != config.num_topics:
             raise ValueError(
@@ -393,7 +404,22 @@ class OnlineLDATrainer:
             )
         if not np.isfinite(p).all() or (p < 0).any():
             raise ValueError("topic_probs must be finite and nonnegative")
-        trainer = cls(config, num_terms=p.shape[0],
+        if num_terms is None:
+            num_terms = p.shape[0]
+        if num_terms < p.shape[0]:
+            raise ValueError(
+                f"num_terms={num_terms} would SHRINK the vocabulary "
+                f"(topic_probs covers {p.shape[0]} words): window word "
+                "ids are first-seen-stable, so pass the grown vocab "
+                "size or slice topic_probs explicitly"
+            )
+        if num_terms > p.shape[0]:
+            p = np.concatenate(
+                [p, np.zeros((num_terms - p.shape[0],
+                              config.num_topics), np.float64)],
+                axis=0,
+            )
+        trainer = cls(config, num_terms=num_terms,
                       total_docs=total_docs, **kwargs)
         if trainer.step_count > 0:
             # A checkpoint_path kwarg restored an in-progress stream:

@@ -1,0 +1,850 @@
+"""Continuous ingestion — the standing service that kills the day
+boundary (ROADMAP item 3; `ml_ops continuous`).
+
+The batch pipeline's unit of work is one FINISHED day: an event at
+00:05 is servable ~24 h later, and every day pays a full
+EM-from-scratch even when the topics barely moved.  This runner
+generalizes the PR 8 streaming dataplane into a standing loop on one
+process — the same devices the serving fleet scores from:
+
+    raw slices ──► featurization ──► CorpusWindow (ring-buffered CSR,
+       │                              first-seen vocab growth,
+       │                              O(evicted) retirement)
+       └────────► FleetScorer (events scored under the CURRENT model
+                  the moment they arrive — servable in seconds)
+
+    every refresh_every_s of event time:
+        window.advance ─► snapshot (pow2 vocab capacity tier)
+        ─► WindowTrainer.fit  (warm-started from the previous
+           published topics; the f64 convergence check early-exits
+           after the few iterations the stream actually moved)
+        ─► DriftDetector.evaluate/check  (held-out per-token LL vs
+           the journal's rolling history)
+        ─► publish gate: drifted models are VETOED and never reach
+           FleetRegistry — serving keeps the prior version
+           bit-identically; healthy models hot-swap in.
+
+Zero post-warmup retraces by construction: the window pads its
+vocabulary to pow2 capacity tiers (the compiled [K, V] family is
+keyed by tier, not census), window batches pad to the full batch
+size, the refresh reuses ONE WindowTrainer's jitted programs, and the
+fleet's capacity-tiered stack keys the serving dispatch by capacity.
+The freshness ledger (event arrival → a model covering the event
+published) is the headline the streaming_freshness bench reports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..config import PipelineConfig
+from ..io import formats
+
+# Flow schema: hour/minute/second live at columns 4/5/6
+# (features/flow.py FLOW_COLUMNS); DNS carries unix_tstamp at column 1.
+_FLOW_H, _FLOW_M, _FLOW_S = 4, 5, 6
+_DNS_TSTAMP = 1
+
+
+@dataclass
+class IngestSlice:
+    """One paced ingest unit: raw event lines covering [t0, t1) of
+    EVENT time, stamped with the wall clock it was delivered at."""
+
+    lines: list
+    t0: float
+    t1: float
+    arrival_wall: float = 0.0
+    index: int = 0
+
+    @property
+    def events(self) -> int:
+        return len(self.lines)
+
+
+def event_time_s(line: str, dsource: str) -> float:
+    """Event-time seconds-into-day for one raw CSV line."""
+    cols = line.split(",")
+    if dsource == "flow":
+        return (int(cols[_FLOW_H]) * 3600 + int(cols[_FLOW_M]) * 60
+                + int(cols[_FLOW_S]))
+    return float(cols[_DNS_TSTAMP])
+
+
+def slice_events(
+    lines, dsource: str, slice_s: float, *, t_base: "float | None" = None
+) -> "list[IngestSlice]":
+    """Order raw lines by event time and cut them into fixed
+    `slice_s`-second slices — the replay decomposition of a historical
+    day into the stream the day never was.  Deterministic: stable sort
+    by event time, empty slices dropped.  Lines whose time columns do
+    not parse (the reference day files' header row, truncated tails)
+    are skipped, matching the featurizers' garbage-row tolerance."""
+    if slice_s <= 0:
+        raise ValueError(f"slice_s must be > 0, got {slice_s}")
+    rows = []
+    parsed = []
+    for ln in lines:
+        if not ln.strip():
+            continue
+        try:
+            parsed.append(event_time_s(ln, dsource))
+        except (ValueError, IndexError):
+            continue          # header / malformed row: not an event
+        rows.append(ln)
+    times = np.asarray(parsed, np.float64)
+    order = np.argsort(times, kind="stable")
+    if t_base is None:
+        t_base = float(times[order[0]]) if len(order) else 0.0
+    slices: list[IngestSlice] = []
+    cur: list = []
+    cur_idx = 0
+    for j in order:
+        idx = int((times[j] - t_base) // slice_s)
+        if cur and idx != cur_idx:
+            slices.append(IngestSlice(
+                lines=cur, t0=t_base + cur_idx * slice_s,
+                t1=t_base + (cur_idx + 1) * slice_s, index=len(slices),
+            ))
+            cur = []
+        if not cur:
+            cur_idx = idx
+        cur.append(rows[int(j)])
+    if cur:
+        slices.append(IngestSlice(
+            lines=cur, t0=t_base + cur_idx * slice_s,
+            t1=t_base + (cur_idx + 1) * slice_s, index=len(slices),
+        ))
+    return slices
+
+
+def paced_slices(slices, speed: float, *, sleep=time.sleep):
+    """Deliver slices at ×`speed` real time: the wall gap between
+    consecutive slices is their event-time gap divided by `speed`.
+    Stamps each slice's `arrival_wall` at delivery.  `speed=inf` (or
+    any non-positive sleep result) delivers as fast as downstream
+    consumes — the no-sleep test/bench mode."""
+    if speed <= 0:
+        raise ValueError(f"speed must be > 0, got {speed}")
+    t_wall0 = time.perf_counter()
+    t_sim0 = None
+    for sl in slices:
+        if t_sim0 is None:
+            t_sim0 = sl.t1
+        due = t_wall0 + (sl.t1 - t_sim0) / speed
+        delay = due - time.perf_counter()
+        if delay > 0 and np.isfinite(delay):
+            sleep(delay)
+        sl.arrival_wall = time.perf_counter()
+        yield sl
+
+
+@dataclass
+class _SliceLedger:
+    """Freshness bookkeeping for one ingested slice: arrival wall
+    stamp, event count, event-time span end.  The service keeps only
+    slices not yet covered by a publish (covered entries drop at the
+    publish that covers them — they can never be re-covered)."""
+
+    index: int
+    arrival_wall: float
+    events: int
+    t1: float
+
+
+@dataclass
+class ContinuousResult:
+    """run_continuous' payload (also what `ml_ops continuous`
+    prints)."""
+
+    payload: dict = field(default_factory=dict)
+
+
+def _featurize_slice(lines, dsource: str, cuts):
+    """One slice through the batch featurizers with PINNED cuts (a
+    slice's own ECDF would bin values differently slice-over-slice and
+    churn the vocabulary for nothing — serving/events.py's rule)."""
+    if dsource == "flow":
+        from ..features.flow import featurize_flow
+
+        return featurize_flow(lines, skip_header=False,
+                              precomputed_cuts=cuts)
+    from ..features.dns import featurize_dns
+
+    rows = [ln.strip().split(",") for ln in lines]
+    return featurize_dns(rows, precomputed_cuts=cuts)
+
+
+def _derive_cuts(lines, dsource: str, qtiles_path: str = ""):
+    """Pin the stream's quantile cuts: from a qtiles file when given
+    (stable word identity across service restarts), else from the
+    bootstrap slice's own ECDF."""
+    if dsource == "flow" and qtiles_path:
+        from ..features.qtiles import read_flow_qtiles
+
+        return read_flow_qtiles(qtiles_path)
+    from ..features.flow import featurize_flow
+
+    if dsource == "flow":
+        feats = featurize_flow(lines, skip_header=False)
+        return (feats.time_cuts, feats.ibyt_cuts, feats.ipkt_cuts)
+    from ..features.dns import featurize_dns
+
+    feats = featurize_dns([ln.strip().split(",") for ln in lines])
+    return (feats.time_cuts, feats.frame_length_cuts,
+            feats.subdomain_length_cuts, feats.entropy_cuts,
+            feats.numperiods_cuts)
+
+
+class ContinuousService:
+    """The standing train-and-serve loop.  Drive it with
+    `run(slices)` (a paced IngestSlice iterable) or slice-by-slice via
+    `ingest_slice` + `maybe_refresh` — tests inject drift that way."""
+
+    def __init__(
+        self,
+        config: PipelineConfig,
+        dsource: str,
+        *,
+        out_dir: str,
+        tenant: str = "stream",
+        fresh_control: bool = False,
+        warmup_refreshes: "int | None" = None,
+    ) -> None:
+        if dsource not in ("flow", "dns"):
+            raise ValueError(f"dsource must be flow or dns, got {dsource!r}")
+        self.config = config
+        self.cc = config.continuous
+        self.dsource = dsource
+        self.out_dir = formats.ensure_dir(out_dir)
+        self.tenant = tenant
+        self.fresh_control = fresh_control
+        if warmup_refreshes is None:
+            # "Post-warmup" starts once the window first reaches steady
+            # state: while it is still FILLING (the first
+            # window_s/refresh_every_s refreshes), each refresh can
+            # legitimately meet a novel doc-length bucket and trace it
+            # — that is startup, not churn.
+            warmup_refreshes = int(
+                np.ceil(self.cc.window_s
+                        / max(self.cc.refresh_every_s, 1e-9))
+            ) + 1
+        self.warmup_refreshes = int(warmup_refreshes)
+
+        from ..dataplane import CorpusWindow
+        from ..models.drift import DriftDetector
+        from ..serving import FleetRegistry, TenantSpec
+        from ..telemetry import Journal, Recorder, RunJournal
+
+        tel = config.telemetry
+        self.journal = None
+        self.recorder = None
+        if tel.journal:
+            jpath = os.path.join(self.out_dir, "run_journal.jsonl")
+            replayed = Journal.replay(jpath)
+            self.journal = RunJournal(
+                Journal(jpath, fsync_every=tel.journal_fsync_every)
+            )
+            self.journal.run_start(
+                mode="continuous", dsource=dsource, tenant=tenant,
+                window_s=self.cc.window_s,
+                refresh_every_s=self.cc.refresh_every_s,
+                replayed_records=len(replayed),
+            )
+            self.recorder = Recorder(journal=self.journal.journal)
+        else:
+            replayed = []
+        raw_journal = (
+            self.journal.journal if self.journal is not None else None
+        )
+        self.window = CorpusWindow(
+            self.cc.window_s, vocab_floor=self.cc.vocab_floor,
+            recorder=self.recorder, journal=raw_journal,
+        )
+        self.drift = DriftDetector(
+            tol_nats=self.cc.drift_tol_nats,
+            history=self.cc.drift_history,
+            min_history=self.cc.drift_min_history,
+            journal=raw_journal, recorder=self.recorder,
+        )
+        # A restarted service resumes its drift baseline from the
+        # journal instead of re-learning it over min_history refreshes.
+        self.drift.prime(replayed)
+        self.fleet = FleetRegistry(
+            journal=raw_journal, recorder=self.recorder,
+            capacity_tiers=True,
+        )
+        self.fleet.add_tenant(TenantSpec(tenant=tenant, dsource=dsource))
+        self.scorer = None          # created at first publish
+        self.cuts = None            # pinned at bootstrap
+        self.trainer = None         # one per vocab capacity tier
+        self.tier_rebuilds = 0
+        self._prev_probs = None     # last PUBLISHED [V_real, K]
+        self._prev_alpha = None
+        self._last_fresh_iters = None
+        self._next_refresh_t = None
+        self._ledger: list[_SliceLedger] = []
+        from ..telemetry.spans import Recorder as _Recorder
+
+        rec = self.recorder or _Recorder()
+        # Two freshness ledgers: wall-clock (what THIS replay measured,
+        # speed-dependent) and event-time (cadence lag + refresh wall —
+        # what a real-time deployment would deliver, speed-invariant).
+        self._freshness = rec.histogram("continuous.freshness_s")
+        self._freshness_event = rec.histogram(
+            "continuous.freshness_event_s"
+        )
+        self._freshness_count = 0
+        # A standing service runs indefinitely: per-refresh detail is
+        # bounded (the journal holds the full history); aggregates are
+        # running sums.
+        from collections import deque as _deque
+
+        self.refresh_records: "_deque[dict]" = _deque(maxlen=1024)
+        self.refresh_count = 0
+        self._fit_agg = {
+            True: {"fits": 0, "wall_s": 0.0, "em_iters": 0},
+            False: {"fits": 0, "wall_s": 0.0, "em_iters": 0},
+        }
+        self.events = 0
+        self.slices = 0
+        self.events_rejected = 0
+        self.flagged = 0
+        self.skipped_refreshes = 0
+        self.control_record = None
+        self._warmup_counts = None
+        self._lda_cfg = None
+        self._flagged_file = None
+        self._last_ll = None
+
+    # -- per-slice ingest ------------------------------------------------
+
+    def ingest_slice(self, sl: IngestSlice) -> None:
+        from ..dataplane import word_count_columns
+
+        if sl.arrival_wall == 0.0:
+            sl.arrival_wall = time.perf_counter()
+        if self.cuts is None:
+            qtiles = (
+                self.config.qtiles_path if self.dsource == "flow" else ""
+            )
+            self.cuts = _derive_cuts(sl.lines, self.dsource, qtiles)
+        feats = _featurize_slice(sl.lines, self.dsource, self.cuts)
+        self.window.ingest(word_count_columns(feats), sl.t0, sl.t1)
+        if self._next_refresh_t is None:
+            self._next_refresh_t = sl.t1 + self.cc.refresh_every_s
+        self._ledger.append(_SliceLedger(
+            index=sl.index, arrival_wall=sl.arrival_wall,
+            events=sl.events, t1=sl.t1,
+        ))
+        self.slices += 1
+        self.events += sl.events
+        if self.scorer is not None:
+            # Scored-the-moment-they-arrive: every event rides the
+            # serving path under the CURRENT published model.  Flagged
+            # (suspicious) events land through the scorer's on_batch
+            # sink (_start_scorer); a malformed event is shed and
+            # counted, never allowed to kill the standing service
+            # (serve mode's contract).
+            for ln in sl.lines:
+                try:
+                    self.scorer.submit(self.tenant, ln)
+                except ValueError:
+                    self.events_rejected += 1
+            self.scorer.flush()
+
+    def maybe_refresh(self, now_sim: float) -> "dict | None":
+        """Run one refresh if `now_sim` crossed the cadence boundary."""
+        if (self._next_refresh_t is None
+                or now_sim < self._next_refresh_t):
+            return None
+        while (self._next_refresh_t is not None
+               and now_sim >= self._next_refresh_t):
+            self._next_refresh_t += self.cc.refresh_every_s
+        return self.refresh(now_sim)
+
+    # -- the refresh -----------------------------------------------------
+
+    def _lda_config(self):
+        if self._lda_cfg is None:
+            import dataclasses
+
+            cc = self.cc
+            self._lda_cfg = dataclasses.replace(
+                self.config.lda,
+                batch_size=cc.batch_size,
+                min_bucket_len=cc.min_bucket_len,
+                fused_em_chunk=cc.fused_em_chunk,
+            )
+        return self._lda_cfg
+
+    def refresh(self, now_sim: float) -> dict:
+        from ..models.lda import WindowTrainer
+
+        idx = self.refresh_count + self.skipped_refreshes + 1
+        self.window.advance(now_sim)
+        snap = self.window.snapshot()
+        corpus = snap.corpus
+        if corpus.num_docs < self.cc.min_refresh_docs:
+            self.skipped_refreshes += 1
+            return {"refresh": idx, "skipped": "window_too_small",
+                    "docs": corpus.num_docs}
+        cfg = self._lda_config()
+        if (self.trainer is None
+                or self.trainer.num_terms != corpus.num_terms):
+            # One program family per vocabulary capacity tier: churn
+            # inside a tier retraces nothing; crossing a boundary
+            # mints exactly one new trainer (and family).
+            self.trainer = WindowTrainer(cfg, corpus.num_terms)
+            self.tier_rebuilds += 1
+        mode = self._train_mode()
+        seed_probs = self._prev_probs if mode == "warm" else None
+        seed_alpha = self._prev_alpha if mode == "warm" else None
+        refresh_wall0 = time.perf_counter()
+        t0 = time.perf_counter()
+        result = self.trainer.fit(
+            corpus, topic_probs=seed_probs, alpha=seed_alpha,
+        )
+        train_wall = time.perf_counter() - t0
+        ll, held_docs = self.drift.evaluate(
+            result.log_beta, result.alpha, corpus,
+            holdout_frac=self.cc.holdout_frac,
+            batch_size=cfg.batch_size,
+            min_bucket_len=cfg.min_bucket_len,
+            var_max_iters=cfg.var_max_iters, var_tol=cfg.var_tol,
+        )
+        decision = self.drift.check(
+            ll, held_docs=held_docs, docs=corpus.num_docs,
+            window_t0=round(snap.t0, 3), window_t1=round(snap.t1, 3),
+        )
+        version = self.fleet.version(self.tenant)
+        ok = self.drift.gate(
+            decision, version=version, tenant=self.tenant,
+            mode=mode, em_iters=result.em_iters,
+        )
+        publish_wall = None
+        if ok:
+            model = self._publish(snap, result)
+            publish_wall = time.perf_counter()
+            self._prev_probs = np.asarray(
+                model.p[:-1], np.float64
+            )  # drop fallback row: the [V_real, K] warm-start seed
+            self._prev_alpha = result.alpha
+        if mode == "fresh":
+            self._last_fresh_iters = result.em_iters
+        iters_saved = (
+            self._last_fresh_iters - result.em_iters
+            if mode == "warm" and self._last_fresh_iters is not None
+            else None
+        )
+        fresh = self._freshness_record(publish_wall, now_sim,
+                                       refresh_wall0)
+        record = {
+            "refresh": idx,
+            "mode": mode,
+            "warm_start": mode == "warm",
+            "em_iters": result.em_iters,
+            "iters_saved": iters_saved,
+            "train_wall_s": round(train_wall, 4),
+            "held_out_ll": round(ll, 6),
+            "held_docs": held_docs,
+            "drifted": decision.drifted,
+            "published": ok,
+            "version": self.fleet.version(self.tenant),
+            "docs": corpus.num_docs,
+            "vocab": snap.real_vocab,
+            "vocab_capacity": snap.vocab_capacity,
+            "window_chunks": snap.chunks,
+            **fresh,
+        }
+        self.refresh_records.append(record)
+        self.refresh_count += 1
+        agg = self._fit_agg[mode == "warm"]
+        agg["fits"] += 1
+        agg["wall_s"] += train_wall
+        agg["em_iters"] += result.em_iters
+        self._last_ll = ll
+        if (self.fresh_control and self.control_record is None
+                and mode == "warm" and ok
+                and idx > self.warmup_refreshes):
+            self.control_record = self._run_fresh_control(
+                corpus, record, seed_probs, seed_alpha
+            )
+        if (self._warmup_counts is None
+                and idx >= self.warmup_refreshes):
+            from ..plans import warmup as plans_warmup
+
+            self._warmup_counts = plans_warmup.compile_counts()
+        return record
+
+    def _train_mode(self) -> str:
+        cc = self.cc
+        if cc.warm_start not in ("auto", "always", "never"):
+            raise ValueError(
+                f"ContinuousConfig.warm_start={cc.warm_start!r}: "
+                "expected 'auto', 'always', or 'never'"
+            )
+        if self._prev_probs is None or cc.warm_start == "never":
+            return "fresh"
+        if cc.warm_start == "always":
+            return "warm"
+        return self.drift.mode        # fresh right after a veto
+
+    def _publish(self, snap, result):
+        from ..scoring import ScoringModel
+
+        sc = self.config.scoring
+        fallback = (
+            sc.flow_fallback if self.dsource == "flow"
+            else sc.dns_fallback
+        )
+        corpus = snap.corpus
+        # The published model covers the REAL vocabulary only: the
+        # tier's pad words never occur in an event and must not ride
+        # into word_index.
+        model = ScoringModel.from_lda(
+            corpus.doc_names,
+            result.gamma,
+            corpus.vocab[: snap.real_vocab],
+            result.log_beta[:, : snap.real_vocab],
+            fallback,
+        )
+        self.fleet.publish(
+            self.tenant, model,
+            source=f"window@{round(snap.t1, 1)}",
+        )
+        if self.scorer is None:
+            self._start_scorer()
+        return model
+
+    def _start_scorer(self) -> None:
+        from ..serving import (
+            DnsEventFeaturizer,
+            FleetScorer,
+            FlowEventFeaturizer,
+        )
+
+        fz = (
+            FlowEventFeaturizer(self.cuts) if self.dsource == "flow"
+            else DnsEventFeaturizer(self.cuts)
+        )
+        # Flagged-event product sink: the scored output IS the
+        # pipeline's purpose — suspicious connects stream to
+        # flagged_events.jsonl as they score (serve mode's on_batch
+        # contract), not just into the freshness ledger.
+        self._flagged_file = open(
+            os.path.join(self.out_dir, "flagged_events.jsonl"), "a"
+        )
+
+        def on_batch(tenant, snapshot, feats, scores):
+            threshold = self.scorer.tenant_threshold(tenant)
+            for i in np.where(scores < threshold)[0]:
+                self.flagged += 1
+                self._flagged_file.write(json.dumps({
+                    "tenant": tenant,
+                    "flagged": feats.featurized_row(int(i)),
+                    "score": float(scores[i]),
+                    "model_version": snapshot.version,
+                }) + "\n")
+            self._flagged_file.flush()
+
+        self.scorer = FleetScorer(
+            self.fleet, {self.tenant: fz}, self.config.serving,
+            on_batch=on_batch, journal=self.journal,
+        )
+
+    def _freshness_record(self, publish_wall: "float | None",
+                          now_sim: float,
+                          refresh_wall0: float) -> dict:
+        """Resolve the freshness ledger at a successful publish: every
+        not-yet-covered slice's events became servable under a model
+        trained on a window containing them.  Wall freshness is what
+        THIS replay measured (speed-dependent); event-time freshness
+        is the cadence lag plus the refresh's own wall — what a
+        real-time deployment would deliver, invariant to the replay
+        speed."""
+        if publish_wall is None:
+            return {"freshness_slices": 0}
+        refresh_cost = publish_wall - refresh_wall0
+        n = 0
+        wall_max = 0.0
+        event_max = 0.0
+        for entry in self._ledger:
+            wall = publish_wall - entry.arrival_wall
+            event_s = max(now_sim - entry.t1, 0.0) + refresh_cost
+            n += 1
+            wall_max = max(wall_max, wall)
+            event_max = max(event_max, event_s)
+            self._freshness_count += 1
+            self._freshness.observe(wall)
+            self._freshness_event.observe(event_s)
+        # Covered entries can never be re-covered: drop them, so a
+        # standing service's ledger holds only the slices since the
+        # last successful publish (bounded, and each publish's scan is
+        # O(new slices), not O(slices ever)).
+        self._ledger.clear()
+        if n and self.journal is not None:
+            # The freshness-latency lane trace_view plots: per publish,
+            # the worst newly-covered slice's arrival→servable gap.
+            self.journal.append({
+                "kind": "freshness",
+                "slices": n,
+                "wall_max_s": round(wall_max, 3),
+                "event_max_s": round(event_max, 3),
+            })
+        return {"freshness_slices": n}
+
+    def _run_fresh_control(self, corpus, record, seed_probs,
+                           seed_alpha):
+        """The apples-to-apples warm-vs-fresh measurement: re-run the
+        warm fit AND one fresh fit back-to-back on the exact snapshot
+        a warm refresh just trained (neither is published) — same
+        data, same shapes, both on already-traced programs, so the
+        bench's warm_start_speedup compares pure EM walls at matched
+        held-out likelihood, not a compile against a cache hit."""
+        cfg = self._lda_config()
+
+        def _eval(result):
+            ll, _ = self.drift.evaluate(
+                result.log_beta, result.alpha, corpus,
+                holdout_frac=self.cc.holdout_frac,
+                batch_size=cfg.batch_size,
+                min_bucket_len=cfg.min_bucket_len,
+                var_max_iters=cfg.var_max_iters, var_tol=cfg.var_tol,
+            )
+            return ll
+
+        t0 = time.perf_counter()
+        warm_res = self.trainer.fit(
+            corpus, topic_probs=seed_probs, alpha=seed_alpha
+        )
+        warm_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fresh_res = self.trainer.fit(corpus)
+        fresh_wall = time.perf_counter() - t0
+        warm_ll = _eval(warm_res)
+        fresh_ll = _eval(fresh_res)
+        self._last_fresh_iters = fresh_res.em_iters
+        return {
+            "at_refresh": record["refresh"],
+            "warm_wall_s": round(warm_wall, 4),
+            "fresh_wall_s": round(fresh_wall, 4),
+            "warm_em_iters": warm_res.em_iters,
+            "fresh_em_iters": fresh_res.em_iters,
+            "warm_start_speedup": round(
+                fresh_wall / max(warm_wall, 1e-9), 3
+            ),
+            "held_out_ll_warm": round(warm_ll, 6),
+            "held_out_ll_fresh": round(fresh_ll, 6),
+            "held_out_ll_delta": round(warm_ll - fresh_ll, 6),
+        }
+
+    # -- drive + close ---------------------------------------------------
+
+    def run(self, slices) -> dict:
+        """Consume a paced slice stream to exhaustion, then close."""
+        try:
+            for sl in slices:
+                self.ingest_slice(sl)
+                self.maybe_refresh(sl.t1)
+        finally:
+            payload = self.close()
+        return payload
+
+    def close(self) -> dict:
+        if self.scorer is not None:
+            self.scorer.close(timeout=60.0)
+            self.scorer = None
+        if self._flagged_file is not None:
+            self._flagged_file.close()
+            self._flagged_file = None
+        payload = self.summary()
+        if self.journal is not None:
+            self.journal.run_end(ok=True, publishes=self.drift.publishes,
+                                 vetoes=self.drift.vetoes)
+            self.journal.close()
+            self.journal = None
+        with open(os.path.join(self.out_dir, "continuous_metrics.json"),
+                  "w") as f:
+            json.dump(payload, f, indent=1)
+        return payload
+
+    def summary(self) -> dict:
+        def _fit_stats(warm: bool) -> dict:
+            agg = self._fit_agg[warm]
+            if not agg["fits"]:
+                return {"fits": 0}
+            return {
+                "fits": agg["fits"],
+                "mean_wall_s": round(agg["wall_s"] / agg["fits"], 4),
+                "mean_em_iters": round(
+                    agg["em_iters"] / agg["fits"], 2
+                ),
+            }
+
+        fresh_q = {}
+        if self._freshness_count:
+            fresh_q = {
+                "freshness_p50_s": round(
+                    self._freshness.quantile(0.50), 3
+                ),
+                "freshness_p99_s": round(
+                    self._freshness.quantile(0.99), 3
+                ),
+                "freshness_event_p50_min": round(
+                    self._freshness_event.quantile(0.50) / 60.0, 3
+                ),
+                "freshness_event_p99_min": round(
+                    self._freshness_event.quantile(0.99) / 60.0, 3
+                ),
+            }
+        retraces = None
+        if self._warmup_counts is not None:
+            from ..plans import warmup as plans_warmup
+
+            delta = plans_warmup.counts_delta(self._warmup_counts)
+            retraces = delta.get("traces", 0)
+        return {
+            "dsource": self.dsource,
+            "tenant": self.tenant,
+            "slices": self.slices,
+            "events": self.events,
+            "events_rejected": self.events_rejected,
+            "flagged": self.flagged,
+            "refreshes": self.refresh_count,
+            "skipped_refreshes": self.skipped_refreshes,
+            "publishes": self.drift.publishes,
+            "vetoes": self.drift.vetoes,
+            "version": (
+                self.fleet.version(self.tenant)
+                if self.tenant in self.fleet.tenants() else 0
+            ),
+            **fresh_q,
+            "freshness_samples": self._freshness_count,
+            "uncovered_slices": len(self._ledger),
+            "warm": _fit_stats(True),
+            "fresh": _fit_stats(False),
+            "fresh_control": self.control_record,
+            "held_out_ll": self._last_ll,
+            "vocab": self.window.vocab_size,
+            "vocab_capacity": self.window.vocab_capacity(),
+            "tier_rebuilds": self.tier_rebuilds,
+            "evicted_chunks": self.window.evicted_chunks,
+            "retraces_after_warmup": retraces,
+            # Bounded recent detail (maxlen 1024); the journal holds
+            # the full history.
+            "refresh_records": list(self.refresh_records),
+        }
+
+
+def run_continuous(
+    config: PipelineConfig,
+    dsource: str,
+    slices,
+    *,
+    out_dir: str,
+    tenant: str = "stream",
+    fresh_control: bool = False,
+    warmup_refreshes: "int | None" = None,
+) -> dict:
+    """Convenience wrapper: stand up a ContinuousService, wire the
+    persistent compilation cache (the zero-retrace counters count
+    nothing without it), and drive the slice stream to exhaustion."""
+    from ..plans import warmup as plans_warmup
+
+    if config.plans.compilation_cache:
+        plans_warmup.setup_compilation_cache(
+            cache_dir=config.plans.compilation_cache_dir
+        )
+    plans_warmup._ensure_listener()
+    service = ContinuousService(
+        config, dsource, out_dir=out_dir, tenant=tenant,
+        fresh_control=fresh_control, warmup_refreshes=warmup_refreshes,
+    )
+    return service.run(slices)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ml_ops continuous",
+        description="continuous ingestion: windowed streaming corpus, "
+        "warm-start EM refreshes, drift-gated fleet publishes — "
+        "freshness in minutes, not next-day (tools/day_replay.py "
+        "paces a historical day into this mode)",
+    )
+    p.add_argument("dsource", choices=["flow", "dns"])
+    p.add_argument("--flow-path", default=None,
+                   help="raw netflow CSV to replay (FLOW_PATH env)")
+    p.add_argument("--dns-path", default=None,
+                   help="raw DNS CSV to replay (DNS_PATH env)")
+    p.add_argument("--data-dir", default=None,
+                   help="output/journal directory (LPATH env)")
+    p.add_argument("--qtiles", default=None,
+                   help="pinned flow quantile cuts (stable word "
+                   "identity across restarts)")
+    p.add_argument("--speed", type=float, default=60.0,
+                   help="replay speed multiplier over event time "
+                   "(60 = an hour of events per wall minute)")
+    p.add_argument("--slice-s", type=float, default=300.0,
+                   help="ingest slice span in EVENT seconds")
+    p.add_argument("--window-s", type=float, default=None,
+                   help="override ContinuousConfig.window_s")
+    p.add_argument("--refresh-s", type=float, default=None,
+                   help="override ContinuousConfig.refresh_every_s")
+    p.add_argument("--tenant", default="stream")
+    p.add_argument("--fresh-control", action="store_true",
+                   help="measure one fresh fit against a warm refresh's "
+                   "snapshot (the warm_start_speedup number)")
+    p.add_argument("--no-sleep", action="store_true",
+                   help="deliver slices as fast as consumed (tests/CI)")
+    return p
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import dataclasses
+
+    args = build_parser().parse_args(argv)
+    env = os.environ
+    path = (
+        (args.flow_path or env.get("FLOW_PATH", ""))
+        if args.dsource == "flow"
+        else (args.dns_path or env.get("DNS_PATH", ""))
+    )
+    if not path or not os.path.exists(path):
+        print(f"continuous: no input file at {path!r}", flush=True)
+        return 2
+    config = PipelineConfig(
+        data_dir=args.data_dir or env.get("LPATH", "."),
+        qtiles_path=args.qtiles or "",
+    )
+    cc = config.continuous
+    overrides = {}
+    if args.window_s is not None:
+        overrides["window_s"] = args.window_s
+    if args.refresh_s is not None:
+        overrides["refresh_every_s"] = args.refresh_s
+    if overrides:
+        config = config.replace(
+            continuous=dataclasses.replace(cc, **overrides)
+        )
+    with open(path) as f:
+        lines = f.readlines()
+    slices = slice_events(lines, args.dsource, args.slice_s)
+    speed = float("inf") if args.no_sleep else args.speed
+    payload = run_continuous(
+        config, args.dsource, paced_slices(slices, speed),
+        out_dir=os.path.join(config.data_dir, "continuous"),
+        tenant=args.tenant, fresh_control=args.fresh_control,
+    )
+    print(json.dumps(payload), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1602,7 +1602,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="ml_ops",
         description="oni_ml_tpu suspicious-connects pipeline "
         "(replaces ml_ops.sh YYYYMMDD {flow|dns} [TOL]); "
-        "`ml_ops serve --help` for the streaming scoring service",
+        "`ml_ops serve --help` for the streaming scoring service, "
+        "`ml_ops continuous --help` for windowed streaming ingestion "
+        "with warm-start EM and drift-gated publishes",
     )
     p.add_argument("fdate", help="day to analyze, YYYYMMDD")
     p.add_argument("dsource", choices=["flow", "dns"])
@@ -1809,6 +1811,15 @@ def main(argv: list[str] | None = None) -> int:
         from . import serve
 
         return serve.main(argv[1:])
+    # `ml_ops continuous ...` is the windowed streaming-ingestion mode
+    # (runner/continuous.py): a standing train-and-serve loop — ring-
+    # buffered corpus window, warm-start EM refreshes, drift-gated
+    # fleet publishes — rather than a per-day batch run, so it routes
+    # before the YYYYMMDD parser like serve.
+    if argv and argv[0] == "continuous":
+        from . import continuous
+
+        return continuous.main(argv[1:])
     # `ml_ops lint ...` is the static-analysis gate (oni_ml_tpu/analysis)
     # — same engine as tools/graftlint.py and the oni-graftlint console
     # script; routes before the YYYYMMDD parser like serve.

@@ -284,6 +284,25 @@ def _patch_phases(bench, monkeypatch):
         },
     )
     monkeypatch.setattr(
+        bench, "bench_streaming_freshness",
+        lambda *a, **k: {
+            "dsource": "flow", "tenant": "stream", "slices": 96,
+            "events": 40_000, "refreshes": 47, "publishes": 47,
+            "vetoes": 0, "freshness_p50_s": 0.4,
+            "freshness_p99_s": 2.4, "freshness_event_p50_min": 14.4,
+            "freshness_event_p99_min": 29.0, "freshness_samples": 95,
+            "warm": {"fits": 46, "mean_wall_s": 0.06,
+                     "mean_em_iters": 5.4},
+            "fresh": {"fits": 1, "mean_wall_s": 1.2,
+                      "mean_em_iters": 74.0},
+            "fresh_control": {"warm_start_speedup": 4.3,
+                              "held_out_ll_delta": -0.36},
+            "warm_start_speedup": 4.3, "held_out_ll": -6.08,
+            "held_out_ll_delta": -0.36, "retraces_after_warmup": 0,
+            "replay_speed": 1440.0,
+        },
+    )
+    monkeypatch.setattr(
         bench, "bench_distributed_em",
         lambda *a, **k: {
             "nprocs": 2, "docs": 2048, "em_iters": 6, "em_shards": 8,
@@ -444,6 +463,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "serving_slo",
         "serving_slo_fleet",
         "serving_slo_fleet_paged",
+        "streaming_freshness",
         "distributed_em",
         "pipeline_e2e",
         "pipeline_e2e_dns",

@@ -1,0 +1,661 @@
+"""Continuous ingestion: the ring-buffered corpus window, warm-start
+EM, the drift-gated publish path, and the day-replay/bench plumbing.
+
+The two contracts this file pins hardest:
+
+* window id discipline — word ids are window-global, first-seen, and
+  survive eviction, which is what makes warm-started beta rows mean
+  the same words refresh-over-refresh;
+* the publish gate — a deliberately drifted window produces
+  `publish_gate: vetoed`, the fleet keeps serving the PRIOR version
+  with bit-identical scores through the vetoed refresh, and a
+  recovered window (drifted chunks evicted) publishes again.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+))
+
+from oni_ml_tpu.config import (  # noqa: E402
+    ContinuousConfig,
+    LDAConfig,
+    OnlineLDAConfig,
+    PipelineConfig,
+)
+from oni_ml_tpu.dataplane import CorpusWindow, pow2_capacity  # noqa: E402
+from oni_ml_tpu.io import Corpus  # noqa: E402
+from oni_ml_tpu.models import (  # noqa: E402
+    DriftDetector,
+    OnlineLDATrainer,
+    WindowTrainer,
+    warm_start_log_beta,
+)
+from oni_ml_tpu.runner.continuous import (  # noqa: E402
+    ContinuousService,
+    IngestSlice,
+    paced_slices,
+    slice_events,
+)
+
+
+# ---------------------------------------------------------------------------
+# CorpusWindow
+# ---------------------------------------------------------------------------
+
+
+def _triples(rng, ips, words, n):
+    return [
+        (str(rng.choice(ips)), str(rng.choice(words)),
+         int(rng.integers(1, 5)))
+        for _ in range(n)
+    ]
+
+
+def test_window_snapshot_matches_batch_corpus():
+    """A window that evicted nothing assembles the same corpus the
+    batch path would build from the concatenated triples (modulo the
+    pow2 vocab padding tail)."""
+    rng = np.random.default_rng(0)
+    ips = [f"ip{i}" for i in range(12)]
+    words = [f"w{i}" for i in range(20)]
+    # Unique (ip, word) pairs per stream so aggregation can't differ.
+    all_trips = []
+    seen = set()
+    for t in _triples(rng, ips, words, 400):
+        if (t[0], t[1]) in seen:
+            continue
+        seen.add((t[0], t[1]))
+        all_trips.append(t)
+    w = CorpusWindow(1e9, vocab_floor=4)
+    third = len(all_trips) // 3
+    w.ingest_triples(all_trips[:third], 0, 100)
+    w.ingest_triples(all_trips[third:2 * third], 100, 200)
+    w.ingest_triples(all_trips[2 * third:], 200, 300)
+    snap = w.snapshot()
+    batch = Corpus.from_word_counts(all_trips)
+    assert snap.real_vocab == batch.num_terms
+    assert snap.corpus.vocab[:snap.real_vocab] == batch.vocab
+    assert snap.corpus.doc_names == batch.doc_names
+    np.testing.assert_array_equal(snap.corpus.doc_ptr, batch.doc_ptr)
+    np.testing.assert_array_equal(snap.corpus.word_idx, batch.word_idx)
+    np.testing.assert_array_equal(snap.corpus.counts, batch.counts)
+    # pow2 capacity tier with inert pad words.
+    assert snap.vocab_capacity == pow2_capacity(batch.num_terms, 4)
+    assert snap.corpus.num_terms == snap.vocab_capacity
+    assert all(v.startswith("__pad")
+               for v in snap.corpus.vocab[snap.real_vocab:])
+
+
+def test_window_duplicate_pairs_aggregate_across_chunks():
+    w = CorpusWindow(1e9, vocab_floor=4)
+    w.ingest_triples([("a", "x", 2), ("a", "y", 1)], 0, 10)
+    w.ingest_triples([("a", "x", 3), ("b", "x", 1)], 10, 20)
+    snap = w.snapshot()
+    c = snap.corpus
+    assert c.doc_names == ["a", "b"]
+    # Doc a: x summed 2+3, y once.
+    a_words = {
+        c.vocab[int(c.word_idx[j])]: int(c.counts[j])
+        for j in range(int(c.doc_ptr[0]), int(c.doc_ptr[1]))
+    }
+    assert a_words == {"x": 5, "y": 1}
+
+
+def test_window_vocab_ids_survive_eviction():
+    """First-seen word ids are window-global: eviction retires counts,
+    never ids — the warm-start contract."""
+    w = CorpusWindow(100.0, vocab_floor=4)
+    w.ingest_triples([("a", f"w{i}", 1) for i in range(10)], 0, 50)
+    ids_before = dict(w._words.ids)
+    rec = w.advance(200.0)        # horizon 100 evicts the chunk
+    assert rec["evicted_chunks"] == 1 and w.live_chunks == 0
+    w.ingest_triples([("b", f"w{i}", 1) for i in range(5, 15)], 150, 200)
+    for i in range(5, 10):
+        assert w._words.ids[f"w{i}"] == ids_before[f"w{i}"]
+    assert w.vocab_size == 15     # grew, never shrank
+    snap = w.snapshot()
+    # Evicted words keep their (zero-count) vocab slots.
+    assert snap.real_vocab == 15
+    assert snap.corpus.doc_names == ["b"]
+
+
+def test_window_advance_is_o_evicted_and_journaled():
+    records = []
+
+    class _J:
+        def append(self, rec):
+            records.append(rec)
+
+    w = CorpusWindow(100.0, vocab_floor=4, journal=_J())
+    for i in range(5):
+        w.ingest_triples([("a", "x", 1)], i * 50, i * 50 + 50)
+    rec = w.advance(300.0)        # horizon 200: evicts spans ending <= 200
+    assert rec["kind"] == "window_advance"
+    assert rec["evicted_chunks"] == 4 and rec["chunks"] == 1
+    assert w.evicted_rows == 4
+    assert records and records[-1]["kind"] == "window_advance"
+    assert "advance_s" in records[-1]
+
+
+def test_window_rejects_stream_disorder():
+    w = CorpusWindow(100.0)
+    w.ingest_triples([("a", "x", 1)], 0, 50)
+    with pytest.raises(ValueError, match="stream order"):
+        w.ingest_triples([("a", "y", 1)], 0, 40)
+    with pytest.raises(ValueError, match="inverted"):
+        w.ingest_triples([("a", "y", 1)], 90, 80)
+
+
+def test_pow2_capacity_tiers():
+    assert pow2_capacity(3, 8) == 8
+    assert pow2_capacity(9, 8) == 16
+    assert pow2_capacity(16, 8) == 16
+    assert pow2_capacity(1000, 8) == 1024
+
+
+# ---------------------------------------------------------------------------
+# Warm-start seeding (batch EM + online SVI vocab growth)
+# ---------------------------------------------------------------------------
+
+
+def test_warm_start_log_beta_pads_from_symmetric_prior():
+    rng = np.random.default_rng(1)
+    k, v0, v1 = 4, 10, 16
+    p = rng.dirichlet(np.ones(v0), size=k).T       # [V0, K]
+    lb = warm_start_log_beta(p, v1)
+    assert lb.shape == (k, v1)
+    beta = np.exp(lb)
+    np.testing.assert_allclose(beta.sum(axis=1), 1.0, rtol=1e-12)
+    # Old words keep their relative proportions; new words carry small
+    # but trainable mass (never the LOG_ZERO floor).
+    ratio = beta[:, :v0] / p.T
+    np.testing.assert_allclose(
+        ratio, np.broadcast_to(ratio[:, :1], ratio.shape), rtol=1e-9
+    )
+    assert (beta[:, v0:] > 0).all()
+    assert (lb[:, v0:] > -200).all()
+
+
+def test_warm_start_log_beta_refuses_shrink():
+    p = np.full((8, 4), 1.0 / 8)
+    with pytest.raises(ValueError, match="shrink"):
+        warm_start_log_beta(p, 4)
+
+
+def test_from_topic_probs_accepts_grown_vocabulary():
+    """Satellite regression: day N words absent from day N−1 pad new
+    lambda rows from the symmetric prior instead of erroring."""
+    rng = np.random.default_rng(2)
+    cfg = OnlineLDAConfig(num_topics=3)
+    v0, v1 = 12, 20
+    p = rng.dirichlet(np.ones(v0), size=3).T
+    tr = OnlineLDATrainer.from_topic_probs(
+        cfg, p, total_docs=100, num_terms=v1
+    )
+    lam = np.asarray(tr.lam)
+    assert lam.shape == (3, v1)
+    # Grown rows: prior-only lambda (p contributed nothing).
+    np.testing.assert_allclose(lam[:, v0:], cfg.eta, rtol=1e-5)
+    # Old rows still encode the seeded topics.
+    assert (lam[:, :v0] > cfg.eta).any()
+    with pytest.raises(ValueError, match="SHRINK"):
+        OnlineLDATrainer.from_topic_probs(
+            cfg, p, total_docs=100, num_terms=v0 - 1
+        )
+
+
+def _structured_corpus(rng, docs=60, v=64, k=3, tokens=30):
+    """Topic-structured synthetic corpus: documents draw words from one
+    of k disjoint vocabulary blocks (plus noise), so EM has real
+    structure to find and warm starts have something to preserve."""
+    trips = []
+    block = v // k
+    for d in range(docs):
+        t = d % k
+        for _ in range(tokens):
+            if rng.random() < 0.9:
+                wid = t * block + int(rng.integers(0, block))
+            else:
+                wid = int(rng.integers(0, v))
+            trips.append((f"ip{d}", f"w{wid}", 1))
+    return Corpus.from_word_counts(trips)
+
+
+def test_window_trainer_warm_start_saves_iterations():
+    """Warm-started EM early-exits on the existing f64 convergence
+    check in a fraction of the fresh fit's iterations, at matched
+    held-out likelihood — the streaming_freshness bench's claim, at
+    test scale."""
+    rng = np.random.default_rng(3)
+    corpus = _structured_corpus(rng)
+    cfg = LDAConfig(num_topics=3, batch_size=64, fused_em_chunk=1,
+                    em_max_iters=60, seed=1)
+    tr = WindowTrainer(cfg, corpus.num_terms)
+    fresh = tr.fit(corpus)
+    assert fresh.plan["warm_start"]["value"] is False
+    probs = np.exp(fresh.log_beta).T       # [V, K]
+    warm = tr.fit(corpus, topic_probs=probs, alpha=fresh.alpha)
+    assert warm.plan["warm_start"]["value"] is True
+    assert warm.em_iters < fresh.em_iters / 2
+    det = DriftDetector(tol_nats=0.5)
+    ll_fresh, _ = det.evaluate(fresh.log_beta, fresh.alpha, corpus,
+                               holdout_frac=0.3)
+    ll_warm, _ = det.evaluate(warm.log_beta, warm.alpha, corpus,
+                              holdout_frac=0.3)
+    assert abs(ll_warm - ll_fresh) < 0.5   # matched within drift tol
+
+
+def test_window_trainer_rejects_wrong_tier():
+    cfg = LDAConfig(num_topics=3, fused_em_chunk=1)
+    tr = WindowTrainer(cfg, 64)
+    corpus = _structured_corpus(np.random.default_rng(4), docs=10, v=32)
+    with pytest.raises(ValueError, match="capacity tier"):
+        tr.fit(corpus)
+
+
+# ---------------------------------------------------------------------------
+# DriftDetector
+# ---------------------------------------------------------------------------
+
+
+def test_drift_detector_flags_regression_and_recovers():
+    records = []
+
+    class _J:
+        def append(self, rec):
+            records.append(rec)
+
+    d = DriftDetector(tol_nats=0.3, min_history=2, journal=_J())
+    for ll in (-4.0, -4.05, -3.95):
+        dec = d.check(ll)
+        assert not dec.drifted
+    assert d.baseline is not None
+    bad = d.check(-5.0)
+    assert bad.drifted and bad.delta < -0.3
+    assert d.mode == "fresh"               # next refresh trains fresh
+    # The drifted value did NOT poison the baseline.
+    good = d.check(-4.02)
+    assert not good.drifted
+    assert d.mode == "warm"
+    checks = [r for r in records if r["kind"] == "drift_check"]
+    assert len(checks) == 5
+    assert checks[3]["drifted"] is True
+    assert checks[3]["baseline_ll"] is not None
+    # Gate: veto on drift, publish otherwise — both journaled.
+    assert d.gate(bad, version=7, tenant="t") is False
+    assert d.gate(good, version=7, tenant="t") is True
+    gates = [r for r in records if r["kind"] == "publish_gate"]
+    assert [g["action"] for g in gates] == ["vetoed", "published"]
+    assert d.vetoes == 1 and d.publishes == 1
+
+
+def test_drift_detector_primes_from_journal():
+    d = DriftDetector(tol_nats=0.3, min_history=2)
+    replayed = [
+        {"kind": "drift_check", "ll": -4.0, "drifted": False},
+        {"kind": "drift_check", "ll": -4.1, "drifted": False},
+        {"kind": "drift_check", "ll": -9.0, "drifted": True},
+        {"kind": "other"},
+    ]
+    assert d.prime(replayed) == 2          # drifted checks excluded
+    assert d.baseline == pytest.approx(-4.05)
+
+
+# ---------------------------------------------------------------------------
+# Slicing / pacing
+# ---------------------------------------------------------------------------
+
+
+def _flow_line(rng, sip, dip, dport, h=None):
+    h = int(rng.integers(0, 24)) if h is None else h
+    return (
+        "2016-01-22 00:00:00,2016,1,22,"
+        f"{h},{int(rng.integers(0, 60))},{int(rng.integers(0, 60))},0.0,"
+        f"{sip},{dip},{int(rng.integers(1024, 60000))},{dport},TCP,,0,0,"
+        f"{int(rng.integers(1, 100))},{int(rng.integers(40, 100000))},"
+        "0,0,0,0,0,0,0,0,0"
+    )
+
+
+def test_slice_events_orders_and_buckets():
+    def line(h):
+        return ("2016-01-22 00:00:00,2016,1,22,"
+                f"{h},0,0,0.0,10.0.0.1,10.1.0.1,4000,80,TCP,,0,0,"
+                "5,400,0,0,0,0,0,0,0,0,0")
+
+    lines = [line(h) for h in (3, 0, 2, 1, 0, 3)]
+    slices = slice_events(lines, "flow", 3600.0)
+    assert [s.events for s in slices] == [2, 1, 1, 2]
+    assert all(s.t1 - s.t0 == 3600.0 for s in slices)
+    lasts = [max(int(ln.split(",")[4]) for ln in s.lines)
+             for s in slices]
+    assert lasts == sorted(lasts)
+
+
+def test_slice_events_skips_header_and_garbage():
+    """Real reference day files carry a header row (featurize_flow
+    strips it); the slicer must skip unparsable lines, not crash."""
+    def line(h):
+        return ("2016-01-22 00:00:00,2016,1,22,"
+                f"{h},0,0,0.0,10.0.0.1,10.1.0.1,4000,80,TCP,,0,0,"
+                "5,400,0,0,0,0,0,0,0,0,0")
+
+    lines = ["treceived,unix_tsecs,year,month,hour\n",  # header
+             line(0), "garbage,row\n", line(1), "\n"]
+    slices = slice_events(lines, "flow", 3600.0)
+    assert sum(s.events for s in slices) == 2
+
+
+def test_paced_slices_stamps_arrivals():
+    slices = [IngestSlice(lines=["x"], t0=0, t1=600, index=0),
+              IngestSlice(lines=["y"], t0=600, t1=1200, index=1)]
+    out = list(paced_slices(slices, float("inf")))
+    assert all(s.arrival_wall > 0 for s in out)
+    assert out[1].arrival_wall >= out[0].arrival_wall
+
+
+# ---------------------------------------------------------------------------
+# The drift-gated continuous service (the pinned veto regression)
+# ---------------------------------------------------------------------------
+
+
+def _service(tmp_path, **cc_kw):
+    import dataclasses
+
+    config = PipelineConfig(
+        data_dir=str(tmp_path),
+        continuous=ContinuousConfig(
+            window_s=1800.0, refresh_every_s=1200.0,
+            min_refresh_docs=8, drift_tol_nats=0.8,
+            drift_min_history=2, vocab_floor=512, batch_size=64,
+            holdout_frac=0.3, **cc_kw,
+        ),
+    )
+    config = dataclasses.replace(
+        config,
+        lda=dataclasses.replace(config.lda, num_topics=4,
+                                em_max_iters=30),
+    )
+    return ContinuousService(
+        config, "flow", out_dir=str(tmp_path / "cont"),
+        warmup_refreshes=2,
+    )
+
+
+def _normal_slice(rng, idx, n=220):
+    ports = (80, 443, 22, 53)
+    lines = [
+        _flow_line(rng, f"10.0.0.{int(rng.integers(0, 24))}",
+                   f"10.1.0.{int(rng.integers(0, 12))}",
+                   ports[int(rng.integers(0, len(ports)))])
+        for _ in range(n)
+    ]
+    return IngestSlice(lines=lines, t0=idx * 600.0,
+                       t1=(idx + 1) * 600.0, index=idx)
+
+
+def _drifted_slice(rng, idx, n=220):
+    """High-entropy word space: uniform-random service ports explode
+    the vocabulary, so the window's model scores its own held-out docs
+    nats worse than the healthy baseline."""
+    lines = [
+        _flow_line(rng, f"10.0.0.{int(rng.integers(0, 24))}",
+                   f"10.1.0.{int(rng.integers(0, 12))}",
+                   int(rng.integers(1, 60000)))
+        for _ in range(n)
+    ]
+    return IngestSlice(lines=lines, t0=idx * 600.0,
+                       t1=(idx + 1) * 600.0, index=idx)
+
+
+def test_drift_gate_vetoes_and_fleet_serves_prior_bits(tmp_path):
+    """THE acceptance pin: a deliberately drifted window produces
+    `publish_gate: vetoed`, the fleet keeps scoring BIT-IDENTICALLY on
+    the prior version through the vetoed refresh, and a recovered
+    window (drifted chunks evicted) publishes again."""
+    from oni_ml_tpu.serving.events import score_features
+
+    rng = np.random.default_rng(7)
+    svc = _service(tmp_path)
+    try:
+        idx = 0
+        # Healthy stream: slices 0..5, refreshes at slice 1, 3, 5.
+        for _ in range(6):
+            svc.ingest_slice(_normal_slice(rng, idx))
+            svc.maybe_refresh(idx * 600.0 + 600.0)
+            idx += 1
+        assert svc.drift.publishes >= 2
+        assert svc.drift.vetoes == 0
+        v_before = svc.fleet.version(svc.tenant)
+        snap_before = svc.fleet.active(svc.tenant)
+
+        # Fixed probe set scored under the serving model, both through
+        # the model directly (f64 host path) and the FleetScorer.
+        probe = [_flow_line(rng, "10.0.0.1", "10.1.0.2", 80)
+                 for _ in range(16)]
+        feats = svc.scorer._lanes[svc.tenant].featurizer(probe)
+        scores_before = score_features(snap_before.model, feats, "flow")
+        futs = [svc.scorer.submit(svc.tenant, ln) for ln in probe]
+        svc.scorer.flush()
+        resolved = [f.result(timeout=30.0) for f in futs]
+        served_before = np.asarray([s for s, _ in resolved], np.float64)
+        assert all(v == v_before for _, v in resolved)
+
+        # Drifted window: two high-entropy slices fill the 3-slice
+        # window past the next refresh boundary.
+        for _ in range(2):
+            svc.ingest_slice(_drifted_slice(rng, idx))
+            svc.maybe_refresh(idx * 600.0 + 600.0)
+            idx += 1
+        assert svc.drift.vetoes >= 1
+        # The fleet never saw the drifted model: same version, same
+        # snapshot object, bit-identical scores on the probe set.
+        assert svc.fleet.version(svc.tenant) == v_before
+        snap_after = svc.fleet.active(svc.tenant)
+        assert snap_after.version == snap_before.version
+        scores_after = score_features(snap_after.model, feats, "flow")
+        np.testing.assert_array_equal(scores_before, scores_after)
+        futs = [svc.scorer.submit(svc.tenant, ln) for ln in probe]
+        svc.scorer.flush()
+        resolved = [f.result(timeout=30.0) for f in futs]
+        served_after = np.asarray([s for s, _ in resolved], np.float64)
+        # Still the PRIOR version, bit-identical scores, through the
+        # serving path itself.
+        assert all(v == v_before for _, v in resolved)
+        np.testing.assert_array_equal(served_before, served_after)
+
+        # Recovery: healthy slices age the drifted chunks out of the
+        # 1800 s window; the next refresh publishes.
+        for _ in range(6):
+            svc.ingest_slice(_normal_slice(rng, idx))
+            svc.maybe_refresh(idx * 600.0 + 600.0)
+            idx += 1
+        assert svc.fleet.version(svc.tenant) > v_before
+        # Journal carries the full verdict trail.
+        payload = svc.close()
+        jpath = tmp_path / "cont" / "run_journal.jsonl"
+        records = [json.loads(ln) for ln in open(jpath)]
+        gates = [r for r in records if r.get("kind") == "publish_gate"]
+        assert any(g["action"] == "vetoed" for g in gates)
+        assert any(g["action"] == "published" for g in gates)
+        # Recovered: the LAST gate decision, on a clean window, is a
+        # publish (vetoes during the transition — drifted chunks still
+        # in-window — are the detector doing its job).
+        assert gates[-1]["action"] == "published"
+        checks = [r for r in records if r.get("kind") == "drift_check"]
+        assert any(c["drifted"] for c in checks)
+        assert any(r.get("kind") == "window_advance" for r in records)
+        assert payload["vetoes"] >= 1
+        assert payload["publishes"] >= 3
+        assert payload["freshness_samples"] > 0
+    finally:
+        if svc.scorer is not None:
+            svc.close()
+
+
+def test_continuous_run_end_to_end_payload(tmp_path):
+    """Smoke the run()/close() path: a short healthy stream produces a
+    payload with freshness quantiles, warm/fresh fit stats, and the
+    metrics file + journal on disk."""
+    rng = np.random.default_rng(11)
+    svc = _service(tmp_path)
+    slices = [_normal_slice(rng, i, n=180) for i in range(5)]
+    # A malformed event in a post-publish slice must be SHED (counted),
+    # never kill the standing service.
+    slices[4].lines.append("not,a,flow,line")
+    payload = svc.run(paced_slices(slices, float("inf")))
+    assert payload["slices"] == 5
+    assert payload["publishes"] >= 1
+    assert payload["events_rejected"] == 1
+    # The scored product sink exists: flagged events stream to disk.
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "cont"), "flagged_events.jsonl")
+    )
+    assert "flagged" in payload
+    assert payload["freshness_p50_s"] is not None
+    assert payload["freshness_event_p50_min"] > 0
+    assert payload["warm"]["fits"] + payload["fresh"]["fits"] \
+        == payload["refreshes"]
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "cont"), "continuous_metrics.json")
+    )
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "cont"), "run_journal.jsonl")
+    )
+
+
+def test_day_replay_cli_smoke(tmp_path):
+    """tools/day_replay.py end-to-end on a tiny synthetic day."""
+    import day_replay
+
+    rng = np.random.default_rng(13)
+    day = tmp_path / "day.csv"
+    with open(day, "w") as f:
+        for h in range(4):
+            for _ in range(160):
+                f.write(_flow_line(rng, f"10.0.0.{int(rng.integers(0, 32))}",
+                                   f"10.1.0.{int(rng.integers(0, 16))}",
+                                   80, h=h) + "\n")
+    rc = day_replay.main([
+        str(day), "--dsource", "flow", "--slice-s", "3600",
+        "--no-sleep", "--window-s", "7200", "--refresh-s", "3600",
+        "--out-dir", str(tmp_path / "cont"),
+    ])
+    assert rc == 0
+    payload = json.load(open(tmp_path / "cont"
+                             / "continuous_metrics.json"))
+    assert payload["slices"] == 4
+    assert payload["refreshes"] >= 2
+    assert payload["events"] == 640
+
+
+# ---------------------------------------------------------------------------
+# bench_diff direction keys
+# ---------------------------------------------------------------------------
+
+
+def _stream_payload(**over):
+    base = {
+        "freshness_p50_s": 1.0, "freshness_p99_s": 5.0,
+        "freshness_event_p50_min": 15.0, "freshness_event_p99_min": 30.0,
+        "warm_start_speedup": 4.0, "held_out_ll": -5.0,
+    }
+    base.update(over)
+    return base
+
+
+def test_bench_diff_streaming_direction_keys():
+    import bench_diff
+
+    old = {"metric": "m", "value": 1.0, "unit": "x",
+           "secondary": {"streaming_freshness": _stream_payload()}}
+
+    def rows_for(**over):
+        new = {"metric": "m", "value": 1.0, "unit": "x",
+               "secondary": {
+                   "streaming_freshness": _stream_payload(**over)}}
+        return bench_diff.diff_payloads(old, new)
+
+    # Freshness latency UP -> regression (lower-better).
+    rows = rows_for(freshness_p99_s=10.0)
+    assert any(r["regression"] and "freshness_p99_s" in r["name"]
+               for r in rows)
+    # Event-time freshness UP -> regression.
+    rows = rows_for(freshness_event_p50_min=40.0)
+    assert any(r["regression"]
+               and "freshness_event_p50_min" in r["name"] for r in rows)
+    # Warm-start speedup DOWN -> regression (higher-better).
+    rows = rows_for(warm_start_speedup=1.1)
+    assert any(r["regression"] and "warm_start_speedup" in r["name"]
+               for r in rows)
+    # Held-out LL: absolute drop beyond the nats budget -> regression;
+    # small wobble -> clean.
+    rows = rows_for(held_out_ll=-5.6)
+    assert any(r["regression"] and "held_out_ll" in r["name"]
+               for r in rows)
+    rows = rows_for(held_out_ll=-5.1)
+    assert not any(r["regression"] and "held_out_ll" in r["name"]
+                   for r in rows)
+    # Improvements in every direction -> no streaming regressions.
+    rows = rows_for(freshness_p50_s=0.5, freshness_p99_s=2.0,
+                    freshness_event_p50_min=10.0,
+                    freshness_event_p99_min=20.0,
+                    warm_start_speedup=6.0, held_out_ll=-4.8)
+    assert not any(r["regression"] for r in rows
+                   if "streaming" in r["name"])
+
+
+def test_bench_diff_streaming_headline_form():
+    import bench_diff
+
+    old = _stream_payload()
+    new = _stream_payload(freshness_p50_s=3.0)
+    rows = bench_diff.diff_payloads(old, new)
+    assert any(r["regression"] and r["name"] == "headline.freshness_p50_s"
+               for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# trace_view lanes
+# ---------------------------------------------------------------------------
+
+
+def test_trace_view_renders_continuous_records():
+    import trace_view
+
+    records = [
+        {"kind": "window_advance", "mono_ns": 1000, "chunks": 3,
+         "rows": 500, "vocab": 200, "evicted_chunks": 1,
+         "evicted_rows": 50},
+        {"kind": "drift_check", "mono_ns": 2000, "ll": -5.0,
+         "baseline_ll": -4.8, "delta": -0.2, "drifted": False},
+        {"kind": "drift_check", "mono_ns": 3000, "ll": -7.0,
+         "baseline_ll": -4.8, "delta": -2.2, "drifted": True},
+        {"kind": "publish_gate", "mono_ns": 4000, "action": "vetoed",
+         "version": 3, "ll": -7.0},
+        {"kind": "publish_gate", "mono_ns": 5000,
+         "action": "published", "version": 4, "ll": -4.9},
+        {"kind": "freshness", "mono_ns": 6000, "slices": 2,
+         "wall_max_s": 1.5, "event_max_s": 900.0},
+    ]
+    trace = trace_view.journal_to_trace(records)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "window occupancy" in names
+    assert "window evict" in names
+    assert "drift held-out ll" in names
+    assert "DRIFT" in names
+    assert "publish VETOED" in names
+    assert "publish gate: published" in names
+    assert "freshness max" in names
+    table = trace_view.continuous_table(records)
+    assert table["published"] == 1 and table["vetoed"] == 1
+    assert table["drifts"] == 1
+    assert table["worst_freshness_s"] == 1.5

@@ -60,6 +60,37 @@ _RESIDENCY_KEYS = (
     ("promotion_stall_s", "s"),          # lower-better
 )
 
+# Continuous-ingestion freshness phase: direction per key — freshness
+# latencies (wall seconds AND speed-invariant event-time minutes) are
+# lower-better, the warm-start speedup (fresh wall / warm wall at
+# matched held-out likelihood) is higher-better.  The held-out
+# likelihood itself gates on ABSOLUTE drop (nats — a relative delta on
+# a negative log-likelihood is meaningless), like overlap_efficiency.
+_STREAMING_PHASE = "streaming_freshness"
+_STREAMING_KEYS = (
+    ("freshness_p50_s", "s"),              # lower-better
+    ("freshness_p99_s", "s"),
+    ("freshness_event_p50_min", "min"),    # minutes; latency direction
+    ("freshness_event_p99_min", "min"),
+    ("warm_start_speedup", "x"),           # higher-better
+)
+
+
+def _streaming_rows(name: str, old: dict, new: dict,
+                    threshold_pct: float, ll_drop: float) -> "list[dict]":
+    rows = []
+    for key, unit in _STREAMING_KEYS:
+        r = _rel_row(f"{name}.{key}", old.get(key), new.get(key), unit,
+                     threshold_pct)
+        if r:
+            rows.append(r)
+    r = _abs_row(f"{name}.held_out_ll", old.get("held_out_ll"),
+                 new.get("held_out_ll"), "nats", ll_drop)
+    if r:
+        rows.append(r)
+    return rows
+
+
 # Distributed-EM scaling phase: direction per key — scaling efficiency
 # is a fraction of ideal speedup (higher-better), the per-iteration
 # allreduce wall is dead time on the EM critical path (lower-better).
@@ -153,7 +184,8 @@ def _higher_is_better(unit: str) -> bool:
     u = (unit or "").lower()
     if "/" in u:          # docs/sec, events/sec, ...
         return True
-    return u not in ("seconds", "second", "s", "ms", "milliseconds")
+    return u not in ("seconds", "second", "s", "ms", "milliseconds",
+                     "min", "minutes")
 
 
 def _rel_row(name: str, old, new, unit: str, threshold_pct: float):
@@ -188,7 +220,8 @@ def _abs_row(name: str, old, new, unit: str, max_drop: float):
 
 def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
                   efficiency_drop: float = 0.05,
-                  util_drop_pct: float = 2.0) -> "list[dict]":
+                  util_drop_pct: float = 2.0,
+                  ll_drop: float = 0.25) -> "list[dict]":
     rows = []
     # Headline.
     r = _rel_row(
@@ -240,6 +273,16 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
                                       threshold_pct))
     if "scaling_efficiency" in old and "scaling_efficiency" in new:
         rows.extend(_distributed_rows("headline", old, new, threshold_pct))
+    # Streaming-freshness keys (freshness latencies lower-better,
+    # warm-start speedup higher-better, held-out LL absolute-drop
+    # gated) — phase payloads and freshness-headline captures.
+    o, n = old_sec.get(_STREAMING_PHASE), new_sec.get(_STREAMING_PHASE)
+    if isinstance(o, dict) and isinstance(n, dict):
+        rows.extend(_streaming_rows(f"phase:{_STREAMING_PHASE}", o, n,
+                                    threshold_pct, ll_drop))
+    if "freshness_p50_s" in old and "freshness_p50_s" in new:
+        rows.extend(_streaming_rows("headline", old, new,
+                                    threshold_pct, ll_drop))
     # Streaming-dataplane overlap efficiency (absolute fraction).
     for name in _OVERLAP_PHASES:
         o, n = old_sec.get(name), new_sec.get(name)
@@ -271,6 +314,10 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--util-drop-pct", type=float, default=2.0,
                     help="max tolerated absolute drop in utilization "
                     "percentage points (default 2.0)")
+    ap.add_argument("--ll-drop", type=float, default=0.25,
+                    help="max tolerated absolute drop in the streaming "
+                    "phase's held-out per-token log-likelihood, in "
+                    "nats (default 0.25)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the comparison rows as JSON")
     args = ap.parse_args(argv)
@@ -281,7 +328,8 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"bench_diff: {e}", file=sys.stderr)
         return 2
     rows = diff_payloads(old, new, args.threshold_pct,
-                         args.efficiency_drop, args.util_drop_pct)
+                         args.efficiency_drop, args.util_drop_pct,
+                         args.ll_drop)
     if not rows:
         print("bench_diff: no comparable metrics between the two "
               "payloads", file=sys.stderr)

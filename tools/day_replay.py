@@ -1,0 +1,146 @@
+"""Pace a historical day's raw CSV into the continuous-mode ingest.
+
+The streaming_freshness bench (and the continuous-mode tests) need
+realistic arrival patterns, not a file handed over at once: this tool
+replays a completed day — the exact CSV the batch pipeline would have
+eaten tomorrow — as the event stream it originally was, at ×N
+real-time speed, through `ml_ops continuous`'s service loop
+(oni_ml_tpu/runner/continuous.py).
+
+Slicing is event-time-ordered (`slice_events`: flow rows by their
+hour/minute/second columns, DNS rows by unix_tstamp) and pacing is the
+load generator's open-loop discipline (tools/load_gen.py): each
+slice's delivery wall-time is its event-time offset divided by the
+speed factor, and a delivery that falls behind schedule is not dropped
+— the backlog shows up as freshness latency, exactly like a real
+overloaded ingest.  `--jitter` additionally spreads each slice's
+delivery inside its span with a Poisson draw from
+`load_gen.arrival_offsets`, so burst-shaped arrivals can be replayed
+without editing the day file.
+
+Usage:
+
+    python tools/day_replay.py DAY.csv --dsource flow --speed 720 \
+        --slice-s 300 --out-dir /tmp/continuous [--fresh-control]
+
+At --speed 720 a 24-hour day replays in two wall minutes; the payload
+(last stdout line) is the same summary `ml_ops continuous` prints:
+freshness quantiles, warm-vs-fresh walls, publish/veto counts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from oni_ml_tpu.runner.continuous import (  # noqa: E402
+    paced_slices,
+    run_continuous,
+    slice_events,
+)
+
+
+def replay_slices(path: str, dsource: str, *, slice_s: float,
+                  speed: float, limit: "int | None" = None,
+                  jitter_seed: "int | None" = None,
+                  sleep=time.sleep):
+    """Slice a day CSV and yield paced IngestSlices at ×`speed`.
+
+    `limit` caps the event count (bench/test budgets); `jitter_seed`
+    turns on within-span Poisson delivery jitter via load_gen's
+    arrival_offsets (None = deliver each slice at its span end)."""
+    with open(path) as f:
+        lines = f.readlines()
+    if limit is not None:
+        lines = lines[:limit]
+    slices = slice_events(lines, dsource, slice_s)
+    if jitter_seed is not None:
+        import load_gen
+
+        rng = np.random.default_rng(jitter_seed)
+        for sl in slices:
+            # One Poisson inter-arrival draw per slice: shift the
+            # slice's effective delivery point inside its span so
+            # replayed arrivals are not metronome-regular.
+            off = load_gen.arrival_offsets(
+                "poisson", 1, 1.0 / max(slice_s, 1e-9),
+                seed=int(rng.integers(0, 2**31)),
+            )[0]
+            sl.t1 = min(sl.t1, sl.t0 + max(off, 1e-3)) \
+                if off < slice_s else sl.t1
+    return paced_slices(slices, speed, sleep=sleep)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Replay a historical day CSV into continuous-mode "
+        "ingest at ×N real-time speed."
+    )
+    ap.add_argument("day_csv", help="raw flow/DNS CSV of one day")
+    ap.add_argument("--dsource", choices=["flow", "dns"],
+                    default="flow")
+    ap.add_argument("--speed", type=float, default=60.0,
+                    help="replay speed multiplier (60 = 1 event-hour "
+                    "per wall-minute); inf with --no-sleep")
+    ap.add_argument("--slice-s", type=float, default=300.0,
+                    help="ingest slice span in EVENT seconds")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="cap replayed events (bench/test budgets)")
+    ap.add_argument("--out-dir", default=None,
+                    help="service output dir (default: "
+                    "<day_csv dir>/continuous)")
+    ap.add_argument("--window-s", type=float, default=None)
+    ap.add_argument("--refresh-s", type=float, default=None)
+    ap.add_argument("--jitter-seed", type=int, default=None,
+                    help="Poisson within-slice delivery jitter "
+                    "(load_gen arrival_offsets)")
+    ap.add_argument("--fresh-control", action="store_true",
+                    help="measure one fresh fit against a warm "
+                    "refresh's snapshot (warm_start_speedup)")
+    ap.add_argument("--no-sleep", action="store_true",
+                    help="deliver as fast as consumed (tests/CI)")
+    ap.add_argument("--tenant", default="stream")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.day_csv):
+        print(f"day_replay: no such file {args.day_csv}",
+              file=sys.stderr)
+        return 2
+    import dataclasses
+
+    from oni_ml_tpu.config import PipelineConfig
+
+    config = PipelineConfig(
+        data_dir=os.path.dirname(os.path.abspath(args.day_csv)) or "."
+    )
+    overrides = {}
+    if args.window_s is not None:
+        overrides["window_s"] = args.window_s
+    if args.refresh_s is not None:
+        overrides["refresh_every_s"] = args.refresh_s
+    if overrides:
+        config = config.replace(continuous=dataclasses.replace(
+            config.continuous, **overrides))
+    out_dir = args.out_dir or os.path.join(config.data_dir, "continuous")
+    speed = float("inf") if args.no_sleep else args.speed
+    payload = run_continuous(
+        config, args.dsource,
+        replay_slices(args.day_csv, args.dsource,
+                      slice_s=args.slice_s, speed=speed,
+                      limit=args.limit, jitter_seed=args.jitter_seed),
+        out_dir=out_dir, tenant=args.tenant,
+        fresh_control=args.fresh_control,
+    )
+    print(json.dumps(payload), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -212,6 +212,72 @@ def journal_to_trace(records: "list[dict]") -> dict:
                          ("tenant", "policy", "for_tenant",
                           "spill_bytes") if k in rec},
             })
+        elif kind == "window_advance":
+            # Window-occupancy counter lanes (chunks/rows/vocab over
+            # time) next to the refresh spans: a rows curve that only
+            # climbs means eviction is not keeping up with ingest; a
+            # vocab curve crossing a pow2 boundary explains the one
+            # retrace family it minted.
+            events.append({
+                "name": "window occupancy", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"chunks": rec.get("chunks", 0),
+                         "rows": rec.get("rows", 0)},
+            })
+            events.append({
+                "name": "window vocab", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"vocab": rec.get("vocab", 0)},
+            })
+            if rec.get("evicted_chunks"):
+                events.append({
+                    "name": "window evict", "ph": "i", "s": "t",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {k: rec[k] for k in
+                             ("evicted_chunks", "evicted_rows")
+                             if k in rec},
+                })
+        elif kind == "drift_check":
+            # Held-out likelihood as a counter lane — the drift
+            # detector's input plotted over the run, with the baseline
+            # alongside so a veto is visibly "the ll curve fell out of
+            # its band", not a mystery bit.
+            args = {"held_out_ll": rec.get("ll")}
+            if isinstance(rec.get("baseline_ll"), (int, float)):
+                args["baseline_ll"] = rec["baseline_ll"]
+            events.append({
+                "name": "drift held-out ll", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0, "args": args,
+            })
+            if rec.get("drifted"):
+                events.append({
+                    "name": "DRIFT", "ph": "i", "s": "g",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"ll": rec.get("ll"),
+                             "delta": rec.get("delta")},
+                })
+        elif kind == "freshness":
+            # Freshness-latency counter lane: per publish, the worst
+            # newly-covered slice's arrival→servable gap (wall and
+            # event-time) — the continuous mode's headline, plotted
+            # where the publish instants land.
+            events.append({
+                "name": "freshness max", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"wall_s": rec.get("wall_max_s", 0),
+                         "event_s": rec.get("event_max_s", 0)},
+            })
+        elif kind == "publish_gate":
+            vetoed = rec.get("action") == "vetoed"
+            events.append({
+                "name": ("publish VETOED" if vetoed
+                         else "publish gate: published"),
+                "ph": "i", "s": "g" if vetoed else "t",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("version", "ll", "delta", "mode", "em_iters")
+                         if k in rec},
+            })
         elif kind == "backend_lost":
             events.append({
                 "name": "BACKEND LOST", "ph": "i", "s": "g",
@@ -337,6 +403,32 @@ def residency_table(records: "list[dict]") -> "list[dict]":
     return sorted(acc.values(), key=lambda r: -r["stall_s"])
 
 
+def continuous_table(records: "list[dict]") -> "dict | None":
+    """Continuous-ingestion rollup: window churn, drift verdicts, and
+    the publish gate's tally — the terminal answer to "is the stream
+    healthy and how fresh is serving"."""
+    adv = [r for r in records if r.get("kind") == "window_advance"]
+    checks = [r for r in records if r.get("kind") == "drift_check"]
+    gates = [r for r in records if r.get("kind") == "publish_gate"]
+    fresh = [r for r in records if r.get("kind") == "freshness"]
+    if not (adv or checks or gates):
+        return None
+    return {
+        "advances": len(adv),
+        "evicted_chunks": sum(r.get("evicted_chunks", 0) for r in adv),
+        "drift_checks": len(checks),
+        "drifts": sum(1 for r in checks if r.get("drifted")),
+        "published": sum(
+            1 for r in gates if r.get("action") == "published"
+        ),
+        "vetoed": sum(1 for r in gates if r.get("action") == "vetoed"),
+        "last_ll": checks[-1].get("ll") if checks else None,
+        "worst_freshness_s": max(
+            (r.get("wall_max_s", 0.0) for r in fresh), default=None
+        ),
+    }
+
+
 def print_summary(records: "list[dict]", dropped: int,
                   out=sys.stdout) -> None:
     rows = stage_summary(records)
@@ -397,6 +489,20 @@ def print_summary(records: "list[dict]", dropped: int,
                   f"{r['to_cold']:>7} {r['failures']:>8}", file=out)
         if len(res_rows) > 16:
             print(f"  ... {len(res_rows) - 16} more tenant(s)", file=out)
+    cont = continuous_table(records)
+    if cont:
+        print("continuous ingestion (window / drift / publish gate):",
+              file=out)
+        print(f"  advances={cont['advances']} "
+              f"evicted_chunks={cont['evicted_chunks']} "
+              f"drift_checks={cont['drift_checks']} "
+              f"drifts={cont['drifts']} published={cont['published']} "
+              f"vetoed={cont['vetoed']}", file=out)
+        if cont["last_ll"] is not None:
+            worst = cont["worst_freshness_s"]
+            print(f"  last held-out ll {cont['last_ll']}"
+                  + (f", worst freshness {worst:.3f}s"
+                     if worst is not None else ""), file=out)
     tasks = dataplane_task_table(records)
     if tasks:
         hidden = sum(t["wall_s"] for t in tasks if t["ok"])
