@@ -1810,6 +1810,54 @@ def bench_serving_slo_fleet_paged(n_tenants=256, zipf_s=1.1,
     )
 
 
+def bench_serving_slo_replicated(replica_counts=(1, 2, 4),
+                                 n_tenants=256, zipf_s=1.1,
+                                 events_per_replica=3072,
+                                 chaos_events=4096,
+                                 chaos_rate_eps=1500.0,
+                                 route_window=64, max_wait_ms=20.0):
+    """Replicated elastic serving (serving/router.py + replica.py +
+    placement.py, ROADMAP item 5): the 256-tenant Zipf census behind
+    the async router on 1, 2, and 4 REAL replica subprocesses
+    (`ml_ops replica` — own Python, own backend, honest blast
+    radius).  Saturation legs measure aggregate sustained events/s per
+    replica count — per-replica capacity is the router's bounded
+    admission window over the round trip (Little's law), so the
+    aggregate scales near-linearly until the host's cores saturate —
+    and the chaos leg SIGKILLs one of two replicas mid-replay:
+    shadow promotion + admission-journal replay must yield ZERO failed
+    futures (victims included), bit-identical survivor scores, a
+    bounded p999 during the failover window, and zero post-recovery
+    retraces on the survivor (the compiled family came off the shared
+    plan/compilation cache at warmup)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    ))
+    import load_gen
+
+    return load_gen.run_replicated_slo(
+        replica_counts, n_tenants=n_tenants, zipf_s=zipf_s,
+        events_per_replica=events_per_replica,
+        chaos_events=chaos_events, chaos_rate_eps=chaos_rate_eps,
+        route_window=route_window, max_wait_ms=max_wait_ms,
+        spawn="process",
+    )
+
+
+def phase_serving_slo_replicated():
+    """Replicated serving SLO: headline value is the aggregate
+    sustained events/s at the LARGEST replica count; the payload
+    carries sustained eps per count, replica_scaling_efficiency (>=
+    0.7 at 2 replicas is the acceptance floor), the chaos phase's
+    failover p999 / time-to-recovery / zero-failed-futures proof, and
+    the zero-retrace counters — all gated by bench_diff direction
+    keys."""
+    res = bench_serving_slo_replicated()
+    top = str(max(res["replica_counts"]))
+    return {"value": res["sustained_eps_by_count"].get(top),
+            "unit": "events/sec", **res}
+
+
 def phase_serving_slo_fleet_paged():
     """Paged fleet SLO: headline value is the aggregate sustained
     events/s over a 256-tenant Zipf census with only 32 HBM-hot slots
@@ -1988,10 +2036,12 @@ def run_distributed_worker(argv) -> int:
 
 
 def _spawn_dist_workers(workdir, nprocs, mode, timeout=300.0,
-                        docs=2048, em_iters=6):
+                        docs=2048, em_iters=6, precision=""):
     """Launch the worker ranks as fresh CPU processes (the phase may
     itself be running under a TPU-pinned env; the scaling proof is a
-    CPU cluster) and collect their result JSONs."""
+    CPU cluster) and collect their result JSONs.  `precision` pins the
+    suff-stats allreduce wire precision via the documented env
+    override (the bf16 bytes-halving leg)."""
     import socket
     import subprocess
 
@@ -2001,10 +2051,13 @@ def _spawn_dist_workers(workdir, nprocs, mode, timeout=300.0,
     env = {
         k: v for k, v in os.environ.items()
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
-                     "ONI_ML_TPU_ESTEP")
+                     "ONI_ML_TPU_ESTEP", "ONI_ML_TPU_ALLREDUCE_PRECISION")
     }
     env["JAX_PLATFORMS"] = "cpu"
-    outs = [os.path.join(workdir, f"{mode}{r}.json") for r in range(nprocs)]
+    if precision:
+        env["ONI_ML_TPU_ALLREDUCE_PRECISION"] = precision
+    outs = [os.path.join(workdir, f"{mode}{precision}{r}.json")
+            for r in range(nprocs)]
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__),
@@ -2055,6 +2108,14 @@ def bench_distributed_em(nprocs=2, docs=2048, em_iters=6):
                                    docs=docs, em_iters=em_iters)[0]
         dist = _spawn_dist_workers(workdir, nprocs, "dist",
                                    docs=docs, em_iters=em_iters)
+        # bf16 wire-compression leg: same corpus/config, the
+        # suff-stats allreduce payload packed to bf16 (f32
+        # accumulation after unpack) — the payload carries the
+        # measured bytes halving and the likelihood drift so the
+        # compression claim is evidence, not arithmetic.
+        bf16 = _spawn_dist_workers(workdir, nprocs, "dist",
+                                   docs=docs, em_iters=em_iters,
+                                   precision="bf16")
     finally:
         import shutil
 
@@ -2063,6 +2124,9 @@ def bench_distributed_em(nprocs=2, docs=2048, em_iters=6):
     iters = max(dist[0]["em_iters"], 1)
     ar = dist[0]["allreduce"] or {}
     ar_bytes = ar.get("bytes_out", 0) + ar.get("bytes_in", 0)
+    ar16 = bf16[0]["allreduce"] or {}
+    ar16_bytes = ar16.get("bytes_out", 0) + ar16.get("bytes_in", 0)
+    iters16 = max(bf16[0]["em_iters"], 1)
     return {
         "nprocs": nprocs,
         "docs": dist[0]["docs"],
@@ -2076,9 +2140,30 @@ def bench_distributed_em(nprocs=2, docs=2048, em_iters=6):
             base["docs"] * max(base["em_iters"], 1) / base["wall_s"]
         ),
         "scaling_efficiency": base["wall_s"] / (nprocs * per_host_wall),
+        "allreduce_precision": ar.get("precision", "f32"),
         "allreduce_bytes_per_iter": ar_bytes / iters,
         "allreduce_wall_s_per_iter": ar.get("wall_s", 0.0) / iters,
         "allreduce_ops": ar.get("ops", 0),
+        # The bf16 wire-compression leg vs the f32 leg above:
+        # bytes_ratio ~0.5 on the bulk suff-stats (the gamma merge and
+        # control plane stay exact, so the whole-fit ratio sits a bit
+        # above one half); ll_drift is the |final-LL| delta the
+        # compressed wire introduced (bf16-tolerance, not bit-equal).
+        "allreduce_bf16": {
+            "bytes_per_iter": ar16_bytes / iters16,
+            "bytes_ratio": (
+                round(ar16_bytes / ar_bytes, 4) if ar_bytes else None
+            ),
+            "wall_s_per_iter": ar16.get("wall_s", 0.0) / iters16,
+            "ll_drift": abs(bf16[0]["final_ll"] - dist[0]["final_ll"]),
+            # Relative to the ELBO magnitude — the comparable number
+            # (absolute nats scale with corpus size).
+            "ll_drift_rel": (
+                abs(bf16[0]["final_ll"] - dist[0]["final_ll"])
+                / abs(dist[0]["final_ll"])
+                if dist[0]["final_ll"] else None
+            ),
+        },
         # Rank parity is part of the phase's contract, not just the
         # test suite's: identical reduced stats => identical ll.
         "rank_ll_spread": float(
@@ -2152,6 +2237,11 @@ PHASES = [
     ("serving_slo_fleet", phase_serving_slo_fleet, 480.0, True),
     ("serving_slo_fleet_paged", phase_serving_slo_fleet_paged,
      480.0, True),
+    # Replicated elastic serving: replica subprocesses are fresh
+    # JAX_PLATFORMS=cpu processes, so the phase stays runnable while
+    # the chip grant is wedged.
+    ("serving_slo_replicated", phase_serving_slo_replicated,
+     600.0, False),
     # Continuous ingestion: a paced day replay through the standing
     # window→warm-EM→gated-publish loop with co-resident serving.
     ("streaming_freshness", phase_streaming_freshness, 600.0, True),

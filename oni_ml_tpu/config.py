@@ -190,6 +190,16 @@ class LDAConfig:
     # 1-rank run's (the reduction applies the same fixed pairwise tree
     # either way).  ONI_ML_TPU_EM_SHARDS overrides.
     em_shards: int = 0
+    # Wire precision of the distributed suff-stats allreduce payload:
+    # "f32" (exact — the byte-identity default) or "bf16"
+    # (round-to-nearest-even compressed, HALF the KV-ring bytes per EM
+    # iteration, f32 accumulation after the unpack).  bf16 keeps the
+    # reduced stats rank-identical and rank-count-invariant, but they
+    # are bf16-tolerance vs an f32-wire run, not bit-equal — leave at
+    # f32 when artifacts must match a single-process fit byte-for-byte.
+    # Applies to the bulk suff-stats reduce only; the f64 gamma merge
+    # always ships exact.  ONI_ML_TPU_ALLREDUCE_PRECISION overrides.
+    allreduce_precision: str = "f32"
 
     @property
     def k(self) -> int:
@@ -380,6 +390,32 @@ class ServingConfig:
     # (documented tolerance, pinned in tests/test_residency.py).  The
     # f32 host path and the golden scoring bytes are untouched.
     stack_precision: str = "f32"
+    # -- replicated elastic serving (serving/router.py / replica.py) --
+    # Replica liveness cadence: each ReplicaServer publishes a KV
+    # heartbeat this often, and the router declares a replica lost —
+    # promoting its tenants' shadows — after replica_heartbeat_miss
+    # consecutive intervals without one (connection EOF and the fail
+    # key short-circuit the wait).  The product is the detection half
+    # of the failover latency budget (docs/performance.md).
+    replica_heartbeat_s: float = 0.25
+    replica_heartbeat_miss: int = 8
+    # Router control-plane op timeout (add_tenant/publish/drain/stats
+    # round trips — NOT the per-event scoring path, which is async).
+    route_op_timeout_s: float = 30.0
+    # The router journals one priced {"kind": "route"} record per edge
+    # every this many forwarded events (per-event records would dwarf
+    # the journal at fleet rates); 0 journals only the stream-end
+    # rollup.
+    route_journal_every: int = 1024
+    # Bounded per-replica admission window: at most this many events
+    # in flight (submitted, response not yet demuxed) per replica edge;
+    # a submit beyond it BLOCKS, and the stall is priced into the
+    # route edge stats like a dataplane channel stall.  This is the
+    # router-side Little's-law bound — per-replica throughput tops out
+    # at window / round-trip — and the backstop that keeps one slow
+    # replica's backlog (and the admission journal) from growing
+    # unboundedly inside the router.  0 = unbounded.
+    route_max_inflight: int = 1024
 
 
 @dataclass(frozen=True)

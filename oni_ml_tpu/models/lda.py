@@ -695,6 +695,13 @@ class LDATrainer:
             for sg in shard_groups
         ]
         have_prev = False
+        # env > config, matching every other distributed knob.  Applies
+        # to the bulk suff-stats reduce ONLY — the f64 gamma merge
+        # below pins f32 (= uncompressed) so posteriors stay exact.
+        ar_precision = (
+            os.environ.get("ONI_ML_TPU_ALLREDUCE_PRECISION", "")
+            or cfg.allreduce_precision
+        )
         ar0 = dict(coll.stats)
         t_loop0 = now_ns()
         n_reduce = 0
@@ -720,7 +727,8 @@ class LDATrainer:
                         (np.asarray(ss), np.asarray(ll), np.asarray(ass)),
                     ))
             gammas_prev, have_prev = new_gammas, True
-            reduced = reduce_partials(coll, plan, shard_stats, f"em{it}")
+            reduced = reduce_partials(coll, plan, shard_stats,
+                                      f"em{it}", precision=ar_precision)
             n_reduce += 1
             log_beta = self._m_step(jnp.asarray(reduced["suff_stats"]))
             if cfg.estimate_alpha:
@@ -783,7 +791,11 @@ class LDATrainer:
                 s: gamma_out[plan.bounds[s][0]:plan.bounds[s][1]]
                 for s in owned
             }
-            for g in coll.allgather_arrays(payload, "em_gamma"):
+            # precision pinned: the gamma merge ships f64 posteriors
+            # whose exactness the artifact byte-identity contract
+            # depends on — never bf16-compress it.
+            for g in coll.allgather_arrays(payload, "em_gamma",
+                                           precision="f32"):
                 for s, rows in g.items():
                     st, en = plan.bounds[s]
                     gamma_out[st:en] = rows
@@ -1954,6 +1966,13 @@ def _train_corpus_distributed(
     d = coll.stats
     result.plan["allreduce"] = {
         "transport": coll.transport,
+        # APPLIED precision — the Collective's own rule, so this
+        # provenance can never disagree with what the data-plane ops
+        # journaled (psum/local/1-process runs never compress).
+        "precision": coll.applied_precision(
+            os.environ.get("ONI_ML_TPU_ALLREDUCE_PRECISION", "")
+            or config.allreduce_precision
+        ),
         "nprocs": coll.num_processes,
         "ops": d["ops"] - ar0["ops"],
         "bytes_out": d["bytes_out"] - ar0["bytes_out"],

@@ -530,9 +530,13 @@ class OnlineLDATrainer:
             jnp.asarray(batch.counts[lo:hi], dtype),
             jnp.asarray(batch.doc_mask[lo:hi], dtype),
         )
+        # precision pinned: the streaming lambda-blend parity contract
+        # (rank-count-invariant lambda BYTES) would not survive a
+        # bf16-compressed wire; the env knob targets the batch
+        # suff-stats reduce, not this path.
         reduced = tree_combine(coll.allgather_arrays(
             {"suff_stats": np.asarray(ss), "likelihood": np.asarray(ll)},
-            f"svi{t}",
+            f"svi{t}", precision="f32",
         ))
         self._lam = blend_prog(
             self._lam,

@@ -95,6 +95,66 @@ POLL_SLICE_S = 2.0
 FAIL_DRAIN_S = 5.0
 
 
+def _bf16_pack(arr: np.ndarray) -> np.ndarray:
+    """f32/f64 -> bf16 bit pattern as uint16, round-to-nearest-even —
+    the standard truncate-with-carry trick on the f32 view.  Used to
+    HALVE the kvring wire bytes of a float payload; accumulation after
+    the matching unpack stays f32, so only the per-rank partials lose
+    mantissa, never the reduction arithmetic."""
+    a = np.asarray(arr, np.float32)
+    # Round-trip through flat 1-d: .view() is shape-preserving only on
+    # contiguous data, and ascontiguousarray would silently promote a
+    # 0-d scalar (the likelihood/alpha suff-stats) to shape (1,).
+    u = np.ascontiguousarray(a).reshape(-1).view(np.uint32)
+    rounded = ((u + np.uint32(0x7FFF) + ((u >> np.uint32(16))
+                                         & np.uint32(1)))
+               >> np.uint32(16)).astype(np.uint16)
+    # NaN guard: the carry add wraps high-payload NaN bit patterns
+    # into +/-0.0 — a diverged rank's suff-stats must stay NaN on the
+    # wire so the fit fails loudly, exactly like the f32 wire would.
+    is_nan = ((u & np.uint32(0x7F800000)) == np.uint32(0x7F800000)) \
+        & ((u & np.uint32(0x007FFFFF)) != 0)
+    if is_nan.any():
+        quiet = (((u >> np.uint32(16)) & np.uint32(0x8000))
+                 | np.uint32(0x7FC0)).astype(np.uint16)
+        rounded = np.where(is_nan, quiet, rounded)
+    return rounded.reshape(a.shape)
+
+
+def _bf16_unpack(u16: np.ndarray) -> np.ndarray:
+    """uint16 bf16 bit pattern -> f32 (exact: bf16 embeds in f32)."""
+    u16 = np.asarray(u16)
+    return ((u16.reshape(-1).astype(np.uint32) << np.uint32(16))
+            .view(np.float32).reshape(u16.shape))
+
+
+# Wire marker for a bf16-compressed array inside a pickled payload.
+# Self-describing per VALUE, so every rank decompresses whatever
+# arrives identically — the reduced bytes stay rank-identical even if
+# (misconfigured) ranks disagree on the compression knob.
+_BF16_TAG = "__oni_bf16__"
+
+
+def _compress_named(named: dict, precision: str) -> dict:
+    if precision != "bf16":
+        return named
+    return {
+        k: (_BF16_TAG, _bf16_pack(v))
+        if np.asarray(v).dtype.kind == "f" else v
+        for k, v in named.items()
+    }
+
+
+def _decompress_named(named: dict) -> dict:
+    out = {}
+    for k, v in named.items():
+        if isinstance(v, tuple) and len(v) == 2 and v[0] == _BF16_TAG:
+            out[k] = _bf16_unpack(v[1])
+        else:
+            out[k] = v
+    return out
+
+
 def tree_combine(parts):
     """Deterministic pairwise-tree sum of a list of pytrees of arrays
     (np or jnp): adjacent pairs combine level by level, an odd tail
@@ -186,7 +246,8 @@ class Collective:
                  transport: "str | None" = None,
                  timeout_s: "float | None" = None,
                  max_chunk_bytes: int = DEFAULT_MAX_CHUNK_BYTES,
-                 namespace: str = "oni/ar"):
+                 namespace: str = "oni/ar",
+                 payload_precision: "str | None" = None):
         import jax
 
         self.rank = jax.process_index() if rank is None else rank
@@ -227,11 +288,39 @@ class Collective:
                 "local, kvring, or psum"
             )
         self.transport = transport
+        if payload_precision is None:
+            payload_precision = os.environ.get(
+                "ONI_ML_TPU_ALLREDUCE_PRECISION", "") or "f32"
+        if payload_precision not in ("f32", "bf16"):
+            raise ValueError(
+                f"unknown allreduce payload_precision "
+                f"{payload_precision!r}: expected f32 or bf16"
+            )
+        # Default WIRE precision for float payloads on the kvring
+        # transport: "bf16" halves the per-iteration KV-ring bytes
+        # (round-to-nearest-even pack, exact f32 unpack, f32
+        # accumulation in the reduction tree).  Per-call overrides let
+        # the trainer compress the bulk suff-stats while the f64 gamma
+        # merge stays exact.  The psum transport ignores it: its
+        # payloads ride ICI as device arrays, not pickled KV chunks.
+        self.payload_precision = payload_precision
         self._failed_reason: "str | None" = None
         # Process-local accounting (bench distributed_em reads it):
         # cumulative data-plane ops, payload bytes out/in, wall.
         self.stats = {"ops": 0, "bytes_out": 0, "bytes_in": 0,
                       "wall_s": 0.0}
+
+    def applied_precision(self, precision: "str | None" = None) -> str:
+        """The wire precision an allgather with this `precision`
+        request would ACTUALLY use — the one rule, shared by the
+        data-plane op and every provenance record: bf16 compresses
+        only multi-process kvring payloads (psum rides ICI as device
+        arrays; a single process never touches the wire at all)."""
+        if precision is None:
+            precision = self.payload_precision
+        return ("bf16" if precision == "bf16"
+                and self.transport == "kvring"
+                and self.num_processes > 1 else "f32")
 
     # -- failure relay ----------------------------------------------------
 
@@ -387,12 +476,24 @@ class Collective:
         return blocks, bytes_out, bytes_in, p - 1
 
     def allgather_arrays(self, named: "dict[str, np.ndarray]",
-                         tag: str) -> "list[dict[str, np.ndarray]]":
+                         tag: str, *,
+                         precision: "str | None" = None
+                         ) -> "list[dict[str, np.ndarray]]":
         """The bulk primitive: every rank's named-array dict, in rank
         order, on every rank.  Journaled as {"kind": "allreduce"} with
         per-op bytes/rounds/wall, the wait priced under an
-        allreduce.wait span like a dataplane stall."""
+        allreduce.wait span like a dataplane stall.
+
+        `precision` overrides the collective's payload_precision for
+        this op.  Under "bf16" on the kvring transport, float arrays
+        ship as round-to-nearest-even bf16 bit patterns (half the wire
+        bytes) and EVERY rank — including the sender reading its own
+        block — unpacks them to f32 before use, so the reduction sees
+        identical f32 inputs everywhere and the reduced bytes stay
+        rank-identical.  Non-float arrays and non-kvring transports
+        pass through untouched."""
         named = {k: np.asarray(v) for k, v in named.items()}
+        applied = self.applied_precision(precision)
         if self.num_processes == 1:
             return [named]
         t0 = time.monotonic()
@@ -411,11 +512,14 @@ class Collective:
                 bytes_in = bytes_out * (self.num_processes - 1)
                 rounds = 1
             else:
-                payload = pickle.dumps(named, protocol=4)
+                payload = pickle.dumps(
+                    _compress_named(named, applied), protocol=4
+                )
                 blocks, bytes_out, bytes_in, rounds = (
                     self._ring_allgather(payload, tag)
                 )
-                out = [pickle.loads(b) for b in blocks]
+                out = [_decompress_named(pickle.loads(b))
+                       for b in blocks]
         wall = time.monotonic() - t0
         self.stats["ops"] += 1
         self.stats["bytes_out"] += bytes_out
@@ -429,6 +533,7 @@ class Collective:
                 "transport": self.transport,
                 "nprocs": self.num_processes,
                 "rounds": rounds,
+                "precision": applied,
                 "bytes_out": bytes_out,
                 "bytes_in": bytes_in,
                 "wall_s": round(wall, 6),
@@ -437,7 +542,9 @@ class Collective:
 
 
 def reduce_partials(coll: Collective, plan, shard_stats: "dict[int, dict]",
-                    tag: str) -> "dict[str, np.ndarray]":
+                    tag: str, *,
+                    precision: "str | None" = None
+                    ) -> "dict[str, np.ndarray]":
     """The sufficient-statistics allreduce: per-shard partial stats in,
     globally-reduced stats out — identical bytes on every rank, and
     invariant to the rank count for a fixed shard plan.
@@ -446,17 +553,24 @@ def reduce_partials(coll: Collective, plan, shard_stats: "dict[int, dict]",
     dicts.  Aligned plans (rank runs are canonical tree nodes) exchange
     one pre-combined subtree root per rank; unaligned plans exchange
     per-shard partials so the canonical shard-order tree can still be
-    applied identically everywhere."""
+    applied identically everywhere.
+
+    `precision="bf16"` compresses the wire payload (kvring transport:
+    half the bytes per EM iteration) with f32 accumulation after the
+    unpack; the reduced bytes are still rank-identical and
+    rank-count-invariant — just bf16-tolerance vs the f32 wire, not
+    bit-equal to it (the PR 9 sparse-engine precision contract)."""
     owned = sorted(shard_stats)
     if plan.aligned:
         local = tree_combine([shard_stats[s] for s in owned])
-        gathered = coll.allgather_arrays(local, tag)
+        gathered = coll.allgather_arrays(local, tag,
+                                         precision=precision)
         return tree_combine(gathered)
     flat: "dict[str, np.ndarray]" = {}
     for s in owned:
         for k, v in shard_stats[s].items():
             flat[f"{s}:{k}"] = v
-    gathered = coll.allgather_arrays(flat, tag)
+    gathered = coll.allgather_arrays(flat, tag, precision=precision)
     by_shard: "dict[int, dict]" = {}
     for g in gathered:
         for key, v in g.items():

@@ -1820,6 +1820,19 @@ def main(argv: list[str] | None = None) -> int:
         from . import continuous
 
         return continuous.main(argv[1:])
+    # `ml_ops replica ...` / `ml_ops route ...` are the replicated
+    # elastic serving fleet (runner/route.py): N serve replica
+    # processes behind an async router with consistent-hash tenant
+    # placement and shadow-promotion failover — long-running services,
+    # so they route before the YYYYMMDD parser like serve.
+    if argv and argv[0] == "replica":
+        from .route import replica_main
+
+        return replica_main(argv[1:])
+    if argv and argv[0] == "route":
+        from .route import route_main
+
+        return route_main(argv[1:])
     # `ml_ops lint ...` is the static-analysis gate (oni_ml_tpu/analysis)
     # — same engine as tools/graftlint.py and the oni-graftlint console
     # script; routes before the YYYYMMDD parser like serve.

@@ -38,6 +38,24 @@ host RAM and checkpoints —
 
 `ServingConfig.fleet_hot_tenants` turns it on; the fleet scales from
 "as many tenants as fit in HBM" to "as many tenants as fit on disk".
+
+Replicated elastic serving (placement.py + replica.py + router.py):
+the whole stack above replicated across N processes —
+
+        -> place()            deterministic balanced consistent-hash
+                              ring: primary + warm shadow per tenant,
+                              minimal movement on ring change
+        -> ReplicaServer      one full serving stack behind a framed
+                              socket protocol, KV heartbeats
+        -> FleetRouter        async scatter/gather front: bounded
+                              per-replica admission windows, an
+                              admission journal that replays in-flight
+                              events on failover, shadow promotion on
+                              BackendLost, rolling drain/join redeploy
+
+`ml_ops route --replicas N` / `ml_ops replica` are the CLI front ends;
+aggregate events/s scales with the replica count and a dead replica
+costs a promotion window, not the fleet.
 """
 
 from .batcher import BatchScorer, ScoreFuture
@@ -62,7 +80,16 @@ from .events import (
     score_features,
 )
 from .metrics import MetricsEmitter
+from .placement import (
+    Placement,
+    load_by_replica,
+    moved_primaries,
+    place,
+    shadow_for,
+)
 from .refresh import RefreshLoop, topic_probs_from_log_beta
+from .replica import ReplicaServer, featurizer_for
+from .router import FleetRouter, ReplicaLink
 from .registry import ModelRegistry, ModelSnapshot, validate_model
 from .residency import (
     TIER_COLD,
@@ -92,6 +119,15 @@ __all__ = [
     "featurizer_from_features",
     "score_features",
     "MetricsEmitter",
+    "Placement",
+    "place",
+    "shadow_for",
+    "moved_primaries",
+    "load_by_replica",
+    "ReplicaServer",
+    "featurizer_for",
+    "FleetRouter",
+    "ReplicaLink",
     "RefreshLoop",
     "topic_probs_from_log_beta",
     "ModelRegistry",

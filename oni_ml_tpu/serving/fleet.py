@@ -540,6 +540,7 @@ class FleetScorer:
         on_batch=None,
         journal=None,
         residency=None,
+        dynamic: bool = False,
     ) -> None:
         self.fleet = fleet
         self.config = config or ServingConfig()
@@ -559,31 +560,28 @@ class FleetScorer:
         self._journal = getattr(journal, "journal", journal) \
             if journal is not None \
             else (metrics._journal if metrics is not None else None)
+        # `dynamic=True` (the replicated-serving replica path,
+        # serving/replica.py): the scorer starts with however many
+        # tenants the registry knows — possibly zero — and grows lanes
+        # at runtime via add_tenant() as the router places tenants on
+        # this replica.  The worker simply parks on "no drainable
+        # lane" until the first lane appears.
+        self._dynamic = dynamic
         self._lanes: dict[str, TenantLane] = {}
         for tenant in fleet.tenants():
             spec = fleet.spec(tenant)
             fz = featurizers.get(tenant)
             if fz is None:
                 raise ValueError(f"no featurizer for tenant {tenant!r}")
-            if getattr(fz, "dsource", None) != spec.dsource:
-                raise ValueError(
-                    f"tenant {tenant!r} declares dsource "
-                    f"{spec.dsource!r} but its featurizer is "
-                    f"{getattr(fz, 'dsource', None)!r}"
-                )
-            self._lanes[tenant] = TenantLane(
-                spec=spec,
-                featurizer=fz,
-                queue_max=spec.queue_max or self.config.tenant_queue_max,
-                admission=spec.admission or self.config.admission,
-                threshold=(spec.threshold
-                           if spec.threshold is not None
-                           else self.config.threshold),
-            )
-        if not self._lanes:
+            self._lanes[tenant] = self._make_lane(spec, fz)
+        if not self._lanes and not dynamic:
             raise ValueError("FleetScorer needs at least one tenant")
+        # Remember the plan resolution so the dynamic add_tenant path
+        # can re-apply the degradation guard as capacity grows.
+        self._plan_max_batch = int(mb)
+        self._plan_max_batch_src = mb_src
         total_capacity = sum(l.queue_max for l in self._lanes.values())
-        if mb_src == "plan" and int(mb) > total_capacity:
+        if self._lanes and mb_src == "plan" and int(mb) > total_capacity:
             # Same degradation guard as BatchScorer: a plan flush size
             # above the fleet's total admission capacity would make the
             # max_batch trigger unreachable (every flush silently
@@ -602,12 +600,6 @@ class FleetScorer:
             raise ValueError(
                 f"fleet_max_wait_ms must be > 0, got {self.max_wait_ms}"
             )
-        for lane in self._lanes.values():
-            if lane.queue_max < 1:
-                raise ValueError(
-                    f"tenant {lane.spec.tenant!r} queue_max must be "
-                    ">= 1"
-                )
         if self.config.device_score_min in (0, "auto"):
             # Pay the one-time host-vs-device calibration at
             # construction, never inside a latency-bounded flush
@@ -633,6 +625,70 @@ class FleetScorer:
             name="oni-fleet-scorer", daemon=True,
         )
         self._worker.start()
+
+    def _make_lane(self, spec: TenantSpec, fz) -> TenantLane:
+        """Validated lane construction — shared by __init__ and the
+        dynamic add_tenant path so both enforce the same
+        dsource/queue/admission resolution."""
+        if getattr(fz, "dsource", None) != spec.dsource:
+            raise ValueError(
+                f"tenant {spec.tenant!r} declares dsource "
+                f"{spec.dsource!r} but its featurizer is "
+                f"{getattr(fz, 'dsource', None)!r}"
+            )
+        lane = TenantLane(
+            spec=spec,
+            featurizer=fz,
+            queue_max=spec.queue_max or self.config.tenant_queue_max,
+            admission=spec.admission or self.config.admission,
+            threshold=(spec.threshold
+                       if spec.threshold is not None
+                       else self.config.threshold),
+        )
+        if lane.queue_max < 1:
+            raise ValueError(
+                f"tenant {lane.spec.tenant!r} queue_max must be >= 1"
+            )
+        return lane
+
+    def add_tenant(self, spec: TenantSpec, featurizer) -> None:
+        """Grow one admission lane at runtime (dynamic fleets only —
+        the replicated-serving router places tenants on a running
+        replica).  The tenant must already be registered (and
+        published) in the FleetRegistry; the new lane becomes
+        drainable on the next take."""
+        if not self._dynamic:
+            raise RuntimeError(
+                "add_tenant on a static FleetScorer — construct with "
+                "dynamic=True"
+            )
+        self.fleet.spec(spec.tenant)    # raise early on unknown tenant
+        lane = self._make_lane(spec, featurizer)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("FleetScorer is closed")
+            if spec.tenant in self._lanes:
+                raise ValueError(
+                    f"tenant {spec.tenant!r} already has a lane"
+                )
+            self._lanes[spec.tenant] = lane
+            # Re-apply the plan-flush degradation guard at the grown
+            # capacity: a plan-sourced max_batch above the fleet's
+            # total admission capacity is unreachable (silent
+            # latency-timer flushes); once capacity covers it, the
+            # measured plan value takes effect.
+            if self._plan_max_batch_src == "plan":
+                total = sum(l.queue_max for l in self._lanes.values())
+                if self._plan_max_batch > total:
+                    self.max_batch = self.config.fleet_max_batch
+                    src = "default"
+                else:
+                    self.max_batch = self._plan_max_batch
+                    src = "plan"
+                self.plan["max_batch"] = {
+                    "value": self.max_batch, "source": src,
+                }
+            self._cond.notify_all()
 
     def _wake(self) -> None:
         with self._cond:

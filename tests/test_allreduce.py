@@ -414,3 +414,126 @@ def test_allreduce_journal_record(monkeypatch):
         assert a["bytes_out"] > 0 and a["bytes_in"] > 0
     for s in stats:
         assert s["ops"] == 1 and s["bytes_out"] > 0
+
+
+# ---------------------------------------------------------------------------
+# bf16-compressed payloads
+# ---------------------------------------------------------------------------
+
+
+def test_bf16_pack_roundtrip_tolerance():
+    """Round-to-nearest-even bf16 pack: exact unpack back into f32
+    with <= 2^-8 relative error on normal values, specials preserved."""
+    from oni_ml_tpu.parallel.allreduce import _bf16_pack, _bf16_unpack
+
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal(4096).astype(np.float32)
+         * np.float32(10.0) ** rng.integers(-6, 6, 4096))
+    r = _bf16_unpack(_bf16_pack(x))
+    np.testing.assert_allclose(r, x, rtol=2 ** -8, atol=0)
+    specials = np.array([0.0, -0.0, np.inf, -np.inf], np.float32)
+    np.testing.assert_array_equal(
+        _bf16_unpack(_bf16_pack(specials)), specials)
+    # NaN must survive the wire (a diverged rank's stats fail loudly,
+    # never silently zero): every NaN bit pattern, incl. the
+    # high-payload ones whose carry add would wrap to +/-0.0.
+    nans = np.array([np.nan, -np.nan], np.float32)
+    nans = np.concatenate([
+        nans,
+        np.array([0x7FFFFFFF, 0xFFFFFFFF, 0x7FC00001],
+                 np.uint32).view(np.float32),
+    ])
+    assert np.isnan(_bf16_unpack(_bf16_pack(nans))).all()
+    # f64 input packs through f32 (accumulation dtype is f32).
+    assert _bf16_unpack(_bf16_pack(np.ones(3, np.float64))).dtype \
+        == np.float32
+
+
+def test_allgather_bf16_halves_bytes_rank_identical():
+    """bf16 wire precision on the kvring: float payloads ship half the
+    bytes, EVERY rank (sender included) unpacks the same bits, so the
+    gathered arrays are rank-identical and within bf16 tolerance of
+    the f32 wire; int arrays pass through untouched."""
+    rng = np.random.default_rng(3)
+    payloads = [
+        {"ss": rng.standard_normal((128, 8)).astype(np.float32),
+         "n": np.int64(7 + r)}
+        for r in range(2)
+    ]
+    out = {}
+    for precision in ("f32", "bf16"):
+        kv = _MemKV()
+
+        def fn(c, r, precision=precision):
+            g = c.allgather_arrays(payloads[r], "t",
+                                   precision=precision)
+            return tree_combine(g), dict(c.stats)
+
+        out[precision] = _ring(kv, 2, fn, max_chunk=1 << 20)
+    (f32_a, s32), (f32_b, _) = out["f32"]
+    (bf_a, s16), (bf_b, _) = out["bf16"]
+    np.testing.assert_array_equal(f32_a["ss"], f32_b["ss"])
+    np.testing.assert_array_equal(bf_a["ss"], bf_b["ss"])
+    assert bf_a["n"] == f32_a["n"] == np.int64(7) + np.int64(8)
+    np.testing.assert_allclose(bf_a["ss"], f32_a["ss"],
+                               rtol=2 ** -7, atol=2 ** -6)
+    assert not np.array_equal(bf_a["ss"], f32_a["ss"])
+    assert s16["bytes_out"] < 0.62 * s32["bytes_out"]
+
+
+def test_reduce_partials_bf16_parity_and_journal_precision():
+    """The suff-stats reduce under precision="bf16": rank-identical
+    reduced stats within tolerance of the f32 wire, and the
+    {"kind": "allreduce"} record carries the APPLIED precision."""
+    from oni_ml_tpu.telemetry.spans import Recorder, use_recorder
+
+    class _Sink:
+        def __init__(self):
+            self.records = []
+
+        def append(self, rec, sync=False):
+            self.records.append(rec)
+
+    rng = np.random.default_rng(5)
+    parts = {s: {"ss": rng.standard_normal((17, 3)).astype(np.float32)}
+             for s in range(8)}
+    plan2 = plan_shards(100, 2, 8)
+
+    def run(precision, sink):
+        kv = _MemKV()
+        rec = Recorder(journal=sink)
+
+        def fn(c, r):
+            mine = {s: parts[s] for s in plan2.owned(r)}
+            with use_recorder(rec):
+                return reduce_partials(c, plan2, mine, "t",
+                                       precision=precision)
+
+        return _ring(kv, 2, fn)
+
+    sink32, sink16 = _Sink(), _Sink()
+    got32 = run(None, sink32)
+    got16 = run("bf16", sink16)
+    np.testing.assert_array_equal(got16[0]["ss"], got16[1]["ss"])
+    np.testing.assert_allclose(got16[0]["ss"], got32[0]["ss"],
+                               rtol=2 ** -6, atol=2 ** -5)
+    assert {r["precision"] for r in sink32.records
+            if r.get("kind") == "allreduce"} == {"f32"}
+    assert {r["precision"] for r in sink16.records
+            if r.get("kind") == "allreduce"} == {"bf16"}
+
+
+def test_collective_payload_precision_env_and_validation(monkeypatch):
+    """ONI_ML_TPU_ALLREDUCE_PRECISION sets the collective default;
+    junk values fail loudly at construction."""
+    monkeypatch.setenv("ONI_ML_TPU_ALLREDUCE_PRECISION", "bf16")
+    c = Collective(client=_MemKV(), rank=0, nprocs=1,
+                   transport="local")
+    assert c.payload_precision == "bf16"
+    monkeypatch.delenv("ONI_ML_TPU_ALLREDUCE_PRECISION")
+    c = Collective(client=_MemKV(), rank=0, nprocs=1,
+                   transport="local")
+    assert c.payload_precision == "f32"
+    with pytest.raises(ValueError, match="payload_precision"):
+        Collective(client=_MemKV(), rank=0, nprocs=1,
+                   transport="local", payload_precision="f16")

@@ -284,6 +284,46 @@ def _patch_phases(bench, monkeypatch):
         },
     )
     monkeypatch.setattr(
+        bench, "bench_serving_slo_replicated",
+        lambda *a, **k: {
+            "n_tenants": 256, "zipf_s": 1.1, "spawn": "process",
+            "route_window": 64, "max_wait_ms": 20.0,
+            "replica_counts": [1, 2, 4],
+            "scaling": {
+                "1": {"replicas": 1, "events": 3072, "wall_s": 1.1,
+                      "sustained_eps": 2800.0, "errors": 0,
+                      "retraces_in_window": 0},
+                "2": {"replicas": 2, "events": 6144, "wall_s": 1.1,
+                      "sustained_eps": 5500.0, "errors": 0,
+                      "retraces_in_window": 0},
+                "4": {"replicas": 4, "events": 12288, "wall_s": 1.15,
+                      "sustained_eps": 10700.0, "errors": 0,
+                      "retraces_in_window": 0},
+            },
+            "sustained_eps_by_count": {"1": 2800.0, "2": 5500.0,
+                                       "4": 10700.0},
+            "replica_scaling_efficiency": 0.98,
+            "replica_scaling_efficiency_by_count": {
+                "1": 1.0, "2": 0.98, "4": 0.95},
+            "retraces_in_windows": 0,
+            "chaos": {
+                "replicas": 2, "killed": "r0", "offered_eps": 1500.0,
+                "events": 4096, "victim_tenants": 128,
+                "errors_surviving": 0, "errors_victim_tenants": 0,
+                "p50_ms": 93.0, "p99_ms": 215.0, "p999_ms": 253.0,
+                "failover_window_events": 88,
+                "failover_p999_ms": 202.0,
+                "time_to_recovery_s": 0.18,
+                "survivor_bit_identical": True,
+                "retraces_after_recovery": 0,
+                "failover_record": {"promoted": 128, "resent": 64,
+                                    "recovery_s": 0.035},
+            },
+            "failover_p999_ms": 202.0,
+            "time_to_recovery_s": 0.18,
+        },
+    )
+    monkeypatch.setattr(
         bench, "bench_streaming_freshness",
         lambda *a, **k: {
             "dsource": "flow", "tenant": "stream", "slices": 96,
@@ -463,6 +503,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "serving_slo",
         "serving_slo_fleet",
         "serving_slo_fleet_paged",
+        "serving_slo_replicated",
         "streaming_freshness",
         "distributed_em",
         "pipeline_e2e",
@@ -988,6 +1029,17 @@ def test_bench_distributed_em_smoke():
     # em_iters reduces + the gamma merge ride the same collective.
     assert res["allreduce_ops"] == res["em_iters"] + 1
     assert res["rank_ll_spread"] == 0.0
+    # The bf16 wire-compression leg: the bulk suff-stats payload
+    # halves, the gamma merge + control plane stay exact, so the
+    # whole-fit ratio sits between 0.4 and ~0.95; the compressed wire
+    # may not silently change the f32 default leg.
+    assert res["allreduce_precision"] == "f32"
+    bf16 = res["allreduce_bf16"]
+    assert 0.3 < bf16["bytes_ratio"] < 0.98
+    assert bf16["bytes_per_iter"] < res["allreduce_bytes_per_iter"]
+    # bf16-tolerance, not bit-equal: a few percent of the ELBO
+    # magnitude at toy scale, never garbage.
+    assert bf16["ll_drift_rel"] < 0.05
 
 
 def test_bench_diff_distributed_em_directions(tmp_path):

@@ -91,6 +91,40 @@ def _streaming_rows(name: str, old: dict, new: dict,
     return rows
 
 
+# Replicated elastic serving phase: direction per key — aggregate
+# sustained events/s per replica count and the scaling efficiency are
+# higher-better; the chaos phase's p999-during-failover and
+# time-to-full-recovery are lower-better (a slower promotion is a
+# regression exactly like a latency blowup).  Error/retrace counts are
+# asserted by the test suite, not gated here (they are correctness
+# bits, not performance trends).
+_REPLICATED_PHASE = "serving_slo_replicated"
+_REPLICATED_KEYS = (
+    ("replica_scaling_efficiency", "fraction"),  # higher-better
+    ("failover_p999_ms", "ms"),                  # lower-better
+    ("time_to_recovery_s", "s"),                 # lower-better
+)
+
+
+def _replicated_rows(name: str, old: dict, new: dict,
+                     threshold_pct: float) -> "list[dict]":
+    rows = []
+    for key, unit in _REPLICATED_KEYS:
+        r = _rel_row(f"{name}.{key}", old.get(key), new.get(key), unit,
+                     threshold_pct)
+        if r:
+            rows.append(r)
+    old_eps = old.get("sustained_eps_by_count") or {}
+    new_eps = new.get("sustained_eps_by_count") or {}
+    for count in sorted(set(old_eps) & set(new_eps), key=int):
+        r = _rel_row(f"{name}.sustained_eps[{count}]",
+                     old_eps.get(count), new_eps.get(count),
+                     "events/sec", threshold_pct)
+        if r:
+            rows.append(r)
+    return rows
+
+
 # Distributed-EM scaling phase: direction per key — scaling efficiency
 # is a fraction of ideal speedup (higher-better), the per-iteration
 # allreduce wall is dead time on the EM critical path (lower-better).
@@ -263,6 +297,17 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
                                       threshold_pct))
     if _serving_groups(old) and _serving_groups(new):
         rows.extend(_serving_rows("headline", old, new, threshold_pct))
+    # Replicated-serving keys (per-count sustained eps + scaling
+    # efficiency higher-better, failover p999 / recovery lower-better)
+    # — phase payloads and replicated-headline captures.
+    o, n = old_sec.get(_REPLICATED_PHASE), new_sec.get(_REPLICATED_PHASE)
+    if isinstance(o, dict) and isinstance(n, dict):
+        rows.extend(_replicated_rows(f"phase:{_REPLICATED_PHASE}", o, n,
+                                     threshold_pct))
+    if ("replica_scaling_efficiency" in old
+            and "replica_scaling_efficiency" in new):
+        rows.extend(_replicated_rows("headline", old, new,
+                                     threshold_pct))
     # Distributed-EM scaling keys (efficiency higher-better, allreduce
     # wall lower-better) — from the secondary phase payloads, and from
     # the headline payload when the compared run IS a distributed_em

@@ -42,6 +42,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -51,6 +52,12 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 
 PATTERNS = ("poisson", "bursty")
+
+# Collector-slot sentinel for a load-shed submit (AdmissionRejected):
+# distinguishes "no future will ever exist here" from "producer not
+# there yet" (None), so a shed mid-replay releases the tenant's
+# collector instead of parking it until the global done event.
+_SHED = object()
 
 
 def arrival_offsets(pattern: str, n: int, rate_eps: float, *,
@@ -241,6 +248,7 @@ def _fleet_stack(tenant_mix, n_events_per_tenant: int, *,
                  shared_day: bool = False, hot_tenants: int = 0,
                  warm_tenants: int = 0, residency_policy: str = "lru",
                  spill_dir: str = "", stack_precision: str = "f32",
+                 admission: str = "", tenant_queue_max: int = 0,
                  recorder=None):
     """N synthetic tenant days (distinct models, same K -> ONE pack
     group / ONE compiled batch family) behind the real fleet stack
@@ -308,6 +316,9 @@ def _fleet_stack(tenant_mix, n_events_per_tenant: int, *,
         fleet_max_batch=fleet_max_batch,
         fleet_max_wait_ms=fleet_max_wait_ms,
         device_score_min=device_score_min,
+        admission=admission or ServingConfig.admission,
+        tenant_queue_max=(tenant_queue_max
+                          or ServingConfig.tenant_queue_max),
     )
     scorer = FleetScorer(fleet, featurizers, cfg, residency=residency)
     if residency is not None:
@@ -325,7 +336,8 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                   timeout_s: float = 120.0, zipf_s: float = 0.0,
                   hot_tenants: int = 0, warm_tenants: int = 0,
                   residency_policy: str = "lru", spill_dir: str = "",
-                  stack_precision: str = "f32",
+                  stack_precision: str = "f32", admission: str = "",
+                  tenant_queue_max: int = 0,
                   per_tenant_detail: int = 16) -> dict:
     """The serving_slo_fleet measurement: >= `n_tenants` tenants with
     weighted mixed Poisson/bursty arrivals multiplexed through ONE
@@ -349,6 +361,7 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
     stall, and final tier occupancy.  Zero-retrace applies unchanged:
     churn inside a capacity tier never mints a program."""
     from oni_ml_tpu.plans import warmup as plans_warmup
+    from oni_ml_tpu.serving import AdmissionRejected
     from oni_ml_tpu.telemetry.spans import Recorder
 
     rec = recorder or Recorder()
@@ -376,7 +389,8 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
         events_by_tenant=events_by_tenant, shared_day=paged,
         hot_tenants=hot_tenants, warm_tenants=warm_tenants,
         residency_policy=residency_policy, spill_dir=spill_dir,
-        stack_precision=stack_precision, recorder=rec,
+        stack_precision=stack_precision, admission=admission,
+        tenant_queue_max=tenant_queue_max, recorder=rec,
     )
     agg_hist = rec.histogram("loadgen.fleet.latency_ms")
     tenant_hists = {
@@ -405,7 +419,13 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
         for i, tm in enumerate(warm_mix):
             rows = rows_by_tenant[tm["tenant"]]
             for r in rows[:max(1, min(len(rows), max_batch))]:
-                warm_futs.append(scorer.submit(tm["tenant"], r))
+                try:
+                    warm_futs.append(scorer.submit(tm["tenant"], r))
+                except AdmissionRejected:
+                    # Under admission="reject" with queues smaller than
+                    # the warmup burst, shedding here is expected; the
+                    # events that DID land still trace every shape.
+                    scorer.flush()
         scorer.flush()
         for f in warm_futs:
             f.result(timeout=timeout_s)
@@ -435,7 +455,7 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
         fifo = {t: [None] * len(rows_by_tenant[t]) for t in schedules}
         done = threading.Event()
         states = {
-            t: {"resolved": 0, "errors": 0, "t_last": None}
+            t: {"resolved": 0, "errors": 0, "shed": 0, "t_last": None}
             for t in schedules
         }
 
@@ -449,6 +469,11 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                         if slots[i] is None:
                             return
                         break
+                if slots[i] is _SHED:
+                    # The submit was load-shed (AdmissionRejected) — no
+                    # future exists for this slot; the collector must
+                    # release it, not wait on it forever.
+                    continue
                 fut, t_submit = slots[i]
                 try:
                     fut.result(timeout=timeout_s)
@@ -479,7 +504,21 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                 else:
                     behind_s = max(behind_s, now - target)
                 t_submit = time.perf_counter()
-                fut = scorer.submit(tenant, rows_by_tenant[tenant][j])
+                try:
+                    fut = scorer.submit(
+                        tenant, rows_by_tenant[tenant][j]
+                    )
+                except AdmissionRejected:
+                    # Shedding is an expected outcome of paged /
+                    # admission="reject" runs, not a harness failure:
+                    # mark the slot so the tenant's collector skips it
+                    # (an unfilled slot would park the thread until the
+                    # global release, silently eating every later
+                    # latency sample of that tenant) and keep
+                    # replaying the schedule.
+                    fifo[tenant][j] = _SHED
+                    states[tenant]["shed"] += 1
+                    continue
                 fifo[tenant][j] = (fut, t_submit)
             scorer.flush()
         finally:
@@ -522,6 +561,7 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                 if t_wall > 0 else None,
                 "resolved": state["resolved"],
                 "errors": state["errors"],
+                "shed": state["shed"],
                 **_quant(tenant_hists[t]),
             }
         # At fleet scale the full per-tenant dict would dominate the
@@ -563,6 +603,7 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
                 "wall_s": round(wall, 3),
                 "resolved": resolved,
                 "errors": errors,
+                "shed": sum(s["shed"] for s in states.values()),
                 "max_sched_lag_s": round(behind_s, 3),
                 **_quant(agg_hist),
             },
@@ -602,6 +643,486 @@ def run_fleet_slo(n_tenants: int = 4, mix: str = "poisson:1,bursty:1",
         scorer.close()
         if residency is not None:
             residency.close()
+
+
+# ---------------------------------------------------------------------------
+# replicated fleet harness (bench.py serving_slo_replicated)
+# ---------------------------------------------------------------------------
+
+
+def _replicated_stack(n_replicas: int, tenant_mix, models, cuts, *,
+                      max_batch: int, max_wait_ms: float,
+                      route_window: int, spawn: str, workdir: str,
+                      device_score_min, recorder=None, journal=None):
+    """Router + N serve replicas hosting the shared-day census
+    (serving/router.py + replica.py).  `spawn="process"` runs each
+    replica as a real `ml_ops replica` subprocess — its own Python,
+    its own backend, the honest blast radius — while `spawn="thread"`
+    hosts ReplicaServer in-process for cheap tests.  Returns (router,
+    procs, servers)."""
+    from oni_ml_tpu.config import ServingConfig
+    from oni_ml_tpu.serving import FleetRouter, TenantSpec
+
+    cfg = ServingConfig(
+        fleet_max_batch=max_batch, fleet_max_wait_ms=max_wait_ms,
+        device_score_min=device_score_min,
+        route_max_inflight=route_window,
+    )
+    procs: dict = {}
+    servers: dict = {}
+    router = FleetRouter(cfg, recorder=recorder, journal=journal)
+    kv_dir = os.path.join(workdir, f"kv{n_replicas}")
+    for i in range(n_replicas):
+        rid = f"r{i}"
+        if spawn == "process":
+            from oni_ml_tpu.runner.route import _spawn_replica
+
+            extra = [
+                "--fleet-max-batch", str(max_batch),
+                "--fleet-max-wait-ms", str(max_wait_ms),
+            ]
+            if device_score_min is None:
+                extra += ["--device-score-min", "none"]
+            proc, host, port = _spawn_replica(rid, kv_dir, workdir,
+                                              extra)
+            procs[rid] = proc
+        else:
+            from oni_ml_tpu.serving import ReplicaServer
+
+            srv = ReplicaServer(rid, cfg)
+            servers[rid] = srv
+            host, port = srv.host, srv.port
+        router.connect_replica(rid, host, port)
+    for i, tm in enumerate(tenant_mix):
+        router.add_tenant(
+            TenantSpec(tenant=tm["tenant"], dsource="dns",
+                       weight=tm["weight"]),
+            cuts, models[i],
+        )
+    router.start(warmup=True)
+    return router, procs, servers
+
+
+def _replicated_teardown(router, procs, servers) -> None:
+    try:
+        router.close()
+    except Exception:
+        pass
+    for proc in procs.values():
+        if proc.poll() is None:
+            proc.terminate()
+    for proc in procs.values():
+        try:
+            proc.wait(timeout=30.0)
+        except Exception:
+            proc.kill()
+    for srv in servers.values():
+        srv.stop()
+
+
+def _zipf_counts(tenants, weights, total: int) -> "dict[str, int]":
+    """Split `total` events across tenants proportionally to their
+    Zipf weights, every tenant getting at least one (a tenant never
+    touched exercises nothing)."""
+    total_w = sum(weights)
+    return {
+        t: max(1, int(round(total * w / total_w)))
+        for t, w in zip(tenants, weights)
+    }
+
+
+def _trace_count(stats: dict) -> int:
+    out = 0
+    for s in stats.values():
+        c = s.get("compile") or {}
+        out += int(c.get("traces") or 0)
+    return out
+
+
+def _scaling_leg(n_replicas: int, tenant_mix, models, rows, cuts, *,
+                 events_per_replica: int, chunk: int, max_batch: int,
+                 max_wait_ms: float, route_window: int, spawn: str,
+                 workdir: str, device_score_min,
+                 timeout_s: float) -> dict:
+    """Saturation throughput at one replica count: one closed-loop
+    feeder per replica drives ITS tenants (census split by primary
+    placement, per-tenant volumes by Zipf weight) through submit_many
+    chunks as fast as the bounded admission window admits.  Per-replica
+    throughput is the Little's-law window/round-trip bound, so
+    aggregate sustained events/s scales with the replica count until
+    the host's cores saturate."""
+    router, procs, servers = _replicated_stack(
+        n_replicas, tenant_mix, models, cuts, max_batch=max_batch,
+        max_wait_ms=max_wait_ms, route_window=route_window,
+        spawn=spawn, workdir=workdir,
+        device_score_min=device_score_min,
+    )
+    try:
+        placement = router.placement()
+        weight = {tm["tenant"]: tm["weight"] for tm in tenant_mix}
+        by_rep: dict = {}
+        for t, p in placement.items():
+            by_rep.setdefault(p.primary, []).append(t)
+        counts: dict = {}
+        for r, tenants in by_rep.items():
+            counts.update(_zipf_counts(
+                tenants, [weight[t] for t in tenants],
+                events_per_replica,
+            ))
+        # Warmup OUTSIDE the measured window: a few flushes trace the
+        # packed shapes (and the shared plan/compilation cache means a
+        # respawned replica pays nothing again).
+        warm = []
+        for t in placement:
+            warm += router.submit_many(
+                t, [rows[j % len(rows)] for j in range(8)])
+        router.flush()
+        for f in warm:
+            f.result(timeout=timeout_s)
+        stats_before = router.replica_stats()
+        results: dict = {}
+        errors: "list[int]" = []
+
+        def feed(rep, tenants):
+            futs = []
+            errs = 0
+            try:
+                remaining = {t: counts[t] for t in tenants}
+                sent = {t: 0 for t in tenants}
+                while any(remaining.values()):
+                    for t in tenants:
+                        take = min(chunk, remaining[t])
+                        if not take:
+                            continue
+                        futs += router.submit_many(t, [
+                            rows[(sent[t] + j) % len(rows)]
+                            for j in range(take)
+                        ])
+                        sent[t] += take
+                        remaining[t] -= take
+                router.flush()
+                for f in futs:
+                    try:
+                        f.result(timeout=timeout_s)
+                    except Exception:
+                        errs += 1
+            except Exception:
+                # A feeder that dies (replica lost beyond failover,
+                # router closed) must surface as ERRORS in the
+                # payload, never as a silently-thinner denominator
+                # behind a plausible sustained_eps.
+                errs += sum(1 for f in futs if not f.done())
+                errs = max(errs, 1)
+            finally:
+                errors.append(errs)
+                results[rep] = len(futs)
+
+        feeders = [
+            threading.Thread(target=feed, args=(r, ts),
+                             name=f"loadgen-rep-{r}", daemon=True)
+            for r, ts in by_rep.items()
+        ]
+        t0 = time.perf_counter()
+        for f in feeders:
+            f.start()
+        for f in feeders:
+            f.join(timeout=timeout_s + 60.0)
+        wall = time.perf_counter() - t0
+        stats_after = router.replica_stats()
+        total = sum(results.values())
+        return {
+            "replicas": n_replicas,
+            "events": total,
+            "wall_s": round(wall, 3),
+            "sustained_eps": round(total / wall, 1) if wall else None,
+            "errors": sum(errors),
+            "retraces_in_window": (
+                _trace_count(stats_after) - _trace_count(stats_before)
+            ),
+            "route": router.stats()["edges"],
+        }
+    finally:
+        _replicated_teardown(router, procs, servers)
+
+
+def _chaos_leg(tenant_mix, models, rows, cuts, *, chaos_events: int,
+               chaos_rate_eps: float, kill_frac: float, chunk: int,
+               max_batch: int, max_wait_ms: float, route_window: int,
+               spawn: str, workdir: str, device_score_min,
+               recorder, seed: int, timeout_s: float) -> dict:
+    """Kill-a-replica chaos at 2 replicas: open-loop Poisson replay
+    across the whole census, SIGKILL one replica mid-stream, and
+    measure what the failover actually cost — zero failed futures
+    for tenants on the surviving replica (and zero for the victims
+    too: the admission journal replays them onto the promoted
+    shadow), p999 DURING the failover window, time to full recovery,
+    bit-identical survivor scores, and zero post-recovery retraces on
+    the survivor."""
+    from oni_ml_tpu.serving import DnsEventFeaturizer, score_features
+
+    router, procs, servers = _replicated_stack(
+        2, tenant_mix, models, cuts, max_batch=max_batch,
+        max_wait_ms=max_wait_ms, route_window=route_window,
+        spawn=spawn, workdir=workdir,
+        device_score_min=device_score_min, recorder=recorder,
+    )
+    try:
+        placement = router.placement()
+        tenants = [tm["tenant"] for tm in tenant_mix]
+        weight = {tm["tenant"]: tm["weight"] for tm in tenant_mix}
+        victim = placement[tenants[0]].primary
+        counts = _zipf_counts(tenants, [weight[t] for t in tenants],
+                              chaos_events)
+        # Warmup outside the window.
+        warm = []
+        for t in tenants:
+            warm += router.submit_many(
+                t, [rows[j % len(rows)] for j in range(8)])
+        router.flush()
+        for f in warm:
+            f.result(timeout=timeout_s)
+        stats_before = router.replica_stats()
+        # Merged open-loop Poisson schedule, event volumes by Zipf
+        # weight; per-tenant FIFO collectors record absolute submit /
+        # resolve stamps so the failover window can be reconstructed.
+        merged: list = []
+        for i, t in enumerate(tenants):
+            offs = arrival_offsets(
+                "poisson", counts[t],
+                chaos_rate_eps * weight[t] / sum(weight.values()),
+                seed=seed + i,
+            )
+            merged.extend((float(offs[j]), t, j)
+                          for j in range(counts[t]))
+        merged.sort()
+        fifo = {t: [None] * counts[t] for t in tenants}
+        samples = {t: [] for t in tenants}   # (t_sub, t_res, ok, score)
+        done = threading.Event()
+
+        def collect(tenant):
+            slots = fifo[tenant]
+            out = samples[tenant]
+            for i in range(len(slots)):
+                while slots[i] is None:
+                    if done.wait(0.0005):
+                        if slots[i] is None:
+                            return
+                        break
+                fut, t_sub = slots[i]
+                try:
+                    score, _ = fut.result(timeout=timeout_s)
+                    out.append(
+                        (t_sub, time.perf_counter(), True, score))
+                except Exception:
+                    out.append(
+                        (t_sub, time.perf_counter(), False, None))
+
+        collectors = [
+            threading.Thread(target=collect, args=(t,),
+                             name=f"loadgen-chaos-{t}", daemon=True)
+            for t in tenants
+        ]
+        for c in collectors:
+            c.start()
+        kill_at = int(len(merged) * kill_frac)
+        t_kill = None
+        t0 = time.perf_counter()
+        try:
+            for i, (off, tenant, j) in enumerate(merged):
+                if i == kill_at:
+                    if procs:
+                        procs[victim].kill()  # SIGKILL, the real thing
+                    else:
+                        servers[victim].kill()
+                    t_kill = time.perf_counter()
+                target = t0 + off
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                t_sub = time.perf_counter()
+                fut = router.submit(tenant, rows[j % len(rows)])
+                fifo[tenant][j] = (fut, t_sub)
+            router.flush()
+        finally:
+            # Unconditionally release the collectors (run_fleet_slo's
+            # contract): a submit that raises mid-chaos must not leave
+            # one busy-polling daemon thread per tenant for the life
+            # of the bench process.
+            done.set()
+            for c in collectors:
+                c.join(timeout=timeout_s + 60.0)
+        # -- post-run accounting -----------------------------------------
+        victims = {t for t, p in placement.items()
+                   if p.primary == victim}
+        surviving = set(tenants) - victims
+        err_surv = sum(
+            1 for t in surviving for s in samples[t] if not s[2])
+        err_vic = sum(
+            1 for t in victims for s in samples[t] if not s[2])
+        # In flight at the kill: submitted before, resolved after —
+        # full recovery is when the LAST of them lands.
+        t_rec = t_kill
+        for t in victims:
+            for t_sub, t_res, ok, _ in samples[t]:
+                if ok and t_sub <= t_kill < t_res:
+                    t_rec = max(t_rec, t_res)
+        recovery_s = t_rec - t_kill
+        fo_hist = recorder.histogram(
+            "loadgen.replicated.failover_ms")
+        all_hist = recorder.histogram(
+            "loadgen.replicated.latency_ms")
+        window_n = 0
+        for t in tenants:
+            for t_sub, t_res, ok, _ in samples[t]:
+                if not ok:
+                    continue
+                lat_ms = (t_res - t_sub) * 1e3
+                all_hist.observe(lat_ms)
+                if t_kill <= t_sub <= t_rec:
+                    fo_hist.observe(lat_ms)
+                    window_n += 1
+        # Survivor bit-identity: a surviving tenant's scores must equal
+        # the single-process oracle (packing/routing never changes
+        # arithmetic, even while the other replica dies).
+        probe = sorted(surviving)[0] if surviving else None
+        bit_identical = None
+        if probe is not None:
+            # Collector order == submit order == event index j, and
+            # event j scored rows[j % len(rows)].
+            got = [s[3] for s in samples[probe]]
+            used = [rows[j % len(rows)] for j in range(len(got))]
+            feats = DnsEventFeaturizer(cuts)(used)
+            oracle = score_features(
+                models[tenants.index(probe)], feats, "dns")
+            bit_identical = (
+                len(got) == counts[probe]
+                and all(s is not None for s in got)
+                and bool(np.array_equal(
+                    np.asarray(got, np.float64), oracle))
+            )
+        stats_after = router.replica_stats()
+        surv_traces = _trace_count(
+            {r: s for r, s in stats_after.items() if r != victim}
+        ) - _trace_count(
+            {r: s for r, s in stats_before.items() if r != victim}
+        )
+        fo = fo_hist.summary()
+        al = all_hist.summary()
+        # The recovery record lands on a reader thread after the
+        # journal replay + shadow backfill; give it a moment rather
+        # than racing it.
+        deadline = time.monotonic() + 15.0
+        failovers = router.stats()["failovers"]
+        while not failovers and time.monotonic() < deadline:
+            time.sleep(0.02)
+            failovers = router.stats()["failovers"]
+        return {
+            "replicas": 2,
+            "killed": victim,
+            "offered_eps": chaos_rate_eps,
+            "events": len(merged),
+            "victim_tenants": len(victims),
+            "errors_surviving": err_surv,
+            "errors_victim_tenants": err_vic,
+            "p50_ms": al["p50"] and round(al["p50"], 3),
+            "p99_ms": al["p99"] and round(al["p99"], 3),
+            "p999_ms": al["p999"] and round(al["p999"], 3),
+            "failover_window_events": window_n,
+            "failover_p999_ms": fo["p999"] and round(fo["p999"], 3),
+            "time_to_recovery_s": round(recovery_s, 4),
+            "survivor_bit_identical": bit_identical,
+            "retraces_after_recovery": surv_traces,
+            "failover_record": failovers[-1] if failovers else None,
+        }
+    finally:
+        _replicated_teardown(router, procs, servers)
+
+
+def run_replicated_slo(replica_counts=(1, 2, 4), *,
+                       n_tenants: int = 256, zipf_s: float = 1.1,
+                       events_per_replica: int = 3072,
+                       chunk: int = 32, max_batch: int = 256,
+                       max_wait_ms: float = 20.0,
+                       route_window: int = 64,
+                       chaos: bool = True,
+                       chaos_events: int = 4096,
+                       chaos_rate_eps: float = 1500.0,
+                       kill_frac: float = 0.4,
+                       spawn: str = "process",
+                       day_events: int = 512, seed: int = 0,
+                       device_score_min=0, recorder=None,
+                       timeout_s: float = 300.0) -> dict:
+    """The serving_slo_replicated measurement (ROADMAP item 5): the
+    same Zipf tenant census served by 1, 2, and 4 replicas behind the
+    async router, saturation throughput per count (the bounded
+    per-replica admission window makes per-replica capacity a real
+    Little's-law bound, so aggregate events/s scales with the count),
+    plus a kill-a-replica chaos phase measuring p999 during failover,
+    time-to-full-recovery, zero failed futures, bit-identical
+    survivor scores, and zero post-recovery retraces."""
+    from oni_ml_tpu.runner.serve import _synthetic_day
+    from oni_ml_tpu.telemetry.spans import Recorder
+
+    rec = recorder or Recorder()
+    workdir = tempfile.mkdtemp(prefix="oni_replicated_")
+    rows, base_model, cuts = _synthetic_day(
+        n_events=day_events, n_clients=64, n_doms=16, seed=100)
+    tenant_mix = fleet_mix(n_tenants, "poisson:1", 1000.0, zipf_s)
+    models = _tenant_models(base_model, n_tenants)
+    try:
+        scaling: dict = {}
+        for n in replica_counts:
+            scaling[str(n)] = _scaling_leg(
+                n, tenant_mix, models, rows, cuts,
+                events_per_replica=events_per_replica, chunk=chunk,
+                max_batch=max_batch, max_wait_ms=max_wait_ms,
+                route_window=route_window, spawn=spawn,
+                workdir=workdir, device_score_min=device_score_min,
+                timeout_s=timeout_s,
+            )
+        counts = sorted(int(k) for k in scaling)
+        eps = {n: scaling[str(n)]["sustained_eps"] for n in counts}
+        base = eps.get(counts[0])
+        efficiency = {
+            str(n): (round(eps[n] / (n / counts[0] * base), 4)
+                     if base and eps.get(n) else None)
+            for n in counts
+        }
+        eff2 = efficiency.get("2")
+        out = {
+            "n_tenants": n_tenants,
+            "zipf_s": zipf_s,
+            "spawn": spawn,
+            "route_window": route_window,
+            "max_wait_ms": max_wait_ms,
+            "replica_counts": list(counts),
+            "scaling": scaling,
+            "sustained_eps_by_count": {
+                str(n): eps[n] for n in counts},
+            "replica_scaling_efficiency": eff2,
+            "replica_scaling_efficiency_by_count": efficiency,
+            "retraces_in_windows": sum(
+                s["retraces_in_window"] for s in scaling.values()),
+        }
+        if chaos and len(tenant_mix) >= 2:
+            out["chaos"] = _chaos_leg(
+                tenant_mix, models, rows, cuts,
+                chaos_events=chaos_events,
+                chaos_rate_eps=chaos_rate_eps, kill_frac=kill_frac,
+                chunk=chunk, max_batch=max_batch,
+                max_wait_ms=max_wait_ms, route_window=route_window,
+                spawn=spawn, workdir=workdir,
+                device_score_min=device_score_min, recorder=rec,
+                seed=seed, timeout_s=timeout_s,
+            )
+            out["failover_p999_ms"] = out["chaos"]["failover_p999_ms"]
+            out["time_to_recovery_s"] = (
+                out["chaos"]["time_to_recovery_s"])
+        return out
+    finally:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def _stack(n_events: int, *, max_batch: int, max_wait_ms: float,
@@ -734,10 +1255,26 @@ def main(argv=None) -> int:
     ap.add_argument("--residency-policy", choices=["lru", "lfu"],
                     default="lru",
                     help="eviction victim selection for --hot-tenants")
+    ap.add_argument("--admission", choices=["block", "reject"],
+                    default="",
+                    help="fleet admission policy override: \"reject\" "
+                    "sheds on full tenant queues (shed counts land in "
+                    "the payload) instead of backpressuring the "
+                    "replay (default: fleet config)")
     ap.add_argument("--tenant-ids", default="", metavar="ID,ID,...",
                     help="with --emit-lines: explicit tenant ids for "
                     "the fleet framing, matching a real manifest "
                     "(default: synthetic t0..tN-1 from --tenants)")
+    ap.add_argument("--replicated", default="", metavar="N,N,...",
+                    help="replicated-fleet mode: measure aggregate "
+                    "sustained events/s at each replica count (real "
+                    "`ml_ops replica` subprocesses behind the async "
+                    "router) plus the kill-a-replica chaos leg "
+                    "(serving_slo_replicated harness)")
+    ap.add_argument("--route-window", type=int, default=64,
+                    metavar="N",
+                    help="replicated mode: bounded per-replica "
+                    "admission window (route_max_inflight)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--emit-lines", action="store_true",
                     help="pace raw CSV lines to stdout instead of "
@@ -756,6 +1293,19 @@ def main(argv=None) -> int:
                        tenants=args.tenants, tenant_ids=ids)
         print(f"load_gen: emitted {n} events", file=sys.stderr)
         return 0
+    if args.replicated:
+        counts = tuple(
+            int(c) for c in args.replicated.split(",") if c.strip()
+        )
+        res = run_replicated_slo(
+            counts, n_tenants=args.tenants or 256,
+            zipf_s=args.zipf or 1.1, route_window=args.route_window,
+            max_wait_ms=args.max_wait_ms, max_batch=args.max_batch,
+            seed=args.seed,
+            device_score_min=None if args.host_only else 0,
+        )
+        print(json.dumps(res), flush=True)
+        return 0
     if args.tenants:
         res = run_fleet_slo(
             args.tenants, args.mix, n_events=args.events,
@@ -766,6 +1316,7 @@ def main(argv=None) -> int:
             hot_tenants=args.hot_tenants,
             warm_tenants=args.warm_tenants,
             residency_policy=args.residency_policy,
+            admission=args.admission,
         )
         print(json.dumps(res), flush=True)
         return 0

@@ -278,6 +278,41 @@ def journal_to_trace(records: "list[dict]") -> dict:
                          ("version", "ll", "delta", "mode", "em_iters")
                          if k in rec},
             })
+        elif kind == "route":
+            # Per-edge fan-out counter lane: forwarded events/bytes and
+            # the router's in-flight depth against the bounded
+            # admission window — the replicated fleet's dataplane
+            # edges next to the channel-depth lanes.
+            edge = rec.get("edge", "?")
+            events.append({
+                "name": f"route {edge}", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"events": rec.get("events", 0),
+                         "inflight": rec.get("inflight", 0)},
+            })
+        elif kind == "membership":
+            events.append({
+                "name": (f"fleet {rec.get('event', '?')}: "
+                         f"{rec.get('replica', rec.get('replicas'))}"),
+                "ph": "i", "s": "t",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("tenants", "moved", "reshadowed", "drained")
+                         if k in rec},
+            })
+        elif kind == "failover":
+            recovered = rec.get("event") == "recovered"
+            events.append({
+                "name": (f"FAILOVER recovered: {rec.get('replica')}"
+                         if recovered
+                         else f"FAILOVER: {rec.get('replica')}"),
+                "ph": "i", "s": "t" if recovered else "g",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("reason", "promoted", "inflight", "resent",
+                          "resend_failures", "recovery_s")
+                         if k in rec},
+            })
         elif kind == "backend_lost":
             events.append({
                 "name": "BACKEND LOST", "ph": "i", "s": "g",
@@ -429,6 +464,30 @@ def continuous_table(records: "list[dict]") -> "dict | None":
     }
 
 
+def route_table(records: "list[dict]") -> "list[dict]":
+    """Per-replica routing rollup from the router's {"kind": "route"}
+    records (the close-record totals win when present) plus its
+    failover tally — the terminal answer to "where did the fleet's
+    traffic go and what did losing a replica cost"."""
+    edges: dict = {}
+    for rec in records:
+        if rec.get("kind") != "route" or "edge" not in rec:
+            continue
+        e = edges.setdefault(rec["edge"], {
+            "edge": rec["edge"], "events": 0, "bytes": 0,
+            "resends": 0, "admission_stall_s": 0.0,
+        })
+        if rec.get("event") == "close":
+            e["events"] = rec.get("events", e["events"])
+            e["bytes"] = rec.get("bytes", e["bytes"])
+            e["resends"] = rec.get("resends", 0)
+            e["admission_stall_s"] = rec.get("admission_stall_s", 0.0)
+        elif "events" in rec:
+            e["events"] += rec.get("events", 0)
+            e["bytes"] += rec.get("bytes", 0)
+    return [edges[k] for k in sorted(edges)]
+
+
 def print_summary(records: "list[dict]", dropped: int,
                   out=sys.stdout) -> None:
     rows = stage_summary(records)
@@ -474,6 +533,24 @@ def print_summary(records: "list[dict]", dropped: int,
             print(f"  {e['edge']:<24} {e['capacity']:>4} {e['puts']:>7} "
                   f"{e['gets']:>7} {e['put_stall_s']:>12.3f} "
                   f"{e['get_stall_s']:>12.3f} {e['max_depth']:>9}",
+                  file=out)
+    route_rows = route_table(records)
+    if route_rows:
+        print("replicated routing (per-replica fan-out edges):",
+              file=out)
+        print(f"  {'replica':<16} {'events':>8} {'bytes':>12} "
+              f"{'resends':>8} {'admit_stall_s':>14}", file=out)
+        for e in route_rows:
+            print(f"  {e['edge']:<16} {e['events']:>8} "
+                  f"{e['bytes']:>12} {e['resends']:>8} "
+                  f"{e['admission_stall_s']:>14.3f}", file=out)
+        fos = [r for r in records if r.get("kind") == "failover"
+               and r.get("event") == "recovered"]
+        for f in fos:
+            print(f"  failover {f.get('replica')}: "
+                  f"{f.get('promoted', 0)} promoted, "
+                  f"{f.get('resent', 0)} in-flight replayed, "
+                  f"recovered in {f.get('recovery_s', 0):.3f}s",
                   file=out)
     res_rows = residency_table(records)
     if res_rows:
