@@ -1963,6 +1963,84 @@ def phase_streaming_freshness():
             **res}
 
 
+# -- detection quality (labeled-injection P/R@k) ------------------------
+
+
+def bench_detection_quality(n_events=8000, attack_events=8, seed=7,
+                            num_topics=2, em_max_iters=15):
+    """Detection-quality SLO over labeled injected days: for EVERY
+    registered source, synthesize a benign day, plant the source's
+    attack scenarios (sources/inject.py), train a small LDA on the
+    injected day, and score it back through the serving path
+    (sources/quality.QualitySuite) — precision/recall@k and
+    score-separation per scenario, all higher-better.
+
+    The shape is deliberate: a large MODAL benign day (discrete value
+    modes concentrate benign word mass), attacks rare relative to it
+    (8 events/scenario in 8000), and only 2 topics so the model has no
+    spare capacity to dedicate a topic to the attack tokens — the
+    regime where rank-based metrics mean something (see
+    sources/builtin.py synth_benign docstrings)."""
+    from oni_ml_tpu import sources as src_registry
+    from oni_ml_tpu.config import LDAConfig, ScoringConfig
+    from oni_ml_tpu.io.corpus import Corpus
+    from oni_ml_tpu.models import train_corpus
+    from oni_ml_tpu.scoring import ScoringModel
+    from oni_ml_tpu.sources import inject, quality
+
+    per_source = {}
+    for name in src_registry.names():
+        spec = src_registry.get(name)
+        t0 = time.perf_counter()
+        day = inject.inject_scenarios(
+            name, n_events=n_events, seed=seed,
+            attack_events=attack_events,
+        )
+        feats = spec.featurize(day.lines)
+        cuts = spec.cuts_of(feats)
+        corpus = Corpus.from_features(feats)
+        cfg = LDAConfig(num_topics=num_topics,
+                        em_max_iters=em_max_iters)
+        res = train_corpus(corpus, cfg, out_dir=None, save_final=False)
+        model = ScoringModel.from_lda(
+            corpus.doc_names, res.gamma, corpus.vocab, res.log_beta,
+            spec.fallback(ScoringConfig()),
+        )
+        suite = quality.QualitySuite(
+            name, cuts, n_events=n_events, seed=seed,
+            attack_events=attack_events,
+        )
+        out = suite.evaluate(model)
+        out["vocab"] = len(corpus.vocab)
+        out["docs"] = corpus.num_docs
+        out["wall_s"] = round(time.perf_counter() - t0, 2)
+        per_source[name] = out
+    return per_source
+
+
+def phase_detection_quality():
+    """Detection quality: headline value is the mean recall@k across
+    all registered sources (higher better; 1.0 = every injected attack
+    inside the top-k most-suspicious events).  The payload carries the
+    full per-source / per-scenario breakdown plus precision@k and
+    score-separation — bench_diff gates all three as higher-better
+    keys."""
+    per_source = bench_detection_quality()
+    recalls = [m["recall_at_k"] for m in per_source.values()]
+    return {
+        "value": round(float(np.mean(recalls)), 6),
+        "unit": "fraction",
+        "recall_at_k": round(float(np.mean(recalls)), 6),
+        "precision_at_k": round(float(np.mean(
+            [m["precision_at_k"] for m in per_source.values()]
+        )), 6),
+        "score_separation": round(float(np.mean(
+            [m["score_separation"] for m in per_source.values()]
+        )), 6),
+        "sources": per_source,
+    }
+
+
 # -- distributed EM (host-local shards + explicit allreduce) ------------
 
 
@@ -2245,6 +2323,10 @@ PHASES = [
     # Continuous ingestion: a paced day replay through the standing
     # window→warm-EM→gated-publish loop with co-resident serving.
     ("streaming_freshness", phase_streaming_freshness, 600.0, True),
+    # Detection-quality SLO: labeled-injection P/R@k for every
+    # registered source, trained and scored on CPU — runnable while
+    # the chip grant is wedged.
+    ("detection_quality", phase_detection_quality, 300.0, False),
     # CPU-cluster scaling proof: fresh JAX_PLATFORMS=cpu worker
     # processes, so it stays runnable while the chip grant is wedged.
     ("distributed_em", phase_distributed_em, 600.0, False),

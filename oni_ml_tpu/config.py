@@ -255,6 +255,7 @@ class ScoringConfig:
     threshold: float = 1e-20
     flow_fallback: float = 0.05
     dns_fallback: float = 0.1
+    proxy_fallback: float = 0.1
     # Batch-path scoring engine: "host" (default) is the float64 path
     # whose scored-CSV bytes are golden-pinned — the parity oracle;
     # "device" runs the fused gather·dot·threshold pipeline
@@ -545,6 +546,22 @@ class ContinuousConfig:
     # (drift means the old topics stopped describing the stream);
     # "always"/"never" force.
     warm_start: str = "auto"
+    # Detection-quality publish gate (models/drift.QualityGate): every
+    # candidate model is scored against a pinned labeled-injection
+    # suite (sources/inject.py) and a recall@k drop of more than
+    # quality_tol below the rolling baseline vetoes the publish exactly
+    # like an LL drift.  Off by default — it costs one suite
+    # featurization at startup plus one scoring pass per refresh.
+    quality_gate: bool = False
+    quality_tol: float = 0.25
+    quality_history: int = 8
+    quality_min_history: int = 2
+    # Injection-suite shape: benign events, attack events per scenario,
+    # RNG seed, and ranking depth (0 = k defaults to the attack count).
+    quality_events: int = 2000
+    quality_attack_events: int = 8
+    quality_seed: int = 7
+    quality_k: int = 0
 
 
 @dataclass(frozen=True)
@@ -585,6 +602,7 @@ class PipelineConfig:
                                    # (FLOW_PATH; multi-file = config-3
                                    # 30-day corpus, one joint ECDF)
     dns_path: str = ""             # raw DNS CSV/parquet paths (DNS_PATH)
+    proxy_path: str = ""           # proxy/HTTP log CSV paths (PROXY_PATH)
     top_domains_path: str = ""     # Alexa top-1m.csv (dns_pre_lda.scala:62)
     qtiles_path: str = ""          # precomputed flow cuts (SURVEY §2.7)
     # Pre-stage shard workers: day files split into line-aligned byte

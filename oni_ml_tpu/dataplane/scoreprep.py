@@ -55,17 +55,14 @@ def build_scoring_prep(features, doc_names, vocab,
                        dsource: str) -> ScoringPrep:
     """Resolve every raw event's model rows against the corpus
     orderings (doc_names / vocab — exactly the row orders the results
-    CSVs carry)."""
-    from ..scoring.score import dns_event_indices, flow_event_indices
+    CSVs carry).  The index layout is the registered source's
+    `event_indices` hook — flow/dns delegate to the legacy
+    scoring.score index builders, byte-identically."""
+    from ..sources import get as get_source
 
     ip_index = {ip: i for i, ip in enumerate(doc_names)}
     word_index = {w: i for i, w in enumerate(vocab)}
-    if dsource == "flow":
-        idx = flow_event_indices(features, ip_index, word_index)
-    elif dsource == "dns":
-        idx = dns_event_indices(features, ip_index, word_index)
-    else:
-        raise ValueError(f"dsource must be flow or dns, got {dsource!r}")
+    idx = get_source(dsource).event_indices(features, ip_index, word_index)
     return ScoringPrep(
         dsource=dsource,
         num_docs=len(ip_index),

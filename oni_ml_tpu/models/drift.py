@@ -27,6 +27,16 @@ Drift also steers the NEXT refresh's training mode: warm-starting from
 topics that just failed the quality bar would launder the drift into
 the next model, so the refresh after a veto trains fresh
 (`mode_next == "fresh"`).
+
+`QualityGate` is the drift gate's detection-side twin: where the drift
+detector asks "does the model still describe the stream?", the quality
+gate asks "does it still RANK attacks low?" — every publish candidate
+is scored against a pinned labeled-injection suite
+(sources/quality.QualitySuite) and a recall@k drop of more than
+`tol` below the rolling-median baseline of accepted candidates vetoes
+the publish, journaled as `{"kind": "quality_gate", "action":
+"vetoed"}`.  Same rolling-baseline/veto mechanics, same
+no-regressed-entry rule, same journal replay contract.
 """
 
 from __future__ import annotations
@@ -221,5 +231,145 @@ class DriftDetector:
         if rec is not None:
             rec.counter(
                 "publish_gate.published" if ok else "publish_gate.vetoed"
+            ).add(1)
+        return ok
+
+
+@dataclass(frozen=True)
+class QualityDecision:
+    """One publish candidate's detection-quality verdict."""
+
+    regressed: bool
+    recall: float
+    precision: float
+    separation: float
+    baseline_recall: "float | None"  # rolling median (None: warming up)
+    delta: "float | None"            # recall - baseline (negative = worse)
+    history: int
+    per_scenario: dict
+
+
+class QualityGate:
+    """Rolling recall@k regression gate over a pinned injection suite.
+
+    The suite is any object with an `evaluate(model) -> metrics` hook
+    (sources/quality.QualitySuite in production; tests script it).
+    Metrics must carry `recall_at_k` / `precision_at_k` /
+    `score_separation` and optionally `per_scenario`.  A candidate
+    whose recall sits more than `tol` below the rolling-median baseline
+    of ACCEPTED candidates is vetoed; vetoed candidates never enter the
+    baseline — a weak model must not drag the bar down to meet it."""
+
+    def __init__(
+        self,
+        suite,
+        *,
+        tol: float = 0.25,
+        history: int = 8,
+        min_history: int = 2,
+        journal=None,
+        recorder=None,
+    ) -> None:
+        if tol <= 0:
+            raise ValueError(f"tol must be > 0, got {tol}")
+        if min_history < 1:
+            raise ValueError(
+                f"min_history must be >= 1, got {min_history}"
+            )
+        self.suite = suite
+        self.tol = float(tol)
+        self.min_history = int(min_history)
+        self._history: deque = deque(maxlen=max(int(history), 1))
+        self._journal = journal
+        self._recorder = recorder
+        self.checks = 0
+        self.publishes = 0
+        self.vetoes = 0
+
+    def prime(self, records) -> int:
+        """Rebuild the baseline from replayed `quality_gate` journal
+        records: published (non-regressed) checks re-enter the rolling
+        history in order.  Returns how many were adopted."""
+        n = 0
+        for rec in records:
+            if rec.get("kind") != "quality_gate":
+                continue
+            recall = rec.get("recall_at_k")
+            if (rec.get("action") != "published"
+                    or not isinstance(recall, (int, float))):
+                continue
+            self._history.append(float(recall))
+            n += 1
+        return n
+
+    @property
+    def baseline(self) -> "float | None":
+        if len(self._history) < self.min_history:
+            return None
+        return float(np.median(np.asarray(self._history, np.float64)))
+
+    def check(self, model) -> QualityDecision:
+        """Evaluate one publish candidate against the suite and render
+        the regression verdict (no journal write — `gate()` owns the
+        record so the verdict and the action always land together)."""
+        metrics = self.suite.evaluate(model)
+        recall = float(metrics.get("recall_at_k", 0.0))
+        baseline = self.baseline
+        delta = None if baseline is None else recall - baseline
+        regressed = delta is not None and delta < -self.tol
+        self.checks += 1
+        if not regressed:
+            self._history.append(recall)
+        return QualityDecision(
+            regressed=regressed,
+            recall=recall,
+            precision=float(metrics.get("precision_at_k", 0.0)),
+            separation=float(metrics.get("score_separation", 0.0)),
+            baseline_recall=baseline,
+            delta=delta,
+            history=len(self._history),
+            per_scenario=metrics.get("per_scenario", {}),
+        )
+
+    def gate(self, decision: QualityDecision, *, version: int,
+             **info) -> bool:
+        """True = publish may proceed; False = vetoed.  Journals the
+        `{"kind": "quality_gate"}` record either way — the detection
+        twin of `publish_gate`."""
+        ok = not decision.regressed
+        if ok:
+            self.publishes += 1
+        else:
+            self.vetoes += 1
+        record = {
+            "kind": "quality_gate",
+            "action": "published" if ok else "vetoed",
+            "version": version,
+            "recall_at_k": round(decision.recall, 6),
+            "precision_at_k": round(decision.precision, 6),
+            "score_separation": round(decision.separation, 6),
+            "baseline_recall": (
+                None if decision.baseline_recall is None
+                else round(decision.baseline_recall, 6)
+            ),
+            "delta": (
+                None if decision.delta is None
+                else round(decision.delta, 6)
+            ),
+            "tol": self.tol,
+            "history": decision.history,
+            "per_scenario": {
+                name: round(float(m.get("recall_at_k", 0.0)), 6)
+                for name, m in decision.per_scenario.items()
+            },
+            **info,
+        }
+        if self._journal is not None:
+            self._journal.append(record)
+        rec = self._recorder
+        if rec is not None:
+            rec.gauge("quality.recall_at_k", decision.recall)
+            rec.counter(
+                "quality_gate.published" if ok else "quality_gate.vetoed"
             ).add(1)
         return ok

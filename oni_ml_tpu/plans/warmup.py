@@ -158,15 +158,19 @@ def _aot(fn, *args, harvest: str = "", shape: str = ""):
 def warmup_scoring(num_ip_rows: int, num_word_rows: int, k: int,
                    chunk: int, *, dsource: str = "flow") -> dict:
     """Precompile the fused filter kernel the batch scoring stage
-    dispatches (flow or dns shape) at the plan's chunk size —
+    dispatches at the plan's chunk size —
     filtered_scores/filtered_flow_scores trace exactly this program.
-    `num_*_rows` include the fallback row (model.theta.shape[0] /
-    model.p.shape[0]).  The serving path's padded gather-dot family
-    warms separately (warmup_serving)."""
+    The kernel family follows the source's pair layout (the registry's
+    `pairs_per_event`): two-pair sources run the 4-index min-combining
+    filter, single-pair sources the 2-index one.  `num_*_rows` include
+    the fallback row (model.theta.shape[0] / model.p.shape[0]).  The
+    serving path's padded gather-dot family warms separately
+    (warmup_serving)."""
     import jax
     import numpy as np
 
     from ..scoring.pipeline import _get_fn
+    from ..sources import get as get_source
 
     _ensure_listener()
     before = compile_counts()
@@ -178,7 +182,7 @@ def warmup_scoring(num_ip_rows: int, num_word_rows: int, k: int,
     thr = jax.ShapeDtypeStruct((), f32)
     valid = jax.ShapeDtypeStruct((), np.int32)
     sig = f"ip{num_ip_rows}.w{num_word_rows}.k{k}.c{chunk}"
-    if dsource == "flow":
+    if get_source(dsource).pairs_per_event == 2:
         _aot(_get_fn("filt_flow"), theta, p, idx, idx, idx, idx, thr, valid,
              harvest="score.device.filtered_flow", shape=sig)
     else:

@@ -45,11 +45,8 @@ import numpy as np
 
 from ..config import PipelineConfig
 from ..io import formats
-
-# Flow schema: hour/minute/second live at columns 4/5/6
-# (features/flow.py FLOW_COLUMNS); DNS carries unix_tstamp at column 1.
-_FLOW_H, _FLOW_M, _FLOW_S = 4, 5, 6
-_DNS_TSTAMP = 1
+from ..sources import get as get_source
+from ..sources import names as source_names
 
 
 @dataclass
@@ -69,12 +66,10 @@ class IngestSlice:
 
 
 def event_time_s(line: str, dsource: str) -> float:
-    """Event-time seconds-into-day for one raw CSV line."""
-    cols = line.split(",")
-    if dsource == "flow":
-        return (int(cols[_FLOW_H]) * 3600 + int(cols[_FLOW_M]) * 60
-                + int(cols[_FLOW_S]))
-    return float(cols[_DNS_TSTAMP])
+    """Event-time seconds for one raw CSV line, through the source
+    spec's clock hook (flow: h/m/s columns; dns: unix_tstamp; declared
+    sources: their `time_field`)."""
+    return get_source(dsource).event_time_s(line)
 
 
 def slice_events(
@@ -167,39 +162,19 @@ class ContinuousResult:
 
 
 def _featurize_slice(lines, dsource: str, cuts):
-    """One slice through the batch featurizers with PINNED cuts (a
-    slice's own ECDF would bin values differently slice-over-slice and
-    churn the vocabulary for nothing — serving/events.py's rule)."""
-    if dsource == "flow":
-        from ..features.flow import featurize_flow
-
-        return featurize_flow(lines, skip_header=False,
-                              precomputed_cuts=cuts)
-    from ..features.dns import featurize_dns
-
-    rows = [ln.strip().split(",") for ln in lines]
-    return featurize_dns(rows, precomputed_cuts=cuts)
+    """One slice through the source's batch featurizer with PINNED cuts
+    (a slice's own ECDF would bin values differently slice-over-slice
+    and churn the vocabulary for nothing — serving/events.py's rule)."""
+    return get_source(dsource).featurize(
+        lines, skip_header=False, precomputed_cuts=cuts
+    )
 
 
 def _derive_cuts(lines, dsource: str, qtiles_path: str = ""):
-    """Pin the stream's quantile cuts: from a qtiles file when given
-    (stable word identity across service restarts), else from the
-    bootstrap slice's own ECDF."""
-    if dsource == "flow" and qtiles_path:
-        from ..features.qtiles import read_flow_qtiles
-
-        return read_flow_qtiles(qtiles_path)
-    from ..features.flow import featurize_flow
-
-    if dsource == "flow":
-        feats = featurize_flow(lines, skip_header=False)
-        return (feats.time_cuts, feats.ibyt_cuts, feats.ipkt_cuts)
-    from ..features.dns import featurize_dns
-
-    feats = featurize_dns([ln.strip().split(",") for ln in lines])
-    return (feats.time_cuts, feats.frame_length_cuts,
-            feats.subdomain_length_cuts, feats.entropy_cuts,
-            feats.numperiods_cuts)
+    """Pin the stream's quantile cuts: from a qtiles file when the
+    source supports one (stable word identity across service restarts),
+    else from the bootstrap slice's own ECDF."""
+    return get_source(dsource).derive_cuts(lines, qtiles_path)
 
 
 class ContinuousService:
@@ -217,8 +192,11 @@ class ContinuousService:
         fresh_control: bool = False,
         warmup_refreshes: "int | None" = None,
     ) -> None:
-        if dsource not in ("flow", "dns"):
-            raise ValueError(f"dsource must be flow or dns, got {dsource!r}")
+        if dsource not in source_names():
+            raise ValueError(
+                f"dsource must be one of {'|'.join(source_names())}, "
+                f"got {dsource!r}"
+            )
         self.config = config
         self.cc = config.continuous
         self.dsource = dsource
@@ -276,6 +254,8 @@ class ContinuousService:
         # A restarted service resumes its drift baseline from the
         # journal instead of re-learning it over min_history refreshes.
         self.drift.prime(replayed)
+        self._replayed = replayed
+        self._qgate = None          # built lazily once cuts are pinned
         self.fleet = FleetRegistry(
             journal=raw_journal, recorder=self.recorder,
             capacity_tiers=True,
@@ -331,10 +311,8 @@ class ContinuousService:
         if sl.arrival_wall == 0.0:
             sl.arrival_wall = time.perf_counter()
         if self.cuts is None:
-            qtiles = (
-                self.config.qtiles_path if self.dsource == "flow" else ""
-            )
-            self.cuts = _derive_cuts(sl.lines, self.dsource, qtiles)
+            self.cuts = _derive_cuts(sl.lines, self.dsource,
+                                     self.config.qtiles_path)
         feats = _featurize_slice(sl.lines, self.dsource, self.cuts)
         self.window.ingest(word_count_columns(feats), sl.t0, sl.t1)
         if self._next_refresh_t is None:
@@ -429,13 +407,26 @@ class ContinuousService:
             mode=mode, em_iters=result.em_iters,
         )
         publish_wall = None
+        quality_info = {}
         if ok:
-            model = self._publish(snap, result)
-            publish_wall = time.perf_counter()
-            self._prev_probs = np.asarray(
-                model.p[:-1], np.float64
-            )  # drop fallback row: the [V_real, K] warm-start seed
-            self._prev_alpha = result.alpha
+            model = self._build_model(snap, result)
+            qgate = self._quality_gate()
+            if qgate is not None:
+                qdec = qgate.check(model)
+                ok = qgate.gate(
+                    qdec, version=version, tenant=self.tenant,
+                )
+                quality_info = {
+                    "quality_recall": round(qdec.recall, 6),
+                    "quality_regressed": qdec.regressed,
+                }
+            if ok:
+                self._publish(model, snap)
+                publish_wall = time.perf_counter()
+                self._prev_probs = np.asarray(
+                    model.p[:-1], np.float64
+                )  # drop fallback row: the [V_real, K] warm-start seed
+                self._prev_alpha = result.alpha
         if mode == "fresh":
             self._last_fresh_iters = result.em_iters
         iters_saved = (
@@ -461,6 +452,7 @@ class ContinuousService:
             "vocab": snap.real_vocab,
             "vocab_capacity": snap.vocab_capacity,
             "window_chunks": snap.chunks,
+            **quality_info,
             **fresh,
         }
         self.refresh_records.append(record)
@@ -496,44 +488,70 @@ class ContinuousService:
             return "warm"
         return self.drift.mode        # fresh right after a veto
 
-    def _publish(self, snap, result):
+    def _build_model(self, snap, result):
         from ..scoring import ScoringModel
 
-        sc = self.config.scoring
-        fallback = (
-            sc.flow_fallback if self.dsource == "flow"
-            else sc.dns_fallback
-        )
+        fallback = get_source(self.dsource).fallback(self.config.scoring)
         corpus = snap.corpus
         # The published model covers the REAL vocabulary only: the
         # tier's pad words never occur in an event and must not ride
         # into word_index.
-        model = ScoringModel.from_lda(
+        return ScoringModel.from_lda(
             corpus.doc_names,
             result.gamma,
             corpus.vocab[: snap.real_vocab],
             result.log_beta[:, : snap.real_vocab],
             fallback,
         )
+
+    def _publish(self, model, snap) -> None:
         self.fleet.publish(
             self.tenant, model,
             source=f"window@{round(snap.t1, 1)}",
         )
         if self.scorer is None:
             self._start_scorer()
-        return model
+
+    def _quality_gate(self):
+        """The detection-quality publish gate, built lazily: the
+        injection suite needs the stream's pinned cuts, which exist
+        only after the bootstrap slice.  Off unless
+        ContinuousConfig.quality_gate."""
+        if not self.cc.quality_gate:
+            return None
+        if self._qgate is None:
+            from ..models.drift import QualityGate
+            from ..sources.quality import QualitySuite
+
+            cc = self.cc
+            suite = QualitySuite(
+                self.dsource, self.cuts,
+                n_events=cc.quality_events, seed=cc.quality_seed,
+                attack_events=cc.quality_attack_events, k=cc.quality_k,
+            )
+            raw_journal = (
+                self.journal.journal if self.journal is not None
+                else None
+            )
+            if raw_journal is not None:
+                # The suite's provenance record: what was injected,
+                # under which seed — the ground truth every subsequent
+                # quality_gate record is judged against.
+                raw_journal.append(suite.manifest)
+            self._qgate = QualityGate(
+                suite,
+                tol=cc.quality_tol,
+                history=cc.quality_history,
+                min_history=cc.quality_min_history,
+                journal=raw_journal, recorder=self.recorder,
+            )
+            self._qgate.prime(self._replayed)
+        return self._qgate
 
     def _start_scorer(self) -> None:
-        from ..serving import (
-            DnsEventFeaturizer,
-            FleetScorer,
-            FlowEventFeaturizer,
-        )
+        from ..serving import FleetScorer
 
-        fz = (
-            FlowEventFeaturizer(self.cuts) if self.dsource == "flow"
-            else DnsEventFeaturizer(self.cuts)
-        )
+        fz = get_source(self.dsource).event_featurizer(self.cuts)
         # Flagged-event product sink: the scored output IS the
         # pipeline's purpose — suspicious connects stream to
         # flagged_events.jsonl as they score (serve mode's on_batch
@@ -721,6 +739,12 @@ class ContinuousService:
             "skipped_refreshes": self.skipped_refreshes,
             "publishes": self.drift.publishes,
             "vetoes": self.drift.vetoes,
+            "quality_checks": (
+                self._qgate.checks if self._qgate is not None else 0
+            ),
+            "quality_vetoes": (
+                self._qgate.vetoes if self._qgate is not None else 0
+            ),
             "version": (
                 self.fleet.version(self.tenant)
                 if self.tenant in self.fleet.tenants() else 0
@@ -778,11 +802,17 @@ def build_parser() -> argparse.ArgumentParser:
         "freshness in minutes, not next-day (tools/day_replay.py "
         "paces a historical day into this mode)",
     )
-    p.add_argument("dsource", choices=["flow", "dns"])
+    p.add_argument("dsource", choices=list(source_names()))
     p.add_argument("--flow-path", default=None,
                    help="raw netflow CSV to replay (FLOW_PATH env)")
     p.add_argument("--dns-path", default=None,
                    help="raw DNS CSV to replay (DNS_PATH env)")
+    p.add_argument("--proxy-path", default=None,
+                   help="raw proxy/HTTP log CSV to replay (PROXY_PATH "
+                   "env)")
+    p.add_argument("--quality-gate", action="store_true",
+                   help="veto publishes that regress recall@k on the "
+                   "labeled-injection suite (sources/inject.py)")
     p.add_argument("--data-dir", default=None,
                    help="output/journal directory (LPATH env)")
     p.add_argument("--qtiles", default=None,
@@ -812,9 +842,8 @@ def main(argv: "list[str] | None" = None) -> int:
     args = build_parser().parse_args(argv)
     env = os.environ
     path = (
-        (args.flow_path or env.get("FLOW_PATH", ""))
-        if args.dsource == "flow"
-        else (args.dns_path or env.get("DNS_PATH", ""))
+        getattr(args, f"{args.dsource}_path", None)
+        or env.get(f"{args.dsource.upper()}_PATH", "")
     )
     if not path or not os.path.exists(path):
         print(f"continuous: no input file at {path!r}", flush=True)
@@ -829,6 +858,8 @@ def main(argv: "list[str] | None" = None) -> int:
         overrides["window_s"] = args.window_s
     if args.refresh_s is not None:
         overrides["refresh_every_s"] = args.refresh_s
+    if args.quality_gate:
+        overrides["quality_gate"] = True
     if overrides:
         config = config.replace(
             continuous=dataclasses.replace(cc, **overrides)

@@ -47,11 +47,6 @@ from ..config import (
     ScoringConfig,
     TelemetryConfig,
 )
-from ..features import (
-    load_top_domains,
-    read_dns_feedback_rows,
-    read_flow_feedback_rows,
-)
 from ..io import Corpus, formats
 from ..models import train_corpus, train_corpus_online
 from ..scoring import ScoringModel
@@ -280,103 +275,21 @@ def _run_stage(ctx: RunContext, stage: Stage, fn: Callable[[], dict]) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _dns_sources(path: str) -> list:
-    """DNS input spec -> ordered featurizer sources: CSV paths stay
-    paths (streamed through the native featurizer); parquet files
-    become pre-projected row lists (the reference reads Hive parquet,
-    dns_pre_lda.scala:142).  The spec takes the same forms as
-    FLOW_PATH — comma list, directories, globs
-    (features.native_flow.expand_flow_paths) — and order is preserved:
-    the first-seen id contract depends on event order.  An empty
-    expansion raises rather than producing an empty day."""
-    from ..features.native_flow import expand_flow_paths
-
-    paths = expand_flow_paths(path)
-    if not paths:
-        raise OSError(f"no DNS input files match {path!r}")
-    return [
-        _read_parquet_rows(p) if p.endswith(".parquet") else p
-        for p in paths
-    ]
-
-
-def _read_parquet_rows(path: str) -> list[list[str]]:
-    cols = [
-        "frame_time", "unix_tstamp", "frame_len", "ip_dst", "dns_qry_name",
-        "dns_qry_class", "dns_qry_type", "dns_qry_rcode",
-    ]
-    try:
-        import pyarrow.parquet as pq  # optional in this image
-
-        table = pq.read_table(path, columns=cols)
-        arrays = [table.column(c).to_pylist() for c in cols]
-    except ImportError as e:
-        raise RuntimeError(
-            f"parquet input {path} requires pyarrow, which is unavailable; "
-            "convert to CSV with the 8 DNS columns instead"
-        ) from e
-    return [
-        [str(v) if v is not None else "" for v in row] for row in zip(*arrays)
-    ]
-
-
 def stage_pre(ctx: RunContext) -> dict:
     cfg = ctx.config
-    fb = cfg.feedback
     from ..features.shards import resolve_pre_workers
+    from ..sources import get as get_source
 
     workers, workers_src = resolve_pre_workers(
         cfg.pre_workers, with_source=True
     )
     timings: dict = {}
-    if ctx.dsource == "flow":
-        fb_rows = read_flow_feedback_rows(
-            os.path.join(cfg.data_dir, "flow_scores.csv"),
-            fb.dup_factor,
-            fb.nonthreatening_severity,
-        )
-        cuts = None
-        if cfg.qtiles_path:
-            from ..features.qtiles import read_flow_qtiles
-
-            cuts = read_flow_qtiles(cfg.qtiles_path)
-        from ..features.native_flow import featurize_flow_file
-
-        # Raw rows stream to a spill file during ingest: RSS stays
-        # bounded by the numeric arrays, and features.pkl references the
-        # file instead of embedding the whole day's bytes (config-3
-        # 30-day corpora do not fit RAM; the scorer mmaps rows back in
-        # on demand at emit time).
-        features = featurize_flow_file(
-            cfg.flow_path, feedback_rows=fb_rows, precomputed_cuts=cuts,
-            spill_path=ctx.path("raw_lines.bin"),
-            workers=workers, timings=timings,
-        )
-    else:
-        fb_rows = read_dns_feedback_rows(
-            os.path.join(cfg.data_dir, "dns_scores.csv"),
-            fb.dup_factor,
-            fb.nonthreatening_severity,
-        )
-        top = (
-            load_top_domains(cfg.top_domains_path)
-            if cfg.top_domains_path
-            else frozenset()
-        )
-        from ..features.native_dns import featurize_dns_sources
-
-        # Rows stream to the spill file during native ingest, so CSV
-        # sources never hold the day's bytes in RAM and features.pkl
-        # references the file.  A run that fell back to the pure-Python
-        # container (hostile transport bytes, no C++ toolchain) keeps
-        # rows in memory — that path exists for correctness, not
-        # day-scale data.
-        features = featurize_dns_sources(
-            _dns_sources(cfg.dns_path), top_domains=top,
-            feedback_rows=fb_rows,
-            spill_path=ctx.path("raw_lines.bin"),
-            workers=workers, timings=timings,
-        )
+    # The whole day rides the source spec's `featurize_day` hook —
+    # feedback ingestion, pinned-cut resolution, native/spill-file
+    # streaming — so a registered source needs zero edits here.
+    features, fb_rows = get_source(ctx.dsource).featurize_day(
+        cfg, ctx.path("raw_lines.bin"), workers, timings,
+    )
     if ctx.plane is not None:
         return _finish_pre_dataplane(ctx, features, fb_rows, workers,
                                      workers_src, timings)
@@ -726,10 +639,11 @@ def stage_lda(ctx: RunContext) -> dict:
         # the results CSVs' round-trip arithmetic (ScoringModel.from_lda
         # — identical doubles, so identical scored bytes), parked so
         # scoring starts without reading back the demoted checkpoints.
-        sc = ctx.config.scoring
+        from ..sources import get as get_source
+
         ctx.model_handoff = ScoringModel.from_lda(
             corpus.doc_names, result.gamma, corpus.vocab, result.log_beta,
-            sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback,
+            get_source(ctx.dsource).fallback(ctx.config.scoring),
         )
     lls = [ll for ll, _ in result.likelihoods]
     out = {
@@ -926,8 +840,9 @@ def stage_score(ctx: RunContext) -> dict:
             features = pickle.load(f)
         feat_src = "file"
         _resolve_spill_blobs(ctx, features)
-    sc = ctx.config.scoring
-    fallback = sc.flow_fallback if ctx.dsource == "flow" else sc.dns_fallback
+    from ..sources import get as get_source
+
+    fallback = get_source(ctx.dsource).fallback(ctx.config.scoring)
     if ctx.model_handoff is not None:
         model = ctx.model_handoff
         ctx.model_handoff = None
@@ -1015,9 +930,10 @@ def _resolve_spill_blobs(ctx: RunContext, features) -> None:
 def _score_day(ctx: RunContext, features, model, prep,
                feat_src: str, model_src: str) -> dict:
     sc = ctx.config.scoring
-    from ..scoring import DispatchStats, score_dns_csv, score_flow_csv
+    from ..scoring import DispatchStats
+    from ..sources import get as get_source
 
-    score_fn = score_flow_csv if ctx.dsource == "flow" else score_dns_csv
+    score_fn = get_source(ctx.dsource).score_csv
     # engine="device" runs the fused on-chip filter pipeline
     # (scoring/pipeline.py), data-parallel over the run's mesh when one
     # is active — the same mesh the LDA stage trained on.  The default
@@ -1152,8 +1068,13 @@ def run_pipeline(
     """Run (or resume) the pipeline for one day.  Completed stages are
     skipped unless `force`; `stages` restricts to a subset (they still run
     in pipeline order)."""
-    if dsource not in ("flow", "dns"):
-        raise ValueError(f"dsource must be flow or dns, got {dsource!r}")
+    from ..sources import names as source_names
+
+    if dsource not in source_names():
+        raise ValueError(
+            f"dsource must be one of {'|'.join(source_names())}, "
+            f"got {dsource!r}"
+        )
     if online and eval_holdout:
         raise ValueError("--eval-holdout is batch-mode only")
     if eval_quality and eval_holdout:
@@ -1549,6 +1470,7 @@ def _build_config(args: argparse.Namespace) -> PipelineConfig:
         data_dir=args.data_dir or env.get("LPATH", "."),
         flow_path=args.flow_path or env.get("FLOW_PATH", ""),
         dns_path=args.dns_path or env.get("DNS_PATH", ""),
+        proxy_path=args.proxy_path or env.get("PROXY_PATH", ""),
         top_domains_path=args.top_domains or "",
         qtiles_path=args.qtiles or "",
         pre_workers=args.pre_workers,
@@ -1606,8 +1528,10 @@ def build_parser() -> argparse.ArgumentParser:
         "`ml_ops continuous --help` for windowed streaming ingestion "
         "with warm-start EM and drift-gated publishes",
     )
+    from ..sources import names as source_names
+
     p.add_argument("fdate", help="day to analyze, YYYYMMDD")
-    p.add_argument("dsource", choices=["flow", "dns"])
+    p.add_argument("dsource", choices=list(source_names()))
     p.add_argument(
         "tol", nargs="?", type=float,
         default=float(os.environ.get("TOL", 1.1)),
@@ -1626,6 +1550,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="DNS input: CSV/parquet file, directory, glob, or "
         "comma-separated list (the reference's comma-separated Hive "
         "parquet paths, dns_pre_lda.scala:142)",
+    )
+    p.add_argument(
+        "--proxy-path", default=None,
+        help="proxy/HTTP log CSV input: file, directory, glob, or "
+        "comma-separated list (sources/generic.ProxySource columns)",
     )
     p.add_argument("--top-domains", default=None, help="top-1m.csv path")
     p.add_argument(

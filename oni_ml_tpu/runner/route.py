@@ -332,8 +332,9 @@ def route_stream(args) -> int:
             if not spec.day_dir:
                 raise SystemExit(
                     f"tenant {spec.tenant!r} has no day_dir")
-            fallback = (SC().flow_fallback if spec.dsource == "flow"
-                        else SC().dns_fallback)
+            from ..sources import get as get_source
+
+            fallback = get_source(spec.dsource).fallback(SC())
             snap = ModelRegistry().load_day(spec.day_dir, fallback)
             fz = _load_featurizer(spec.day_dir, args.top_domains)
             router.add_tenant(spec, (), snap.model, featurizer=fz)

@@ -30,6 +30,8 @@ import sys
 import numpy as np
 
 from ..config import OnlineLDAConfig, ScoringConfig, ServingConfig
+from ..sources import get as get_source
+from ..sources import names as source_names
 from ..serving import (
     BatchScorer,
     MetricsEmitter,
@@ -48,7 +50,8 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--day-dir", default=None,
                    help="completed day directory (doc_results.csv / "
                    "word_results.csv / features.pkl)")
-    p.add_argument("--dsource", choices=["flow", "dns"], default="flow")
+    p.add_argument("--dsource", choices=list(source_names()),
+                   default="flow")
     p.add_argument("--input", default="-", metavar="PATH",
                    help="raw event CSV stream; '-' = stdin (default)")
     p.add_argument("--threshold", type=float,
@@ -208,12 +211,13 @@ def _load_featurizer(day_dir: str, top_domains_path: "str | None"):
 
 
 def _looks_like_header(line: str, dsource: str) -> bool:
-    """True when a stream's FIRST line is a column-name header: its
-    always-numeric column (flow `hour`, dns `unix_tstamp`) doesn't
-    parse.  Only consulted for the first line, so mid-stream garbage
-    rows keep the batch path's NaN-featurize-and-score semantics."""
+    """True when a stream's FIRST line is a column-name header: the
+    source spec's always-numeric probe column (flow `hour`, dns
+    `unix_tstamp`, proxy `duration`) doesn't parse.  Only consulted
+    for the first line, so mid-stream garbage rows keep the batch
+    path's NaN-featurize-and-score semantics."""
     parts = line.strip().split(",")
-    col = 4 if dsource == "flow" else 1
+    col = get_source(dsource).header_probe_col
     if len(parts) <= col:
         return False
     try:
@@ -278,7 +282,7 @@ def serve_stream(args) -> int:
     )
     cfg = _serving_config(args)
     sc = SC()
-    fallback = sc.flow_fallback if args.dsource == "flow" else sc.dns_fallback
+    fallback = get_source(args.dsource).fallback(sc)
     registry = ModelRegistry()
     snap = registry.load_day(args.day_dir, fallback)
     featurizer = _load_featurizer(args.day_dir, args.top_domains)
@@ -616,8 +620,7 @@ def serve_fleet_stream(args) -> int:
         # thousand-tenant census pays ZERO startup stack builds; the
         # first admissions fill the hot tier.
         fleet.add_tenant(spec, hot=not tiered)
-        fallback = (sc.flow_fallback if spec.dsource == "flow"
-                    else sc.dns_fallback)
+        fallback = get_source(spec.dsource).fallback(sc)
         snap = fleet.load_day(spec.tenant, spec.day_dir, fallback)
         if residency is not None:
             residency.register(
@@ -727,10 +730,10 @@ def serve_fleet_stream(args) -> int:
                         residency.ensure_hot(t)
             for k in ks:
                 stack = fleet.stack(k)
-                mult = 2 if any(
-                    fleet.spec(t).dsource == "flow"
+                mult = max(
+                    get_source(fleet.spec(t).dsource).pairs_per_event
                     for t in stack.tenants
-                ) else 1
+                )
                 warm.append({
                     "k": k, "tenants": len(stack.tenants),
                     "capacity": stack.capacity or None,

@@ -20,10 +20,9 @@ from typing import Sequence
 
 import numpy as np
 
-from ..features.dns import DNS_COLUMNS, NUM_DNS_COLUMNS, featurize_dns
+from ..features.dns import NUM_DNS_COLUMNS, featurize_dns
 from ..features.flow import NUM_FLOW_COLUMNS, featurize_flow
 from ..scoring import ScoringModel, batched_scores
-from ..scoring.score import _dns_client_strings, _flow_endpoint_strings
 
 
 class FlowEventFeaturizer:
@@ -76,23 +75,14 @@ class DnsEventFeaturizer:
 
 def featurizer_from_features(features, top_domains: frozenset = frozenset()):
     """Build the serving featurizer from a trained day's feature
-    container (features.pkl) — the cuts ride on every FlowFeatures /
-    DnsFeatures instance, native- or Python-backed."""
-    if hasattr(features, "ibyt_cuts"):
-        return FlowEventFeaturizer(
-            (features.time_cuts, features.ibyt_cuts, features.ipkt_cuts)
-        )
-    if hasattr(features, "entropy_cuts"):
-        return DnsEventFeaturizer(
-            (features.time_cuts, features.frame_length_cuts,
-             features.subdomain_length_cuts, features.entropy_cuts,
-             features.numperiods_cuts),
-            top_domains=top_domains,
-        )
-    raise TypeError(
-        f"{type(features).__name__} carries no quantile cuts — not a "
-        "flow/dns feature container"
-    )
+    container (features.pkl) — the cuts ride on every feature container,
+    native- or Python-backed, and the source registry maps the container
+    back to the spec that produced it."""
+    from ..sources import spec_for_features
+
+    spec = spec_for_features(features)
+    return spec.event_featurizer(spec.cuts_of(features),
+                                 top_domains=top_domains)
 
 
 def score_features(
@@ -101,46 +91,29 @@ def score_features(
 ) -> np.ndarray:
     """Per-event suspicion scores for one featurized micro-batch —
     min(src, dest) dot for flow (flow_post_lda.scala:227-239), single
-    <theta_ip, p_word> for DNS — through the calibration-dispatched
-    host/device scorer (scoring.use_device_path; device batches run the
-    chunked pipeline of scoring/pipeline.py).  Endpoint strings come
-    from one column-slicing pass over the raw rows, not 2N bound-method
-    calls (scoring.score._flow_endpoint_strings)."""
-    n = feats.num_raw_events
-    if dsource == "flow":
-        sips, dips = _flow_endpoint_strings(feats, n)
-        src = batched_scores(
-            model,
-            model.ip_rows(sips),
-            model.word_rows(feats.src_word[:n]),
-            device_min,
+    <theta_ip, p_word> for DNS and other single-document sources —
+    through the calibration-dispatched host/device scorer
+    (scoring.use_device_path; device batches run the chunked pipeline of
+    scoring/pipeline.py).  The per-source (document, word) lookup pairs
+    come from the source spec's `event_pairs` hook; multi-pair sources
+    min-combine, the pipeline's "most suspicious endpoint" rule."""
+    from ..sources import get as get_source
+
+    out = None
+    for keys, words in get_source(dsource).event_pairs(feats):
+        scores = batched_scores(
+            model, model.ip_rows(keys), model.word_rows(words), device_min,
         )
-        dst = batched_scores(
-            model,
-            model.ip_rows(dips),
-            model.word_rows(feats.dest_word[:n]),
-            device_min,
-        )
-        return np.minimum(src, dst)
-    return batched_scores(
-        model,
-        model.ip_rows(_dns_client_strings(feats, n)),
-        model.word_rows(list(feats.word[:n])),
-        device_min,
-    )
+        out = scores if out is None else np.minimum(out, scores)
+    return out
 
 
 def event_documents(feats, dsource: str) -> tuple[list[str], list[str]]:
     """(ips, words) training pairs a micro-batch contributes to the
     online refresh — the same document mapping the corpus stage uses:
     flow events feed BOTH endpoints' documents
-    (flow_pre_lda.scala:366-380), DNS events feed the querying client
-    (dns_pre_lda.scala:330)."""
-    n = feats.num_raw_events
-    if dsource == "flow":
-        ips = [feats.sip(i) for i in range(n)]
-        ips += [feats.dip(i) for i in range(n)]
-        words = list(feats.src_word[:n]) + list(feats.dest_word[:n])
-        return ips, words
-    ip_col = DNS_COLUMNS["ip_dst"]
-    return [r[ip_col] for r in feats.rows[:n]], list(feats.word[:n])
+    (flow_pre_lda.scala:366-380), DNS and other client-keyed sources
+    feed the querying client (dns_pre_lda.scala:330)."""
+    from ..sources import get as get_source
+
+    return get_source(dsource).event_documents(feats)

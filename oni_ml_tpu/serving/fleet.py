@@ -54,12 +54,7 @@ import numpy as np
 
 from ..config import ServingConfig
 from ..scoring import ScoringModel
-from ..scoring.score import (
-    _dns_client_strings,
-    _flow_endpoint_strings,
-    batched_scores,
-    use_device_path,
-)
+from ..scoring.score import batched_scores, use_device_path
 from .metrics import MetricsEmitter
 from .registry import ModelRegistry, ModelSnapshot
 from .tenants import (
@@ -490,33 +485,36 @@ def tenant_pairs(feats, dsource: str, model: ScoringModel,
     """One tenant segment's (ip_rows, word_rows) in STACKED coordinates
     plus its pairs-per-event multiplicity: flow events contribute two
     (endpoint, word) pairs each — src block then dst block, min-combined
-    at demux (flow_post_lda.scala:227-239) — DNS events one.  Row
-    lookups go through the tenant's OWN index maps (misses land on the
-    tenant's fallback row), then shift by the tenant's base offset into
-    the stacked matrices: the tenant-id column realized as an index
-    offset, which is what lets one compiled gather serve every tenant."""
-    n = feats.num_raw_events
-    if dsource == "flow":
-        sips, dips = _flow_endpoint_strings(feats, n)
-        ip = np.concatenate(
-            [model.ip_rows(sips), model.ip_rows(dips)]
-        ) + np.int32(ip_base)
-        w = np.concatenate(
-            [model.word_rows(feats.src_word[:n]),
-             model.word_rows(feats.dest_word[:n])]
-        ) + np.int32(word_base)
-        return ip.astype(np.int32), w.astype(np.int32), 2
-    ip = model.ip_rows(_dns_client_strings(feats, n)) + np.int32(ip_base)
-    w = model.word_rows(list(feats.word[:n])) + np.int32(word_base)
-    return ip.astype(np.int32), w.astype(np.int32), 1
+    at demux (flow_post_lda.scala:227-239) — DNS and other client-keyed
+    sources one.  The per-source pair layout comes from the source
+    spec's `event_pairs` hook, so a new registered source serves through
+    this path with zero edits here.  Row lookups go through the tenant's
+    OWN index maps (misses land on the tenant's fallback row), then
+    shift by the tenant's base offset into the stacked matrices: the
+    tenant-id column realized as an index offset, which is what lets one
+    compiled gather serve every tenant."""
+    from ..sources import get as get_source
+
+    pairs = get_source(dsource).event_pairs(feats)
+    ip = np.concatenate(
+        [model.ip_rows(keys) for keys, _ in pairs]
+    ) + np.int32(ip_base)
+    w = np.concatenate(
+        [model.word_rows(words) for _, words in pairs]
+    ) + np.int32(word_base)
+    return ip.astype(np.int32), w.astype(np.int32), len(pairs)
 
 
 def demux_scores(scores_seg: np.ndarray, mult: int) -> np.ndarray:
-    """Per-event scores from a tenant's pair-score segment: flow
-    (mult=2) min-combines the src/dst halves, DNS passes through."""
+    """Per-event scores from a tenant's pair-score segment: multi-pair
+    sources (flow's mult=2 src/dst blocks) min-combine block-wise,
+    single-pair sources pass through."""
     if mult == 2:
         n = scores_seg.shape[0] // 2
         return np.minimum(scores_seg[:n], scores_seg[n:])
+    if mult > 2:
+        n = scores_seg.shape[0] // mult
+        return scores_seg.reshape(mult, n).min(axis=0)
     return scores_seg
 
 

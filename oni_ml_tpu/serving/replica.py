@@ -43,7 +43,6 @@ import time
 from collections import deque
 
 from ..config import ServingConfig
-from .events import DnsEventFeaturizer, FlowEventFeaturizer
 from .fleet import FleetRegistry, FleetScorer
 from .tenants import TenantSpec
 
@@ -92,11 +91,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def featurizer_for(dsource: str, cuts: tuple):
-    if dsource == "flow":
-        return FlowEventFeaturizer(cuts)
-    if dsource == "dns":
-        return DnsEventFeaturizer(cuts)
-    raise ValueError(f"unknown dsource {dsource!r}")
+    from ..sources import get as get_source
+
+    return get_source(dsource).event_featurizer(cuts)
 
 
 class _Resolver:
@@ -448,12 +445,14 @@ class ReplicaServer:
             ks = sorted({
                 self.fleet.tenant_k(t) for t in self.fleet.tenants()
             })
+            from ..sources import get as get_source
+
             for k in ks:
                 stack = self.fleet.stack(k)
-                mult = 2 if any(
-                    self.fleet.spec(t).dsource == "flow"
+                mult = max(
+                    get_source(self.fleet.spec(t).dsource).pairs_per_event
                     for t in stack.tenants
-                ) else 1
+                )
                 out.append({
                     "k": k, "tenants": len(stack.tenants),
                     **plans_warmup.warmup_serving(

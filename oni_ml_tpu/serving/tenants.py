@@ -89,10 +89,12 @@ class TenantSpec:
                 f"{_TENANT_ID_RE.pattern} — ids become OpenMetrics "
                 "name components"
             )
-        if self.dsource not in ("flow", "dns"):
+        from ..sources import names as source_names
+
+        if self.dsource not in source_names():
             raise ValueError(
-                f"tenant {self.tenant!r}: dsource must be flow|dns, "
-                f"got {self.dsource!r}"
+                f"tenant {self.tenant!r}: dsource must be one of "
+                f"{'|'.join(source_names())}, got {self.dsource!r}"
             )
         if self.admission and self.admission not in ADMISSION_POLICIES:
             raise ValueError(

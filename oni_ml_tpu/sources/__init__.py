@@ -1,0 +1,43 @@
+"""Declarative event-source subsystem: specs, registry, injection,
+quality metrics.
+
+Importing this package registers the built-in sources — flow, dns
+(byte-parity wrappers over features/flow.py and features/dns.py) and
+proxy (a declarative TableSourceSpec) — so every layer that resolves
+through `sources.get(name)` / `sources.names()` sees all three.
+
+Import stays jax-free (serving/tenants.py's host-only constraint);
+injection and quality scoring live in submodules imported on use.
+"""
+
+from .builtin import DnsSource, FlowSource
+from .generic import (
+    CutDef,
+    FieldDef,
+    GenericEventFeaturizer,
+    GenericFeatures,
+    ProxySource,
+    TableSourceSpec,
+)
+from .registry import get, names, register, spec_for_features
+from .spec import SourceSpec
+
+register(FlowSource())
+register(DnsSource())
+register(ProxySource())
+
+__all__ = [
+    "CutDef",
+    "DnsSource",
+    "FieldDef",
+    "FlowSource",
+    "GenericEventFeaturizer",
+    "GenericFeatures",
+    "ProxySource",
+    "SourceSpec",
+    "TableSourceSpec",
+    "get",
+    "names",
+    "register",
+    "spec_for_features",
+]

@@ -1,0 +1,60 @@
+"""The source registry: name -> SourceSpec.
+
+Every layer that used to branch on ``dsource in ("flow", "dns")``
+resolves here instead, so registering a spec is the WHOLE act of adding
+a source — `ml_ops`, `run_continuous`, the serving fleet, replicas,
+the router, `day_replay` and `bench.py` all pick up the new name from
+``names()`` without edits.
+
+Import stays jax-free and cheap: builtin + generic specs register at
+package import (sources/__init__.py); heavier machinery (injection,
+quality scoring) lives in modules imported on use.
+"""
+
+from __future__ import annotations
+
+from .spec import SourceSpec
+
+_REGISTRY: "dict[str, SourceSpec]" = {}
+
+
+def register(spec: SourceSpec, replace: bool = False) -> SourceSpec:
+    """Register a spec under its name.  Duplicate names fail loudly
+    unless ``replace`` — two specs answering one dsource would split
+    word identity silently."""
+    if not spec.name:
+        raise ValueError("source spec has no name")
+    if spec.name in _REGISTRY and not replace:
+        raise ValueError(
+            f"source {spec.name!r} already registered "
+            f"(known: {', '.join(names())})"
+        )
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get(name: str) -> SourceSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown source {name!r} (registered: {', '.join(names())})"
+        ) from None
+
+
+def names() -> "tuple[str, ...]":
+    """Registered source names, in registration order (flow and dns
+    first — CLI help and manifest errors read naturally)."""
+    return tuple(_REGISTRY)
+
+
+def spec_for_features(features, top_domains: frozenset = frozenset()):
+    """The spec owning a pickled feature container (features.pkl
+    reconstruction) — each spec recognizes its own containers."""
+    for spec in _REGISTRY.values():
+        if spec.matches_features(features):
+            return spec
+    raise TypeError(
+        f"{type(features).__name__} matches no registered source "
+        f"(registered: {', '.join(names())})"
+    )

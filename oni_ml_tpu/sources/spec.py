@@ -1,0 +1,217 @@
+"""The event-source contract every pipeline layer resolves through.
+
+A *source* is one raw event schema (netflow, DNS, proxy/HTTP, ...) plus
+everything the pipeline needs to turn its CSV lines into scored
+suspicious-connects output: parse/validate rules, per-field quantile-cut
+strategies, the word template, the document mapping, feedback hooks, and
+a synthetic benign-day generator for the detection-quality plane
+(sources/inject.py).
+
+Historically flow and DNS were two bespoke code paths threaded through
+`ml_ops`, `run_continuous`, the fleet/replica serving stack and
+`bench.py` as `if dsource == "flow" ... else ...` branches.  This module
+replaces that with one protocol: the runner/fleet/router layers ask the
+registry (sources/registry.py) for a `SourceSpec` and call its hooks —
+adding a source is registering a spec, not editing serving code.
+
+Two spec families implement the protocol:
+
+  * `builtin.FlowSource` / `builtin.DnsSource` — thin wrappers that
+    delegate to features/flow.py and features/dns.py, so registry-
+    resolved words stay BYTE-IDENTICAL to the legacy featurizers
+    (pinned by tests/test_sources.py against the golden day).
+  * `generic.TableSourceSpec` — a declarative spec (fields, cut
+    strategies, word template) that needs no new code per source; the
+    proxy/HTTP source is one of these.
+
+Nothing here imports jax: specs must resolve on host-only boxes
+(serving/tenants.py's constraint) — scoring imports happen lazily
+inside the hooks that score.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+
+class SourceSpec:
+    """Abstract event-source declaration.
+
+    Subclasses define the class attributes and override the hooks.  The
+    hook set is exactly the union of every call site that used to
+    branch on ``dsource``:
+
+    ============================  =========================================
+    hook                          call site it replaces
+    ============================  =========================================
+    featurize                     continuous._featurize_slice, serving
+                                  event featurizers
+    featurize_day                 ml_ops.stage_pre (native fast paths)
+    feedback_rows                 ml_ops.stage_pre feedback ingestion
+    derive_cuts / cuts_of         continuous bootstrap + featurizer pinning
+    event_time_s                  continuous.slice_events ordering
+    event_featurizer              serving/replica featurizer construction
+    event_pairs                   fleet.tenant_pairs, events.score_features
+    event_documents               events.event_documents (online refresh)
+    event_indices                 dataplane/scoreprep, scoring cores
+    score_csv                     ml_ops stage_score score_fn dispatch
+    fallback                      flow_fallback/dns_fallback selection
+    input_path / top_domains      ml_ops CLI path plumbing
+    synth_benign                  sources/inject.py benign-day synthesis
+    ============================  =========================================
+    """
+
+    #: registry key; also the ``dsource`` value in manifests and CLIs.
+    name: str = ""
+    #: exact CSV column count a valid event must have.
+    num_columns: int = 0
+    #: documents each event feeds: 2 = flow-style (both endpoints,
+    #: scores min-combined), 1 = client-only (dns, proxy).
+    pairs_per_event: int = 1
+    #: an always-numeric column — probing it on the first line of a
+    #: stream detects a header without source-specific sniffing.
+    header_probe_col: int = 0
+
+    # -- featurization ----------------------------------------------------
+
+    def featurize(self, events: Iterable, *, precomputed_cuts=None,
+                  skip_header: bool = False, feedback_rows: Sequence = (),
+                  top_domains: frozenset = frozenset()):
+        """Raw CSV lines (or pre-split rows) -> feature container."""
+        raise NotImplementedError
+
+    def featurize_day(self, config, spill_path: str, workers: int,
+                      timings: dict):
+        """Batch stage_pre: (features, feedback_rows) for a whole day,
+        through the native fast path when one exists."""
+        fb_rows = self.feedback_rows(config)
+        lines = self.read_input(self.input_path(config))
+        feats = self.featurize(
+            lines, skip_header=True, feedback_rows=fb_rows,
+            precomputed_cuts=self.qtiles_cuts(config),
+            top_domains=self.top_domains(config),
+        )
+        return feats, fb_rows
+
+    def feedback_rows(self, config) -> Sequence:
+        """Analyst-feedback duplicates appended to the training rows
+        (flow/dns read <dsource>_scores.csv; default: none)."""
+        return ()
+
+    def qtiles_cuts(self, config):
+        """Precomputed day cuts from config (flow's vestigial qtiles
+        file); None = derive from the day's own ECDF."""
+        return None
+
+    def cuts_of(self, features) -> tuple:
+        """The pinned quantile cuts riding on a feature container —
+        what serving featurizers carry so micro-batches bin exactly
+        like the trained day."""
+        raise NotImplementedError
+
+    def matches_features(self, features) -> bool:
+        """Does this container belong to this source?  (Featurizer
+        reconstruction from a pickled features.pkl.)"""
+        return False
+
+    def derive_cuts(self, lines: Sequence[str],
+                    qtiles_path: str = "") -> tuple:
+        """Bootstrap cuts for continuous mode: from a qtiles file when
+        the source supports one, else the slice's own ECDF (one
+        featurize pass)."""
+        feats = self.featurize(lines, skip_header=False)
+        return self.cuts_of(feats)
+
+    def event_featurizer(self, cuts: tuple,
+                         top_domains: frozenset = frozenset()):
+        """Serving-side featurizer (validate + __call__) pinned to the
+        trained day's cuts; carries ``dsource == self.name``."""
+        raise NotImplementedError
+
+    # -- event identity ---------------------------------------------------
+
+    def event_time_s(self, line: str) -> float:
+        """Event time in seconds (of day, or epoch — only ordering and
+        deltas matter) for slice assignment.  Raises on garbage."""
+        raise NotImplementedError
+
+    def event_pairs(self, feats) -> "list[tuple[list[str], list[str]]]":
+        """The (doc keys, words) blocks of one featurized batch —
+        ``pairs_per_event`` blocks, each one lookup per raw event.
+        Block scores min-combine into the event score."""
+        raise NotImplementedError
+
+    def event_documents(self, feats) -> "tuple[list[str], list[str]]":
+        """All (ip, word) training pairs a batch contributes to the
+        online refresh: every block of event_pairs, concatenated."""
+        ips: list[str] = []
+        words: list[str] = []
+        for keys, ws in self.event_pairs(feats):
+            ips.extend(keys)
+            words.extend(ws)
+        return ips, words
+
+    def event_indices(self, features, ip_index: dict,
+                      word_index: dict) -> tuple:
+        """Model-row index arrays for the batch scoring core —
+        ``2 * pairs_per_event`` int arrays (key, word per block);
+        missing keys map to the fallback row ``len(index)``."""
+        n = features.num_raw_events
+        out = []
+        for keys, words in self.event_pairs(features):
+            out.append(_index_rows(ip_index, keys[:n], len(ip_index)))
+            out.append(_index_rows(word_index, words[:n], len(word_index)))
+        return tuple(out)
+
+    # -- scoring ----------------------------------------------------------
+
+    def score_csv(self, features, model, threshold: float,
+                  engine=None, chunk=None, mesh=None, stats=None,
+                  prep=None) -> "tuple[bytes, np.ndarray]":
+        """Batch stage_score: (results CSV bytes, ascending kept
+        scores)."""
+        raise NotImplementedError
+
+    def fallback(self, scoring_cfg) -> float:
+        """The unseen-ip/word fallback probability for this source."""
+        return getattr(scoring_cfg, f"{self.name}_fallback")
+
+    # -- input plumbing ---------------------------------------------------
+
+    def input_path(self, config) -> str:
+        return getattr(config, f"{self.name}_path", "")
+
+    def top_domains(self, config) -> frozenset:
+        return frozenset()
+
+    def read_input(self, path: str) -> Iterable[str]:
+        """Input spec -> raw CSV lines (comma lists / dirs / globs,
+        features.native_flow.expand_flow_paths forms)."""
+        from ..features.native_flow import expand_flow_paths
+
+        paths = expand_flow_paths(path)
+        if not paths:
+            raise OSError(f"no {self.name} input files match {path!r}")
+        for p in paths:
+            with open(p) as f:
+                yield from f
+
+    # -- detection-quality plane ------------------------------------------
+
+    def synth_benign(self, n_events: int, seed: int) -> "list[str]":
+        """A deterministic synthetic benign day (raw CSV lines, event-
+        time ordered) for the injection suite (sources/inject.py)."""
+        raise NotImplementedError
+
+
+def _index_rows(index: dict, keys: Sequence[str],
+                fallback_row: int) -> np.ndarray:
+    """dict lookups -> int32 row array with the fallback row for
+    misses — the same mapping ScoringModel.ip_rows/word_rows apply."""
+    get = index.get
+    n = len(keys)
+    return np.fromiter(
+        (get(k, fallback_row) for k in keys), dtype=np.int32, count=n
+    )

@@ -343,6 +343,16 @@ def _patch_phases(bench, monkeypatch):
         },
     )
     monkeypatch.setattr(
+        bench, "bench_detection_quality",
+        lambda *a, **k: {
+            src: {"recall_at_k": 1.0, "precision_at_k": 1.0,
+                  "score_separation": 2.5, "k": 24, "attacks": 24,
+                  "per_scenario": {}, "events": 8024, "vocab": 900,
+                  "docs": 48, "wall_s": 3.1}
+            for src in ("flow", "dns", "proxy")
+        },
+    )
+    monkeypatch.setattr(
         bench, "bench_distributed_em",
         lambda *a, **k: {
             "nprocs": 2, "docs": 2048, "em_iters": 6, "em_shards": 8,
@@ -505,6 +515,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "serving_slo_fleet_paged",
         "serving_slo_replicated",
         "streaming_freshness",
+        "detection_quality",
         "distributed_em",
         "pipeline_e2e",
         "pipeline_e2e_dns",

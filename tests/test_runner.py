@@ -36,7 +36,7 @@ def test_dns_parquet_source(tmp_path):
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    from oni_ml_tpu.runner.ml_ops import _dns_sources
+    from oni_ml_tpu.sources.builtin import _dns_sources
 
     n = 6
     table = pa.table({
@@ -536,7 +536,7 @@ def test_dns_sources_expand_dir_and_glob(tmp_path):
     expansions raise instead of producing an empty day."""
     import pytest
 
-    from oni_ml_tpu.runner.ml_ops import _dns_sources
+    from oni_ml_tpu.sources.builtin import _dns_sources
 
     d = tmp_path / "dns_parts"
     d.mkdir()

@@ -1,0 +1,88 @@
+"""Emit a labeled injected day to disk for any registered source.
+
+The file-shaped face of sources/inject.py: one benign synthetic day
+with the source's attack scenarios planted into it, written as
+
+    <out-dir>/day.csv        the raw event CSV, event-time ordered —
+                             feeds `tools/day_replay.py --dsource X`
+                             or `ml_ops continuous` directly
+    <out-dir>/labels.jsonl   ground truth: one {"index", "scenario",
+                             "entity"} row per attack line (index into
+                             day.csv)
+    <out-dir>/manifest.json  the {"kind": "injection"} record — same
+                             vocabulary as the journal record
+                             continuous mode emits when it builds its
+                             quality suite
+
+Everything is deterministic under --seed: same arguments, byte-
+identical outputs (pinned by tests/test_sources.py).
+
+Usage:
+
+    python tools/attack_gen.py proxy --out-dir /tmp/proxy_day \
+        --events 8000 --attack-events 8 --seed 7
+    python tools/day_replay.py /tmp/proxy_day/day.csv --dsource proxy
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from oni_ml_tpu.sources import inject, names as source_names  # noqa: E402
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Generate a labeled attack-injected day for a "
+        "registered source."
+    )
+    ap.add_argument("source", choices=list(source_names()))
+    ap.add_argument("--out-dir", required=True,
+                    help="output directory (created if missing)")
+    ap.add_argument("--events", type=int, default=8000,
+                    help="benign event count (default 8000)")
+    ap.add_argument("--attack-events", type=int, default=8,
+                    help="events per attack scenario (default 8)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--scenarios", default=None,
+                    help="comma list of scenario names (default: every "
+                    "scenario registered for the source)")
+    args = ap.parse_args(argv)
+    scenarios = (tuple(s for s in args.scenarios.split(",") if s)
+                 if args.scenarios is not None else None)
+    try:
+        day = inject.inject_scenarios(
+            args.source, n_events=args.events, seed=args.seed,
+            scenarios=scenarios, attack_events=args.attack_events,
+        )
+    except ValueError as e:
+        print(f"attack_gen: {e}", file=sys.stderr)
+        return 2
+    os.makedirs(args.out_dir, exist_ok=True)
+    day_path = os.path.join(args.out_dir, "day.csv")
+    with open(day_path, "w") as f:
+        f.write("\n".join(day.lines) + "\n")
+    with open(os.path.join(args.out_dir, "labels.jsonl"), "w") as f:
+        for row in day.label_rows():
+            f.write(json.dumps(row) + "\n")
+    with open(os.path.join(args.out_dir, "manifest.json"), "w") as f:
+        json.dump(day.manifest, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "out_dir": args.out_dir,
+        "source": args.source,
+        "events": len(day.lines),
+        "attacks": day.n_attacks,
+        "scenarios": day.manifest["scenarios"],
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

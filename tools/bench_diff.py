@@ -91,6 +91,42 @@ def _streaming_rows(name: str, old: dict, new: dict,
     return rows
 
 
+# Detection-quality phase: every key is HIGHER-better —
+# precision/recall@k are fractions of attacks ranked inside the top-k,
+# score_separation is the median benign-vs-attack log-score gap in
+# nats.  A recall drop gates exit 1 exactly like a p99 blowup; the
+# per-source sections gate too, so one source regressing cannot hide
+# behind the cross-source mean.
+_QUALITY_PHASE = "detection_quality"
+_QUALITY_KEYS = (
+    ("recall_at_k", "fraction"),         # higher-better
+    ("precision_at_k", "fraction"),      # higher-better
+    ("score_separation", "nats"),        # higher-better
+)
+
+
+def _quality_rows(name: str, old: dict, new: dict,
+                  threshold_pct: float) -> "list[dict]":
+    rows = []
+    for key, unit in _QUALITY_KEYS:
+        r = _rel_row(f"{name}.{key}", old.get(key), new.get(key), unit,
+                     threshold_pct)
+        if r:
+            rows.append(r)
+    old_src = old.get("sources") or {}
+    new_src = new.get("sources") or {}
+    for src in sorted(set(old_src) & set(new_src)):
+        o, n = old_src[src], new_src[src]
+        if not isinstance(o, dict) or not isinstance(n, dict):
+            continue
+        for key, unit in _QUALITY_KEYS:
+            r = _rel_row(f"{name}:{src}.{key}", o.get(key), n.get(key),
+                         unit, threshold_pct)
+            if r:
+                rows.append(r)
+    return rows
+
+
 # Replicated elastic serving phase: direction per key — aggregate
 # sustained events/s per replica count and the scaling efficiency are
 # higher-better; the chaos phase's p999-during-failover and
@@ -328,6 +364,15 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
     if "freshness_p50_s" in old and "freshness_p50_s" in new:
         rows.extend(_streaming_rows("headline", old, new,
                                     threshold_pct, ll_drop))
+    # Detection-quality keys (all higher-better: recall/precision@k,
+    # score separation; per-source sections too) — phase payloads and
+    # quality-headline captures.
+    o, n = old_sec.get(_QUALITY_PHASE), new_sec.get(_QUALITY_PHASE)
+    if isinstance(o, dict) and isinstance(n, dict):
+        rows.extend(_quality_rows(f"phase:{_QUALITY_PHASE}", o, n,
+                                  threshold_pct))
+    if "recall_at_k" in old and "recall_at_k" in new:
+        rows.extend(_quality_rows("headline", old, new, threshold_pct))
     # Streaming-dataplane overlap efficiency (absolute fraction).
     for name in _OVERLAP_PHASES:
         o, n = old_sec.get(name), new_sec.get(name)

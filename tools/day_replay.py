@@ -7,8 +7,9 @@ eaten tomorrow — as the event stream it originally was, at ×N
 real-time speed, through `ml_ops continuous`'s service loop
 (oni_ml_tpu/runner/continuous.py).
 
-Slicing is event-time-ordered (`slice_events`: flow rows by their
-hour/minute/second columns, DNS rows by unix_tstamp) and pacing is the
+Slicing is event-time-ordered (`slice_events`, through the source
+registry's `event_time_s` hook — flow rows by their hour/minute/second
+columns, DNS rows by unix_tstamp, proxy rows by p_time) and pacing is the
 load generator's open-loop discipline (tools/load_gen.py): each
 slice's delivery wall-time is its event-time offset divided by the
 speed factor, and a delivery that falls behind schedule is not dropped
@@ -46,6 +47,7 @@ from oni_ml_tpu.runner.continuous import (  # noqa: E402
     run_continuous,
     slice_events,
 )
+from oni_ml_tpu.sources import names as source_names  # noqa: E402
 
 
 def replay_slices(path: str, dsource: str, *, slice_s: float,
@@ -84,8 +86,9 @@ def main(argv: "list[str] | None" = None) -> int:
         description="Replay a historical day CSV into continuous-mode "
         "ingest at ×N real-time speed."
     )
-    ap.add_argument("day_csv", help="raw flow/DNS CSV of one day")
-    ap.add_argument("--dsource", choices=["flow", "dns"],
+    ap.add_argument("day_csv",
+                    help="raw CSV of one day (any registered source)")
+    ap.add_argument("--dsource", choices=list(source_names()),
                     default="flow")
     ap.add_argument("--speed", type=float, default=60.0,
                     help="replay speed multiplier (60 = 1 event-hour "

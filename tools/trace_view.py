@@ -278,6 +278,39 @@ def journal_to_trace(records: "list[dict]") -> dict:
                          ("version", "ll", "delta", "mode", "em_iters")
                          if k in rec},
             })
+        elif kind == "quality_gate":
+            # Detection-quality twin of publish_gate: recall@k as a
+            # counter lane (with its rolling baseline when warmed) and
+            # an instant per verdict, so a quality veto reads as "the
+            # recall curve fell out of its band" right next to the
+            # drift lane.
+            vetoed = rec.get("action") == "vetoed"
+            args = {"recall_at_k": rec.get("recall_at_k")}
+            if isinstance(rec.get("baseline_recall"), (int, float)):
+                args["baseline_recall"] = rec["baseline_recall"]
+            events.append({
+                "name": "quality recall@k", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0, "args": args,
+            })
+            events.append({
+                "name": ("quality VETOED" if vetoed
+                         else "quality gate: published"),
+                "ph": "i", "s": "g" if vetoed else "t",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("version", "recall_at_k", "precision_at_k",
+                          "score_separation", "delta")
+                         if k in rec},
+            })
+        elif kind == "injection":
+            events.append({
+                "name": f"injection suite: {rec.get('source', '?')}",
+                "ph": "i", "s": "t",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("scenarios", "events", "attacks", "seed")
+                         if k in rec},
+            })
         elif kind == "route":
             # Per-edge fan-out counter lane: forwarded events/bytes and
             # the router's in-flight depth against the bounded
@@ -464,6 +497,32 @@ def continuous_table(records: "list[dict]") -> "dict | None":
     }
 
 
+def quality_table(records: "list[dict]") -> "dict | None":
+    """Detection-quality rollup from `quality_gate` records: the gate
+    tally plus the LAST verdict's per-scenario recall — the terminal
+    answer to "does the stream's model still rank attacks low"."""
+    gates = [r for r in records if r.get("kind") == "quality_gate"]
+    if not gates:
+        return None
+    last = gates[-1]
+    return {
+        "checks": len(gates),
+        "published": sum(
+            1 for r in gates if r.get("action") == "published"
+        ),
+        "vetoed": sum(1 for r in gates if r.get("action") == "vetoed"),
+        "last_recall": last.get("recall_at_k"),
+        "last_precision": last.get("precision_at_k"),
+        "last_separation": last.get("score_separation"),
+        "per_scenario": last.get("per_scenario") or {},
+        "suites": [
+            {k: r.get(k) for k in ("source", "scenarios", "events",
+                                   "attacks")}
+            for r in records if r.get("kind") == "injection"
+        ],
+    }
+
+
 def route_table(records: "list[dict]") -> "list[dict]":
     """Per-replica routing rollup from the router's {"kind": "route"}
     records (the close-record totals win when present) plus its
@@ -580,6 +639,19 @@ def print_summary(records: "list[dict]", dropped: int,
             print(f"  last held-out ll {cont['last_ll']}"
                   + (f", worst freshness {worst:.3f}s"
                      if worst is not None else ""), file=out)
+    qual = quality_table(records)
+    if qual:
+        print("detection quality (injection-suite gate):", file=out)
+        print(f"  checks={qual['checks']} "
+              f"published={qual['published']} vetoed={qual['vetoed']} "
+              f"last recall@k={qual['last_recall']} "
+              f"precision@k={qual['last_precision']} "
+              f"separation={qual['last_separation']} nats", file=out)
+        if qual["per_scenario"]:
+            print(f"  {'scenario':<24} {'recall@k':>9}", file=out)
+            for name in sorted(qual["per_scenario"]):
+                print(f"  {name:<24} "
+                      f"{qual['per_scenario'][name]:>9}", file=out)
     tasks = dataplane_task_table(records)
     if tasks:
         hidden = sum(t["wall_s"] for t in tasks if t["ok"])
