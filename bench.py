@@ -1871,6 +1871,167 @@ def phase_serving_slo_fleet_paged():
             **res}
 
 
+# -- device-resident featurization --------------------------------------
+
+
+def bench_featurize_device(batch_sizes=(512, 2048, 8192), repeats=5,
+                           fleet_tenants=16, fleet_events=6144,
+                           seed=11):
+    """Host vs device vs fused featurization (sources/device.py +
+    ops/featurize_kernel.py) over the synthetic DNS day, at several
+    micro-batch sizes, plus a saturated fleet A/B re-run.
+
+    Three engines over identical pre-admitted rows, each timed
+    through featurize AND score (the unit serving actually pays per
+    flush):
+
+      * host  — the golden-oracle event featurizer (per-row Python
+        word building) feeding batched_scores;
+      * device — the compiled table path (vectorized parse + packed
+        codes + row gather, the serving default; scores stay bitwise
+        identical to host) feeding the same batched_scores;
+      * fused — featurize+gather+dot in ONE jitted dispatch
+        (fused_featurize_scores, f32 on-chip).
+
+    The fleet leg re-runs the fleet SLO harness saturated (offered
+    rate far above capacity, so sustained events/s measures drain
+    capacity per replica, not the arrival pacing) under
+    ONI_ML_TPU_FEATURIZE=host and =device, and reports the events/s
+    ratio — the serving-visible win of the featurize plane.  The
+    device legs also dispatch `lut_rows` once so the run carries a
+    `serve.featurize_rows` roofline harvest record (wall-only on
+    CPU), and the fleet payloads carry the zero-post-warmup-retrace
+    counters."""
+    from oni_ml_tpu.ops.featurize_kernel import lut_rows
+    from oni_ml_tpu.runner.serve import _synthetic_day
+    from oni_ml_tpu.scoring.pipeline import fused_featurize_scores
+    from oni_ml_tpu.scoring.score import batched_scores
+    from oni_ml_tpu.sources import get as get_source
+    from oni_ml_tpu.sources.device import DeviceBatch, compile_featurizer
+
+    spec = get_source("dns")
+    day, model, cuts = _synthetic_day(
+        n_events=max(batch_sizes), n_clients=64, n_doms=16, seed=seed
+    )
+    rows = [r.strip().split(",") if isinstance(r, str) else list(r)
+            for r in day]
+    fz = spec.event_featurizer(tuple(cuts))
+    dev, info = compile_featurizer(spec, tuple(cuts), model)
+    if dev is None:
+        raise RuntimeError(f"featurize compile gated: {info['reason']}")
+
+    def _time(fn):
+        fn()                       # warmup (compiles + caches)
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    host_eps, device_eps, fused_eps = {}, {}, {}
+    for b in batch_sizes:
+        sub = [rows[i % len(rows)] for i in range(b)]
+
+        def host_leg():
+            feats = fz(sub)
+            ip = np.concatenate([model.ip_rows(k)
+                                 for k, _ in spec.event_pairs(feats)])
+            w = np.concatenate([model.word_rows(ws)
+                                for _, ws in spec.event_pairs(feats)])
+            return batched_scores(model, ip, w, None)
+
+        def device_leg():
+            batch = DeviceBatch(dev, fz, sub, sub)
+            ip, w, _ = batch.pair_rows()
+            return batched_scores(model, ip, w, None)
+
+        def fused_leg():
+            batch = DeviceBatch(dev, fz, sub, sub)
+            d, codes, ip = batch.fused_operands()
+            return fused_featurize_scores(model, d, codes, ip, block=b)
+
+        host_eps[str(b)] = round(b / _time(host_leg), 1)
+        device_eps[str(b)] = round(b / _time(device_leg), 1)
+        fused_eps[str(b)] = round(b / _time(fused_leg), 1)
+        # One on-device row-gather dispatch per tier: harvests the
+        # serve.featurize_rows roofline record for this shape.
+        batch = DeviceBatch(dev, fz, sub, sub)
+        _, codes, _ = batch.fused_operands()
+        lut_rows(dev, codes, block=b)
+
+    top = str(max(batch_sizes))
+    res = {
+        "source": spec.name,
+        "compile": {k: info[k] for k in
+                    ("mode", "lut", "code_space", "vocab")},
+        "host_eps": host_eps, "device_eps": device_eps,
+        "fused_eps": fused_eps,
+        "speedup_device": round(device_eps[top] / host_eps[top], 2),
+        "speedup_fused": round(fused_eps[top] / host_eps[top], 2),
+    }
+
+    # Fleet A/B: saturated offered rate -> sustained_eps is the drain
+    # capacity of ONE replica under each featurize engine.  Best of
+    # `fleet_trials` per engine: the end-to-end fleet number is
+    # scheduler-noisy on a shared host, and the A/B wants capacity,
+    # not the unluckiest trial.  The flat leg is the 16-tenant fleet;
+    # the paged leg re-runs the tiered-residency census saturated
+    # (events/s per replica before/after the featurize plane, the
+    # acceptance re-run).
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    ))
+    import load_gen
+
+    def _fleet_ab(run, trials=2):
+        out = {}
+        for engine in ("host", "device"):
+            prev = os.environ.get("ONI_ML_TPU_FEATURIZE")
+            os.environ["ONI_ML_TPU_FEATURIZE"] = engine
+            try:
+                legs = [run() for _ in range(trials)]
+            finally:
+                if prev is None:
+                    os.environ.pop("ONI_ML_TPU_FEATURIZE", None)
+                else:
+                    os.environ["ONI_ML_TPU_FEATURIZE"] = prev
+            best = max(legs,
+                       key=lambda o: o["aggregate"]["sustained_eps"])
+            out[f"{engine}_eps"] = best["aggregate"]["sustained_eps"]
+            out[f"{engine}_plans"] = best.get("plans", {})
+        out["speedup"] = round(out["device_eps"] / out["host_eps"], 2)
+        return out
+
+    fleet = _fleet_ab(lambda: load_gen.run_fleet_slo(
+        fleet_tenants, "poisson:1", n_events=fleet_events,
+        rate_eps=1e9, max_batch=256, max_wait_ms=5.0,
+        device_score_min=None, seed=seed,
+    ))
+    paged = _fleet_ab(lambda: load_gen.run_fleet_slo(
+        64, "poisson:1", n_events=fleet_events, rate_eps=1e9,
+        max_batch=256, max_wait_ms=5.0, device_score_min=None,
+        seed=seed, zipf_s=1.1, hot_tenants=16, warm_tenants=32,
+    ))
+    res["fleet"] = fleet
+    res["fleet_paged"] = paged
+    res["fleet_host_eps"] = fleet["host_eps"]
+    res["fleet_device_eps"] = fleet["device_eps"]
+    return res
+
+
+def phase_featurize_device():
+    """Device featurization: headline value is the fleet drain rate
+    per replica under the device engine; the payload carries host/
+    device/fused events/s per micro-batch tier, the compile-table
+    summary (mode/LUT size/code space), the host-vs-device fleet
+    speedup, and each fleet leg's zero-retrace counters — gated by
+    bench_diff's featurize direction keys (events/s, higher-better)."""
+    res = bench_featurize_device()
+    return {"value": res["fleet_device_eps"], "unit": "events/sec",
+            **res}
+
+
 # -- continuous ingestion: streaming freshness --------------------------
 
 
@@ -2315,6 +2476,10 @@ PHASES = [
     ("serving_slo_fleet", phase_serving_slo_fleet, 480.0, True),
     ("serving_slo_fleet_paged", phase_serving_slo_fleet_paged,
      480.0, True),
+    # Device-resident featurization: host/device/fused word-building
+    # A/B plus the saturated fleet drain-rate re-run (wall-only
+    # roofline on CPU; jit dispatches, so it touches the device).
+    ("featurize_device", phase_featurize_device, 480.0, True),
     # Replicated elastic serving: replica subprocesses are fresh
     # JAX_PLATFORMS=cpu processes, so the phase stays runnable while
     # the chip grant is wedged.

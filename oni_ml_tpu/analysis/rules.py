@@ -44,6 +44,7 @@ def default_rules() -> list:
         HarvestCoverageRule(),
         RetraceHazardRule(),
         HiddenHostSyncRule(),
+        HotPathEventLoopRule(),
         LockDisciplineRule(),
         JournalSchemaRule(),
         JournalDocsRule(),
@@ -581,6 +582,123 @@ class HiddenHostSyncRule(Rule):
         if name in self.ARRAY_CALLS and node.args \
                 and isinstance(node.args[0], simple):
             return f"{name}()"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# hot-path-event-loop — NEW
+# ---------------------------------------------------------------------------
+
+
+class HotPathEventLoopRule(Rule):
+    """In the serving/continuous flush paths and the featurize plane,
+    a Python-level loop that CALLS something per event is the scaling
+    ceiling the device featurizer exists to remove: at fleet rates the
+    interpreter dispatch dominates the flush.  The rule flags `for`
+    statements and comprehensions that iterate an event-shaped
+    collection (rows/lines/col/...) and invoke a non-trivial call per
+    element.
+
+    Sanctioned per-event loops stay, visibly: the golden-oracle host
+    featurizers (the byte-identity reference the device compiler is
+    pinned against) and the per-UNIQUE memo passes (entropy/port
+    interning — O(distinct), not O(events)) carry inline
+    `# lint: ok(hot-path-event-loop, <why>)` suppressions."""
+
+    id = "hot-path-event-loop"
+    description = ("per-event Python loop with a call in a serving/"
+                   "continuous flush path")
+    hint = ("vectorize (numpy pass or the device featurize plane), "
+            "hoist to a per-unique memo, or suppress with a reason "
+            "(golden-oracle host featurizers are the sanctioned case)")
+
+    HOT_MODULES = frozenset((
+        PKG + "serving/fleet.py",
+        PKG + "serving/batcher.py",
+        PKG + "serving/events.py",
+        PKG + "runner/continuous.py",
+        PKG + "sources/device.py",
+        PKG + "sources/generic.py",
+        PKG + "features/flow.py",
+        PKG + "features/dns.py",
+    ))
+    #: names that hold per-event collections in these modules — the
+    #: rule keys on the ITERATION SOURCE, so per-tenant / per-field /
+    #: per-source loops (small, bounded) never trip it.
+    EVENT_NAMES = frozenset((
+        "rows", "lines", "raws", "events", "values", "col", "cols",
+        "uq", "queries", "words",
+    ))
+    #: calls cheap enough to never matter (C-level, no dispatch fan-out).
+    CHEAP = frozenset(("len",))
+
+    def check(self, mod: ParsedModule, ctx):
+        if mod.rel not in self.HOT_MODULES:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.For):
+                src, bodies = node.iter, node.body
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                src = node.generators[0].iter
+                bodies = [node.key, node.value] if isinstance(
+                    node, ast.DictComp) else [node.elt]
+                bodies += [c.iter for c in node.generators[1:]]
+                bodies += [i for c in node.generators for i in c.ifs]
+            else:
+                continue
+            name = self._iter_base(src)
+            if name not in self.EVENT_NAMES:
+                continue
+            call = self._per_element_call(bodies)
+            if call is None:
+                continue
+            yield self.finding(
+                mod, node.lineno,
+                f"per-event Python loop over {name!r} calls {call} "
+                "per element in a flush/featurize hot path",
+            )
+
+    def _iter_base(self, src) -> "str | None":
+        """The collection NAME a loop iterates, through the wrappers
+        that preserve per-event cardinality: enumerate/zip/sorted/
+        reversed, `.tolist()`, and a subscript of a name (`cols[i]` is
+        one per-event column)."""
+        if isinstance(src, ast.Name):
+            return src.id
+        if isinstance(src, ast.Subscript):
+            return self._iter_base(src.value)
+        if isinstance(src, ast.Call):
+            fname = dotted_name(src.func)
+            if fname in ("enumerate", "zip", "sorted", "reversed") \
+                    and src.args:
+                for a in src.args:
+                    base = self._iter_base(a)
+                    if base is not None:
+                        return base
+                return None
+            if isinstance(src.func, ast.Attribute) \
+                    and src.func.attr == "tolist":
+                return self._iter_base(src.func.value)
+        return None
+
+    def _per_element_call(self, bodies) -> "str | None":
+        """The first non-cheap call made per iteration (nested defs
+        are their own scope and don't count)."""
+        stack = [b for b in bodies if b is not None]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or (
+                    node.func.attr if isinstance(node.func,
+                                                 ast.Attribute)
+                    else "<call>")
+                if name not in self.CHEAP:
+                    return f"{name}()"
+            stack.extend(ast.iter_child_nodes(node))
         return None
 
 

@@ -391,6 +391,25 @@ class ServingConfig:
     # (documented tolerance, pinned in tests/test_residency.py).  The
     # f32 host path and the golden scoring bytes are untouched.
     stack_precision: str = "f32"
+    # -- featurize plane (sources/device.py, ops/featurize_kernel.py) --
+    # Which engine builds word rows on the flush path.  "host" = the
+    # per-event Python featurizers (the golden oracle); "device" = the
+    # compiled vocabulary tables — vectorized parse + packed-code LUT
+    # gather feeding the UNCHANGED score dispatch, so scores stay
+    # bitwise identical to host; "fused" additionally jit-fuses
+    # LUT-gather + theta/p gather + dot into ONE dispatch per
+    # single-tenant K-group (f32, ~1e-6 score envelope — opt-in).
+    # "auto" resolves through the plan cache (plan knob
+    # "featurize_engine") and defaults to "device": an unlowerable
+    # vocabulary already degrades per-model to the host oracle, so
+    # device is safe as the blanket default.  ONI_ML_TPU_FEATURIZE
+    # overrides everything (the bench A/B toggle).
+    featurize_engine: str = "auto"
+    # Pow2 pad floor for the fused dispatch's micro-batch dimension
+    # (plan knob "featurize_block"): flushes pad up to at least this
+    # many rows so ragged flush sizes land in a handful of compiled
+    # shapes instead of one per pow2 tier below it.
+    featurize_block: int = 2048
     # -- replicated elastic serving (serving/router.py / replica.py) --
     # Replica liveness cadence: each ReplicaServer publishes a KV
     # heartbeat this often, and the router declares a replica lost —

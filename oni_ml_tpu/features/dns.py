@@ -225,6 +225,7 @@ def featurize_dns(
     sub_len = np.zeros(len(rows), dtype=np.int64)
     n_parts = np.zeros(len(rows), dtype=np.int64)
     entropy = np.zeros(len(rows), dtype=np.float64)
+    # lint: ok(hot-path-event-loop, golden-oracle host featurizer — the byte-identity reference the device plane is pinned against)
     for i, row in enumerate(rows):
         d, s, sl, np_ = extract_subdomain(row[c["dns_qry_name"]])
         domain.append(d)
@@ -238,9 +239,11 @@ def featurize_dns(
     from .flow import _to_double
 
     tstamp = np.array(
+        # lint: ok(hot-path-event-loop, golden-oracle host parse — the reference per-cell NaN-defaulting)
         [_to_double(r[c["unix_tstamp"]]) for r in rows], dtype=np.float64
     ) if rows else np.zeros(0)
     frame_len = np.array(
+        # lint: ok(hot-path-event-loop, golden-oracle host parse — the reference per-cell NaN-defaulting)
         [_to_double(r[c["frame_len"]]) for r in rows], dtype=np.float64
     ) if rows else np.zeros(0)
 

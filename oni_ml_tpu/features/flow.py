@@ -199,6 +199,7 @@ def featurize_flow(
     """
     rows: list[list[str]] = []
     header: str | None = None
+    # lint: ok(hot-path-event-loop, golden-oracle admission parse — the batch reference; serving admits via admit once per event)
     for line in lines:
         if skip_header:
             if header is None:
@@ -217,12 +218,21 @@ def featurize_flow(
 
     n = len(rows)
     c = FLOW_COLUMNS
+    # Golden-oracle host parse: the reference per-cell NaN-defaulting
+    # the device plane's vectorized parse is pinned byte-identical to.
+    # lint: ok(hot-path-event-loop, golden-oracle host parse — see above)
     hour = np.array([_to_double(r[c["hour"]]) for r in rows])
+    # lint: ok(hot-path-event-loop, golden-oracle host parse — see above)
     minute = np.array([_to_double(r[c["minute"]]) for r in rows])
+    # lint: ok(hot-path-event-loop, golden-oracle host parse — see above)
     second = np.array([_to_double(r[c["second"]]) for r in rows])
+    # lint: ok(hot-path-event-loop, golden-oracle host parse — see above)
     ipkt = np.array([_to_double(r[c["ipkt"]]) for r in rows])
+    # lint: ok(hot-path-event-loop, golden-oracle host parse — see above)
     ibyt = np.array([_to_double(r[c["ibyt"]]) for r in rows])
+    # lint: ok(hot-path-event-loop, golden-oracle host parse — see above)
     col10 = np.array([_to_double(r[c["sport"]]) for r in rows])
+    # lint: ok(hot-path-event-loop, golden-oracle host parse — see above)
     col11 = np.array([_to_double(r[c["dport"]]) for r in rows])
     with np.errstate(invalid="ignore"):  # garbage rows carry NaN by design
         num_time = hour + minute / 60.0 + second / 3600.0
@@ -247,6 +257,7 @@ def featurize_flow(
     ip_pair: list[str] = []
     src_word: list[str] = []
     dest_word: list[str] = []
+    # lint: ok(hot-path-event-loop, golden-oracle word assembly — the byte-identity reference the device plane is pinned against)
     for i, row in enumerate(rows):
         wp, pair, sw, dw = _adjust_port_words(
             row[c["sip"]], row[c["dip"]], col10[i], col11[i],

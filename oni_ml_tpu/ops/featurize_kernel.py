@@ -1,0 +1,173 @@
+"""Jitted featurize-plane programs: on-device LUT word-row gather and
+the fused featurize+gather+dot(+threshold) single dispatch.
+
+The heavy lifting of device featurization is table COMPILATION
+(sources/device.py): reverse-parsing the model vocabulary into packed
+codes and a code->row LUT.  What remains at dispatch time is pure
+gather arithmetic, and this module owns its jitted forms:
+
+  * `lut_rows` — codes -> model word rows (the featurize step alone,
+    benchmarked against the host word loop by bench.py's
+    featurize_device phase);
+  * `fused_scores` — LUT gather + theta/p row gathers + K-wide dot
+    (+ optional on-device threshold mask) in ONE jit program per flush,
+    tracing the same `scoring.pipeline.score_dot_rows` body every other
+    device scoring path traces.
+
+Shape discipline mirrors the serving stack: micro-batches pad to the
+next power of two (floored at the `featurize_block` plan knob), LUTs
+are pow2-padded at compile (sources/device.py), and theta/p ride at the
+stacked scorer's capacity tiers — so tenant churn, vocabulary drift and
+ragged flush sizes all land in a bounded family of compiled programs
+and steady-state serving retraces nothing.
+
+Numerics: fused scores are f32 on-chip (the pipeline's documented
+~1e-6 envelope vs the float64 host oracle) — which is why the serving
+default is the "device" engine (host-side numpy LUT gather feeding the
+existing bitwise-stable score dispatch) and "fused" is opt-in.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FNS: dict = {}
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def _get_fn(name: str):
+    fn = _FNS.get(name)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    from ..scoring.pipeline import score_dot_rows
+
+    if name == "rows":
+
+        def rows_fn(lut, codes):
+            return jnp.take(lut, codes, axis=0)
+
+        fn = jax.jit(rows_fn)
+    elif name == "fused":
+
+        def fused_fn(theta, p, lut, codes, word_base, ip_idx):
+            w = jnp.take(lut, codes, axis=0) + word_base
+            return score_dot_rows(theta, p, ip_idx, w)
+
+        fn = jax.jit(fused_fn)
+    else:
+
+        def fused_threshold_fn(theta, p, lut, codes, word_base, ip_idx,
+                               threshold):
+            w = jnp.take(lut, codes, axis=0) + word_base
+            scores = score_dot_rows(theta, p, ip_idx, w)
+            return scores, scores < threshold
+
+        fn = jax.jit(fused_threshold_fn)
+    _FNS[name] = fn
+    return fn
+
+
+def device_lut(dev):
+    """The compiled table's device_rows (dense LUT or sparse row
+    array — the int32 gather target either way, see
+    sources/device._CodeTable's device contract) as a device array,
+    transferred once per compiled table and cached ON the table — the
+    `scoring.score._device_model` residency idiom; rebinds of a shared
+    table (same-vocabulary tenants) reuse the one transfer."""
+    table = dev.table
+    cached = getattr(table, "_rows_device", None)
+    if cached is None:
+        import jax.numpy as jnp
+
+        cached = jnp.asarray(table.device_rows)
+        table._rows_device = cached
+    return cached
+
+
+def _pad_operands(codes, ip_idx, block: "int | None"):
+    n = len(codes)
+    m = max(_pow2(n), _pow2(int(block or 1)))
+    codes_pad = np.zeros(m, np.int32)
+    ip_pad = np.zeros(m, np.int32)
+    codes_pad[:n] = codes
+    ip_pad[:n] = ip_idx
+    return codes_pad, ip_pad
+
+
+def lut_rows(dev, codes, *, block: "int | None" = None) -> np.ndarray:
+    """device codes (table.device_codes output) -> model word rows
+    through the on-device row gather (the jitted mirror of the host
+    `table.rows_of`; bench comparison surface — the serving "device"
+    engine keeps the host gather, which feeds the score dispatch
+    without an extra round trip)."""
+    n = len(codes)
+    if n == 0:
+        return np.zeros(0, np.int32)
+    fn = _get_fn("rows")
+    lut = device_lut(dev)
+    m = max(_pow2(n), _pow2(int(block or 1)))
+    codes_pad = np.zeros(m, np.int32)
+    codes_pad[:n] = codes
+    from ..telemetry import roofline
+
+    roofline.ensure_harvested(
+        "serve.featurize_rows", fn, lut, codes_pad,
+        shape=f"n{m}.l{lut.shape[0]}",
+    )
+    return np.asarray(fn(lut, codes_pad)[:n])
+
+
+def fused_scores(model, dev, codes, ip_idx, word_base: int = 0, *,
+                 block: "int | None" = None, threshold=None,
+                 stats=None):
+    """The single-dispatch flush: LUT featurize + theta/p gathers +
+    K-wide dot (+ threshold mask) in one jit program.
+
+    `codes`/`ip_idx` are the DeviceBatch's device codes and absolute
+    document rows (ip_base already applied); `word_base` rides as a
+    scalar operand so stacked-snapshot offsets never retrace.  Returns
+    float64 scores (drop-in for batched_scores consumers), plus the
+    on-device `score < threshold` keep mask when `threshold` is given.
+    f32 arithmetic — see module docstring."""
+    n = len(codes)
+    if n == 0:
+        empty = np.zeros(0, np.float64)
+        return empty if threshold is None else (empty,
+                                                np.zeros(0, bool))
+    from ..scoring.score import _device_model
+    from ..telemetry import roofline
+
+    theta, p = _device_model(model, stats=stats)
+    lut = device_lut(dev)
+    codes_pad, ip_pad = _pad_operands(codes, ip_idx, block)
+    wb = np.int32(word_base)
+    shape = (f"n{len(codes_pad)}.l{lut.shape[0]}"
+             f".ip{theta.shape[0]}.w{p.shape[0]}.k{theta.shape[1]}")
+    if stats is not None:
+        stats.dispatches += 1
+        stats.events += n
+        stats.h2d_bytes += codes_pad.nbytes + ip_pad.nbytes
+        stats.d2h_bytes += 4 * n
+    if threshold is None:
+        fn = _get_fn("fused")
+        roofline.ensure_harvested(
+            "serve.featurize_fused", fn, theta, p, lut, codes_pad, wb,
+            ip_pad, shape=shape,
+        )
+        out = fn(theta, p, lut, codes_pad, wb, ip_pad)
+        return np.asarray(out[:n], np.float64)
+    thr = np.float32(threshold)
+    fn = _get_fn("fused_threshold")
+    roofline.ensure_harvested(
+        "serve.featurize_fused", fn, theta, p, lut, codes_pad, wb,
+        ip_pad, thr, shape=shape,
+    )
+    scores, keep = fn(theta, p, lut, codes_pad, wb, ip_pad, thr)
+    return (np.asarray(scores[:n], np.float64),
+            np.asarray(keep[:n], bool))

@@ -43,6 +43,14 @@ def _engine_dict(v) -> bool:
     return isinstance(v, dict) and v.get("engine") in ("dense", "sparse")
 
 
+def _featurize_engine_dict(v) -> bool:
+    # The featurize-plane engine record: same rule as _engine_dict, over
+    # the sources/device.py engine family.
+    return isinstance(v, dict) and v.get("engine") in (
+        "host", "device", "fused"
+    )
+
+
 @dataclass(frozen=True)
 class Knob:
     """One tunable: `scope` picks the fingerprint (a host knob like
@@ -173,6 +181,20 @@ KNOBS = {
         # convention, like dense_estep_block) by the resolver in
         # serving/residency.py, so a measured capacity engages only
         # when the operator left the knob unset.
+        Knob(
+            "featurize_engine", None, valid=_featurize_engine_dict,
+            doc="measured featurize-plane engine pick for this backend "
+                "(sources/device.py resolve_engine; consulted only when "
+                "ServingConfig.featurize_engine is left at \"auto\" and "
+                "ONI_ML_TPU_FEATURIZE is unset)",
+        ),
+        Knob(
+            "featurize_block", ServingConfig.featurize_block,
+            candidates=(1024, 2048, 4096, 8192),
+            doc="pow2 pad floor for the fused featurize dispatch's "
+                "micro-batch dimension (ops/featurize_kernel.py; bounds "
+                "the compiled-shape family below the flush cap)",
+        ),
         Knob(
             "fleet_hot_tenants", None,
             candidates=(4, 8, 16, 32, 64),

@@ -85,6 +85,7 @@ def slice_events(
         raise ValueError(f"slice_s must be > 0, got {slice_s}")
     rows = []
     parsed = []
+    # lint: ok(hot-path-event-loop, ingest-time slice ordering — one time-field parse per line at admission, off the flush path)
     for ln in lines:
         if not ln.strip():
             continue

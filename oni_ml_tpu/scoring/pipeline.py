@@ -536,3 +536,20 @@ def filtered_flow_scores(
     if not parts:
         return empty
     return _merge_survivors(parts)
+
+
+def fused_featurize_scores(model, dev, codes, ip_idx, word_base: int = 0,
+                           *, block: "int | None" = None, threshold=None,
+                           stats: "DispatchStats | None" = None):
+    """The featurize+gather+dot(+threshold) single-dispatch flush path:
+    packed codes from a compiled device featurizer (sources/device.py)
+    ride ONE jit program that gathers word rows through the LUT, applies
+    the stacked-snapshot `word_base` offset, and runs `score_dot_rows` —
+    optionally with the on-device `score < threshold` keep mask.  Thin
+    re-export of ops/featurize_kernel.py so serving callers stay inside
+    the scoring facade; f32 scores (the fused engine's documented
+    envelope), float64 on return."""
+    from ..ops.featurize_kernel import fused_scores
+
+    return fused_scores(model, dev, codes, ip_idx, word_base,
+                        block=block, threshold=threshold, stats=stats)

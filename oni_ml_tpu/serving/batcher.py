@@ -199,6 +199,7 @@ class BatchScorer:
             return p.future
 
     def submit_many(self, raws) -> list[ScoreFuture]:
+        # lint: ok(hot-path-event-loop, the admission API itself — per-event queueing semantics; flush scoring is vectorized downstream)
         return [self.submit(r) for r in raws]
 
     def flush(self) -> None:

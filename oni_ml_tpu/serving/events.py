@@ -41,6 +41,18 @@ class FlowEventFeaturizer:
             )
         return line
 
+    def admit(self, line: str) -> tuple[str, list[str]]:
+        """Edge columnar parse: validate AND keep the split row, so the
+        flush path never re-splits the line (the device featurizer
+        consumes rows directly; the host oracle still takes the raw
+        line)."""
+        row = line.strip().split(",")
+        if len(row) != NUM_FLOW_COLUMNS:
+            raise ValueError(
+                f"flow event needs {NUM_FLOW_COLUMNS} columns: {line!r}"
+            )
+        return line, row
+
     def __call__(self, lines: Sequence[str]):
         return featurize_flow(
             lines, skip_header=False, precomputed_cuts=self.cuts
@@ -65,6 +77,12 @@ class DnsEventFeaturizer:
                 f"dns event needs {NUM_DNS_COLUMNS} columns: {event!r}"
             )
         return row
+
+    def admit(self, event) -> tuple[list[str], list[str]]:
+        """Edge columnar parse — DNS already validates to the split row,
+        so the row doubles as the host-oracle payload."""
+        row = self.validate(event)
+        return row, row
 
     def __call__(self, rows: Sequence[Sequence[str]]):
         return featurize_dns(

@@ -55,6 +55,7 @@ import numpy as np
 from ..config import ServingConfig
 from ..scoring import ScoringModel
 from ..scoring.score import batched_scores, use_device_path
+from ..sources.device import DeviceBatch, device_batch, resolve_engine
 from .metrics import MetricsEmitter
 from .registry import ModelRegistry, ModelSnapshot
 from .tenants import (
@@ -587,9 +588,20 @@ class FleetScorer:
             mb, mb_src = self.config.fleet_max_batch, "default"
         self.max_batch = int(mb)
         self.max_wait_ms = float(mw)
+        # Featurize plane (sources/device.py): which engine builds word
+        # rows on the flush path, and the pow2 pad floor for the fused
+        # dispatch.  Resolved once at construction — engine swaps are a
+        # restart, like every other serving engine knob.
+        eng, eng_src = resolve_engine(self.config.featurize_engine)
+        self._featurize_engine = eng
+        fb, fb_src = resolve("featurize_block", self.config.featurize_block)
+        self._featurize_block = int(fb)
         self.plan = {
             "max_batch": {"value": self.max_batch, "source": mb_src},
             "max_wait_ms": {"value": self.max_wait_ms, "source": mw_src},
+            "featurize_engine": {"value": eng, "source": eng_src},
+            "featurize_block": {"value": self._featurize_block,
+                                "source": fb_src},
         }
         if self.max_batch < 1:
             raise ValueError(f"fleet_max_batch ({self.max_batch}) must "
@@ -709,7 +721,15 @@ class FleetScorer:
                 f"unknown tenant {tenant!r} "
                 f"(known: {sorted(self._lanes)})"
             )
-        validated = lane.featurizer.validate(raw)
+        admit = getattr(lane.featurizer, "admit", None)
+        if admit is not None:
+            # Edge columnar parse: the line splits ONCE here; the flush
+            # path reuses the row (device featurize consumes it
+            # directly, the host oracle still gets `raw`).
+            validated, row = admit(raw)
+        else:
+            validated = lane.featurizer.validate(raw)
+            row = None
         reject_info = None
         with self._cond:
             if self._closed:
@@ -729,7 +749,7 @@ class FleetScorer:
                     lane.admission_stall_ns += wait_ns
                 if self._closed:
                     raise RuntimeError("FleetScorer is closed")
-                p = _PendingEvent(validated, time.perf_counter())
+                p = _PendingEvent(validated, time.perf_counter(), row)
                 lane.pending.append(p)
                 lane.submitted += 1
                 depth = len(lane.pending)
@@ -939,6 +959,77 @@ class FleetScorer:
                 for _, p in batch:
                     p.future._fail(e)
 
+    def _lane_features(self, lane, items, model):
+        """Featurize one tenant segment: device-compiled tables when the
+        engine allows it, the model snapshot is known, AND every pending
+        event carried an admission-parsed row — otherwise the host
+        featurizer (the golden oracle; also the fallback for unlowerable
+        vocabularies, which `device_batch` reports as None after
+        journaling one `featurize_compile` record)."""
+        if model is not None and self._featurize_engine != "host":
+            rows = [p.row for p in items]
+            if all(r is not None for r in rows):
+                batch, info = device_batch(
+                    lane.featurizer, rows, [p.raw for p in items], model,
+                )
+                if info is not None:
+                    self._journal_safe(info)
+                if batch is not None:
+                    return batch
+        return lane.featurizer([p.raw for p in items])
+
+    @staticmethod
+    def _pair_rows(feats, dsource: str, model: ScoringModel,
+                   ip_base: int, word_base: int):
+        """tenant_pairs through the device featurizer's LUT gather when
+        the segment was device-featurized against THIS model (identity
+        check: a republish between featurize and score falls back to the
+        host oracle rather than gathering stale rows)."""
+        if isinstance(feats, DeviceBatch) and feats.model is model:
+            return feats.pair_rows(ip_base, word_base)
+        return tenant_pairs(feats, dsource, model, ip_base, word_base)
+
+    def _fused_group(self, tenant, stack, feats_by_tenant, tenant_scores,
+                     tenant_snaps, tenant_device, failures) -> bool:
+        """The fused single-dispatch flush path (featurize+gather+dot in
+        one jit program, ops/featurize_kernel.py) for a single-tenant
+        K-group whose segment was device-featurized against the stack
+        member's model.  Returns False — caller runs the generic packed
+        path — whenever the preconditions don't hold; returns True with
+        scores demuxed on success (and on failure, which is recorded
+        like any other group failure)."""
+        feats = feats_by_tenant[tenant]
+        member = stack.members[tenant]
+        if not (isinstance(feats, DeviceBatch)
+                and feats.model is member.model):
+            return False
+        try:
+            from ..scoring.pipeline import fused_featurize_scores
+
+            dev, codes, ip = feats.fused_operands(stack.ip_base[tenant])
+            t_g0 = time.perf_counter()
+            pair_scores = fused_featurize_scores(
+                stack.model, dev, codes, ip,
+                word_base=stack.word_base[tenant],
+                block=self._featurize_block,
+            )
+            if self.metrics is not None:
+                rec = self.metrics.recorder
+                rec.histogram("serve.device_score_ms").observe(
+                    (time.perf_counter() - t_g0) * 1e3
+                )
+                rec.counter("serve.device_events").add(
+                    feats.num_raw_events
+                )
+            tenant_scores[tenant] = demux_scores(
+                pair_scores, dev.pairs_per_event
+            )
+            tenant_snaps[tenant] = member
+            tenant_device[tenant] = True
+        except Exception as e:
+            failures.setdefault(tenant, e)
+        return True
+
     def _score_batch(self, batch, trigger: str, depth: int) -> None:
         cfg = self.config
         t0 = time.perf_counter()
@@ -972,7 +1063,13 @@ class FleetScorer:
                         # No hot member in the K-group at all (every
                         # tenant paged out) — the group scores solo.
                         stacks[k] = None
-                feats = lane.featurizer([p.raw for p in items])
+                stack = stacks[k]
+                member = (stack.members.get(tenant)
+                          if stack is not None else None)
+                feats = self._lane_features(
+                    lane, items,
+                    member.model if member is not None else None,
+                )
                 if feats.num_raw_events != len(items):
                     raise RuntimeError(
                         f"tenant {tenant!r} featurizer returned "
@@ -980,8 +1077,7 @@ class FleetScorer:
                         f"{len(items)} events"
                     )
                 feats_by_tenant[tenant] = feats
-                stack = stacks[k]
-                if stack is not None and tenant in stack.members:
+                if member is not None:
                     groups.setdefault(k, []).append(tenant)
                 else:
                     # Residency miss at scoring time (tenant evicted
@@ -1006,11 +1102,19 @@ class FleetScorer:
         tenant_device: dict[str, bool] = {}
         for k, group in sorted(groups.items()):
             stack = stacks[k]
+            if (self._featurize_engine == "fused" and len(group) == 1
+                    and self._fused_group(group[0], stack,
+                                          feats_by_tenant, tenant_scores,
+                                          tenant_snaps, tenant_device,
+                                          failures)):
+                dispatches += 1
+                device_dispatches += 1
+                continue
             try:
                 parts = []
                 mults = {}
                 for tenant in group:
-                    ip, w, mult = tenant_pairs(
+                    ip, w, mult = self._pair_rows(
                         feats_by_tenant[tenant],
                         self._lanes[tenant].spec.dsource,
                         stack.members[tenant].model,
@@ -1140,6 +1244,11 @@ class FleetScorer:
             "kind": "demux", "batch": seq, "events": len(batch),
             "tenants": len(segments), "segments": dispatches,
             "residency_misses": len(solo),
+            "featurize": self._featurize_engine,
+            "featurize_device_tenants": sum(
+                isinstance(f, DeviceBatch)
+                for f in feats_by_tenant.values()
+            ),
             "score_ms": round((t1 - t0) * 1e3, 3),
             "demux_ms": round((t2 - t1) * 1e3, 3),
         })

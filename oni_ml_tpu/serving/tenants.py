@@ -155,14 +155,19 @@ def parse_manifest(data, origin: str = "<manifest>") -> list[TenantSpec]:
 
 class _PendingEvent:
     """One admitted event awaiting its packed flush: the demux unit.
-    `future` resolves with (score, tenant model version) exactly once."""
+    `future` resolves with (score, tenant model version) exactly once.
+    `row` is the edge columnar parse — the split column list produced
+    at admission by featurizers exposing `admit()` — so the flush path
+    never re-splits the raw line (None for validate-only featurizers,
+    which shed the device path and featurize from `raw`)."""
 
-    __slots__ = ("raw", "t_enqueue", "future")
+    __slots__ = ("raw", "t_enqueue", "future", "row")
 
-    def __init__(self, raw, t_enqueue: float) -> None:
+    def __init__(self, raw, t_enqueue: float, row=None) -> None:
         self.raw = raw
         self.t_enqueue = t_enqueue
         self.future = ScoreFuture()
+        self.row = row
 
 
 @dataclass

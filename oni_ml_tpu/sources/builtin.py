@@ -86,7 +86,7 @@ class FlowSource(SourceSpec):
     def matches_features(self, features) -> bool:
         return hasattr(features, "ibyt_cuts")
 
-    def derive_cuts(self, lines, qtiles_path=""):
+    def _derive_cuts_uncached(self, lines, qtiles_path=""):
         if qtiles_path:
             from ..features.qtiles import read_flow_qtiles
 

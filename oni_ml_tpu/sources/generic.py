@@ -141,6 +141,17 @@ class GenericEventFeaturizer:
             )
         return line
 
+    def admit(self, line: str) -> "tuple[str, list[str]]":
+        """Edge columnar parse: validate AND keep the split row so the
+        flush path feeds the device featurizer without re-splitting."""
+        row = line.strip().split(",")
+        if len(row) != self.spec.num_columns:
+            raise ValueError(
+                f"{self.spec.name} event needs {self.spec.num_columns} "
+                f"columns: {line!r}"
+            )
+        return line, row
+
     def __call__(self, lines: Sequence[str]):
         return self.spec.featurize(
             lines, skip_header=False, precomputed_cuts=self.cuts
@@ -230,14 +241,17 @@ class TableSourceSpec(SourceSpec):
         if f.kind == "number":
             from ..features.flow import _to_double
 
+            # lint: ok(hot-path-event-loop, golden-oracle host parse — the byte-identity reference the device plane is pinned against)
             return np.array([_to_double(r[col]) for r in rows],
                             dtype=np.float64)
         if f.kind == "hms":
+            # lint: ok(hot-path-event-loop, golden-oracle host parse — the byte-identity reference the device plane is pinned against)
             return np.array([_hms_seconds(r[col]) for r in rows],
                             dtype=np.float64)
         if f.kind == "entropy":
             from ..features.dns import shannon_entropy
 
+            # lint: ok(hot-path-event-loop, golden-oracle host transform — device plane memoizes per unique string and is pinned to this)
             return np.array([shannon_entropy(r[col]) for r in rows],
                             dtype=np.float64)
         return np.array([len(r[col]) for r in rows], dtype=np.float64)
@@ -250,6 +264,7 @@ class TableSourceSpec(SourceSpec):
 
         rows: "list[list[str]]" = []
         first = True
+        # lint: ok(hot-path-event-loop, golden-oracle admission parse — the batch reference; serving admits via admit once per event)
         for e in events:
             row = e.strip().split(",") if isinstance(e, str) else list(e)
             if first and skip_header:
@@ -283,6 +298,7 @@ class TableSourceSpec(SourceSpec):
 
         tmpl = self.word_template
         words: "list[str]" = []
+        # lint: ok(hot-path-event-loop, golden-oracle word assembly — the byte-identity reference the device plane is pinned against)
         for i, row in enumerate(rows):
             parts: "dict[str, object]" = {
                 c: row[k] for c, k in self._col.items()

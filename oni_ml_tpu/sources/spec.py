@@ -119,8 +119,43 @@ class SourceSpec:
     def derive_cuts(self, lines: Sequence[str],
                     qtiles_path: str = "") -> tuple:
         """Bootstrap cuts for continuous mode: from a qtiles file when
-        the source supports one, else the slice's own ECDF (one
-        featurize pass)."""
+        the source supports one, else the slice's own ECDF.
+
+        Memoized on the spec: registry specs are singletons, and every
+        consumer of a bootstrap slice (continuous service, fleet lanes,
+        bench phases, the device featurize compiler's cache key) wants
+        the SAME cut tuple for the same day — so the ECDF featurize
+        pass runs once per distinct (line digest, qtiles path) and
+        repeat callers pay a hash, not a featurize.  The returned
+        tuple is shared and must be treated as immutable (it is — cut
+        arrays are read-only bin tables)."""
+        lines = (lines if isinstance(lines, (list, tuple))
+                 else list(lines))
+        key = self._cuts_memo_key(lines, qtiles_path)
+        cache = self.__dict__.setdefault("_derived_cuts", {})
+        cuts = cache.get(key)
+        if cuts is None:
+            cuts = self._derive_cuts_uncached(lines, qtiles_path)
+            while len(cache) >= 8:   # a handful of live days, bounded
+                cache.pop(next(iter(cache)))
+            cache[key] = cuts
+        return cuts
+
+    def _cuts_memo_key(self, lines: Sequence, qtiles_path: str) -> str:
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        for ln in lines:
+            h.update(ln.encode() if isinstance(ln, str)
+                     else repr(ln).encode())
+            h.update(b"\n")
+        h.update(qtiles_path.encode())
+        return h.hexdigest()
+
+    def _derive_cuts_uncached(self, lines: Sequence[str],
+                              qtiles_path: str = "") -> tuple:
+        """One ECDF featurize pass over the slice (qtiles_path handled
+        by sources that support a cut file — see builtin.FlowSource)."""
         feats = self.featurize(lines, skip_header=False)
         return self.cuts_of(feats)
 

@@ -399,6 +399,12 @@ HARVEST_COVERAGE: "dict[str, str]" = {
         "exempt: holdout likelihood evaluation — an offline quality "
         "metric outside the runner's dispatch path"
     ),
+    "ops/featurize_kernel.py": (
+        "serve.featurize_rows + serve.featurize_fused — the LUT "
+        "word-row gather and the fused featurize+gather+dot dispatch; "
+        "harvested at first dispatch per padded shape via "
+        "roofline.ensure_harvested in lut_rows/fused_scores"
+    ),
     # ops/dense_estep.py holds kernel BODIES inlined into the jitted
     # chunk/E-step programs (no jax.jit site of its own) — cost is
     # harvested at the callers' entries (em.run_chunk, em.e_step).

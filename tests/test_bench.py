@@ -513,6 +513,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "serving_slo",
         "serving_slo_fleet",
         "serving_slo_fleet_paged",
+        "featurize_device",
         "serving_slo_replicated",
         "streaming_freshness",
         "detection_quality",

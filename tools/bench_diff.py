@@ -127,6 +127,43 @@ def _quality_rows(name: str, old: dict, new: dict,
     return rows
 
 
+# Device-featurization phase: every gated key is events/sec
+# (higher-better) — the per-micro-batch host/device/fused rates are
+# nested {batch_size: eps} dicts, the fleet drain rates are scalars.
+# The speedup ratios are derived (reported, not separately gated: a
+# device-rate regression already gates through its own key).
+_FEATURIZE_PHASE = "featurize_device"
+_FEATURIZE_TIER_KEYS = (
+    ("host_eps", "events/sec"),          # higher-better
+    ("device_eps", "events/sec"),        # higher-better
+    ("fused_eps", "events/sec"),         # higher-better
+)
+_FEATURIZE_KEYS = (
+    ("fleet_host_eps", "events/sec"),    # higher-better
+    ("fleet_device_eps", "events/sec"),  # higher-better
+)
+
+
+def _featurize_rows(name: str, old: dict, new: dict,
+                    threshold_pct: float) -> "list[dict]":
+    rows = []
+    for key, unit in _FEATURIZE_TIER_KEYS:
+        o, n = old.get(key) or {}, new.get(key) or {}
+        if not isinstance(o, dict) or not isinstance(n, dict):
+            continue
+        for batch in sorted(set(o) & set(n), key=str):
+            r = _rel_row(f"{name}.{key}@{batch}", o[batch], n[batch],
+                         unit, threshold_pct)
+            if r:
+                rows.append(r)
+    for key, unit in _FEATURIZE_KEYS:
+        r = _rel_row(f"{name}.{key}", old.get(key), new.get(key), unit,
+                     threshold_pct)
+        if r:
+            rows.append(r)
+    return rows
+
+
 # Replicated elastic serving phase: direction per key — aggregate
 # sustained events/s per replica count and the scaling efficiency are
 # higher-better; the chaos phase's p999-during-failover and
@@ -344,6 +381,16 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
             and "replica_scaling_efficiency" in new):
         rows.extend(_replicated_rows("headline", old, new,
                                      threshold_pct))
+    # Device-featurization keys (events/s per engine per micro-batch
+    # tier + fleet drain rates, all higher-better) — phase payloads
+    # and featurize-headline captures.
+    o, n = old_sec.get(_FEATURIZE_PHASE), new_sec.get(_FEATURIZE_PHASE)
+    if isinstance(o, dict) and isinstance(n, dict):
+        rows.extend(_featurize_rows(f"phase:{_FEATURIZE_PHASE}", o, n,
+                                    threshold_pct))
+    if "fleet_device_eps" in old and "fleet_device_eps" in new:
+        rows.extend(_featurize_rows("headline", old, new,
+                                    threshold_pct))
     # Distributed-EM scaling keys (efficiency higher-better, allreduce
     # wall lower-better) — from the secondary phase payloads, and from
     # the headline payload when the compared run IS a distributed_em

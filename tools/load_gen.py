@@ -1183,33 +1183,49 @@ def run_slo(patterns=PATTERNS, *, n_events: int = 4096,
 def emit_lines(pattern: str, n_events: int, rate_eps: float, *,
                burst_len: int = 64, seed: int = 0, out=sys.stdout,
                tenants: int = 0,
-               tenant_ids: "list[str] | None" = None) -> int:
+               tenant_ids: "list[str] | None" = None,
+               dsource: str = "dns") -> int:
     """Stream mode: pace raw CSV lines to `out` under the pattern —
     feedstock for a real `ml_ops serve` behind a pipe.  With
     `tenants=N` (or an explicit `tenant_ids` list — required to match
     a real manifest's ids, since the synthetic default is ``t<i>``),
     lines round-robin across the tenant ids in the fleet stream
     framing (``<tenant>\\t<line>``) for piping into
-    `ml_ops serve --fleet`."""
-    from oni_ml_tpu.runner.serve import _synthetic_day
+    `ml_ops serve --fleet`.
 
+    Any registered source emits: ``dns`` keeps the serve harness's
+    `_synthetic_day` rows (the models a synthetic fleet publishes are
+    built over that exact day), every other source draws its
+    registry `synth_benign` day — in particular ``--dsource proxy``
+    produces correctly framed proxy events that a proxy-lane fleet
+    admits (one raw CSV line per event, no header line, tab-framed
+    tenant prefix)."""
     ids = tenant_ids or (
         [f"t{i}" for i in range(tenants)] if tenants else []
     )
-    rows, _, _ = _synthetic_day(n_events=n_events, n_clients=64,
-                                n_doms=16)
-    offsets = arrival_offsets(pattern, len(rows), rate_eps, seed=seed,
+    if dsource == "dns":
+        from oni_ml_tpu.runner.serve import _synthetic_day
+
+        rows, _, _ = _synthetic_day(n_events=n_events, n_clients=64,
+                                    n_doms=16)
+        lines = [",".join(row) for row in rows]
+    else:
+        from oni_ml_tpu.sources import get as get_source
+
+        lines = [ln.rstrip("\n") for ln in
+                 get_source(dsource).synth_benign(n_events, seed)]
+    offsets = arrival_offsets(pattern, len(lines), rate_eps, seed=seed,
                               burst_len=burst_len)
     t0 = time.perf_counter()
-    for i, row in enumerate(rows):
+    for i, line in enumerate(lines):
         target = t0 + offsets[i]
         now = time.perf_counter()
         if target > now:
             time.sleep(target - now)
         prefix = f"{ids[i % len(ids)]}\t" if ids else ""
-        out.write(prefix + ",".join(row) + "\n")
+        out.write(prefix + line + "\n")
         out.flush()
-    return len(rows)
+    return len(lines)
 
 
 def main(argv=None) -> int:
@@ -1276,6 +1292,11 @@ def main(argv=None) -> int:
                     help="replicated mode: bounded per-replica "
                     "admission window (route_max_inflight)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dsource", default="dns",
+                    help="with --emit-lines: which registered source's "
+                    "synthetic day to emit (dns keeps the serve "
+                    "harness day; flow/proxy draw the registry "
+                    "synth_benign day)")
     ap.add_argument("--emit-lines", action="store_true",
                     help="pace raw CSV lines to stdout instead of "
                     "running the in-process harness (pipe into "
@@ -1290,7 +1311,8 @@ def main(argv=None) -> int:
                if t.strip()] or None
         n = emit_lines(args.pattern, args.events, args.rate,
                        burst_len=args.burst_len, seed=args.seed,
-                       tenants=args.tenants, tenant_ids=ids)
+                       tenants=args.tenants, tenant_ids=ids,
+                       dsource=args.dsource)
         print(f"load_gen: emitted {n} events", file=sys.stderr)
         return 0
     if args.replicated:
