@@ -1858,6 +1858,45 @@ def phase_serving_slo_replicated():
             "unit": "events/sec", **res}
 
 
+def bench_serving_crosshost(router_counts=(1, 2)):
+    """Cross-host serving (serving/wire.py + autoscale.py +
+    parallel/membership.py over TCP): the columnar zero-copy wire
+    under multi-router fan-in and a Little's-law autoscaler.  Three
+    legs, all on REAL subprocess boundaries: (1) fan-in — the same
+    census driven by 1 then 2 router PROCESSES against a shared
+    replica fleet; each router bounds its own per-edge admission
+    window, so aggregate events/s must exceed the single-router
+    admission ceiling with zero router-to-router coordination (the
+    acceptance gate) and bit-identical scores against the in-process
+    oracle; (2) router-kill chaos — SIGKILL one of two routers
+    mid-replay; the survivor absorbs the victim's census from its
+    last progress checkpoint with zero failed futures and
+    bit-identical redriven scores; (3) autoscale — an offered-load
+    staircase under the occupancy controller; the fleet must grow on
+    the step up (reaction_s journaled per decision) and drain back
+    down after, every decision in the ``{"kind": "autoscale"}``
+    ledger carried in the payload."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    ))
+    import load_gen
+
+    return load_gen.run_crosshost_slo(router_counts)
+
+
+def phase_serving_crosshost():
+    """Cross-host serving SLO: headline value is the aggregate
+    sustained events/s at the largest router count; the payload
+    carries aggregate eps per router count, router_scaling_efficiency
+    and the fanin_exceeds_single_router gate, wire_bytes_per_event
+    for the columnar frames, the chaos leg's zero-failed-futures +
+    bit-identical proof, and the autoscaler's decision ledger with
+    scale_up_reaction_s — all gated by bench_diff direction keys."""
+    res = bench_serving_crosshost()
+    return {"value": res["sustained_eps"], "unit": "events/sec",
+            **res}
+
+
 def phase_serving_slo_fleet_paged():
     """Paged fleet SLO: headline value is the aggregate sustained
     events/s over a 256-tenant Zipf census with only 32 HBM-hot slots
@@ -1969,6 +2008,29 @@ def bench_featurize_device(batch_sizes=(512, 2048, 8192), repeats=5,
         "fused_eps": fused_eps,
         "speedup_device": round(device_eps[top] / host_eps[top], 2),
         "speedup_fused": round(fused_eps[top] / host_eps[top], 2),
+    }
+
+    # Size-aware engine break-even: measure the segment size where a
+    # device featurize dispatch starts beating the vectorized host
+    # parse on THIS backend, and persist it as the
+    # featurize_break_even plan knob — the paged A/B below then runs
+    # with the knob LIVE, so its many small per-tenant segments (the
+    # 0.91x regression shape) go host-side while big flushes keep the
+    # device win.
+    from oni_ml_tpu import plans
+    from oni_ml_tpu.sources.device import measure_break_even
+
+    break_even, be_samples = measure_break_even(fz, rows, rows, model)
+    persisted = False
+    if break_even is not None:
+        persisted = plans.record_value(
+            "featurize_break_even", int(break_even),
+            source="bench.featurize_device",
+            measurements={"samples": be_samples},
+        )
+    res["break_even"] = {
+        "value": break_even, "persisted": persisted,
+        "samples": be_samples,
     }
 
     # Fleet A/B: saturated offered rate -> sustained_eps is the drain
@@ -2485,6 +2547,11 @@ PHASES = [
     # the chip grant is wedged.
     ("serving_slo_replicated", phase_serving_slo_replicated,
      600.0, False),
+    # Cross-host serving: columnar wire + multi-router fan-in +
+    # autoscaler; router/replica subprocesses are fresh
+    # JAX_PLATFORMS=cpu processes, so the phase stays runnable while
+    # the chip grant is wedged.
+    ("serving_crosshost", phase_serving_crosshost, 600.0, False),
     # Continuous ingestion: a paced day replay through the standing
     # window→warm-EM→gated-publish loop with co-resident serving.
     ("streaming_freshness", phase_streaming_freshness, 600.0, True),

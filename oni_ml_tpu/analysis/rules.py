@@ -46,6 +46,7 @@ def default_rules() -> list:
         HiddenHostSyncRule(),
         HotPathEventLoopRule(),
         LockDisciplineRule(),
+        NoPickleWireRule(),
         JournalSchemaRule(),
         JournalDocsRule(),
     ]
@@ -864,6 +865,62 @@ class LockDisciplineRule(Rule):
 # ---------------------------------------------------------------------------
 # journal-schema — NEW
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# no-pickle-wire — the columnar wire's containment rule
+# ---------------------------------------------------------------------------
+
+
+class NoPickleWireRule(Rule):
+    """Pickle on the serving wire deserializes attacker-adjacent bytes
+    with an arbitrary-code codec and pins both peers to one Python.
+    The columnar wire (serving/wire.py) replaced it; what remains is
+    the ONE negotiated fallback module (serving/wire_pickle.py), whose
+    two call sites carry reasoned suppressions.  This rule keeps the
+    budget at exactly that: any new pickle call — or a
+    ``allow_pickle=True`` numpy load, the same codec by the back
+    door — inside the serving layer or the TCP membership transport
+    fails the lint."""
+
+    id = "no-pickle-wire"
+    description = ("pickle (or allow_pickle=True) in the serving/"
+                   "membership layer outside the negotiated fallback")
+    hint = ("encode through serving/wire.py's columnar frames; a "
+            "deliberate non-wire pickle surface gets "
+            "`# lint: ok(no-pickle-wire, <why>)`")
+
+    SCOPES = ("oni_ml_tpu/serving/", "oni_ml_tpu/parallel/membership.py")
+    CALLS = frozenset((
+        "pickle.dumps", "pickle.loads", "pickle.dump", "pickle.load",
+        "pickle.Pickler", "pickle.Unpickler",
+    ))
+
+    def check(self, mod: ParsedModule, ctx):
+        if not any(mod.rel.startswith(s) for s in self.SCOPES):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in self.CALLS:
+                yield self.finding(
+                    mod, node.lineno,
+                    f"{name}() on the serving/membership path — the "
+                    "wire is columnar; pickle lives only in the "
+                    "negotiated wire_pickle fallback",
+                )
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "allow_pickle"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    yield self.finding(
+                        mod, kw.value.lineno,
+                        "allow_pickle=True load in the serving layer "
+                        "— object-dtype arrays round-trip through the "
+                        "pickle codec",
+                    )
 
 
 def _extracted_schema(ctx) -> dict:

@@ -410,7 +410,53 @@ class ServingConfig:
     # many rows so ragged flush sizes land in a handful of compiled
     # shapes instead of one per pow2 tier below it.
     featurize_block: int = 2048
+    # Minimum flush-segment size (events) before the device featurize
+    # engine pays for its dispatch: smaller segments take the host
+    # oracle even when the engine is "device"/"fused" (the paged
+    # 64-tenant regression in docs/performance.md — tiny per-tenant
+    # flushes sat below the device break-even).  0 resolves through
+    # the plan cache (plan knob "featurize_break_even", measured by
+    # bench.py's featurize phase) and falls back to the shipped
+    # default; ONI_ML_TPU_FEATURIZE_BREAK_EVEN overrides everything.
+    featurize_break_even: int = 0
     # -- replicated elastic serving (serving/router.py / replica.py) --
+    # Frame codec for the router<->replica wire (serving/wire.py):
+    # "columnar" (default — typed arrays as zero-copy buffers) or
+    # "pickle", the negotiated one-release fallback.  Receivers always
+    # auto-detect by magic; this knob sets what THIS side sends and
+    # what the hello negotiation answers.
+    wire_format: str = "columnar"
+    # Same-host shm upgrade: when both ends opt in and the hello
+    # handshake proves the peer shares this host, data frames move to
+    # a wire.ShmRing pair and the TCP data socket degrades to a
+    # liveness signal.  Off = every frame stays on TCP.
+    wire_shm: bool = True
+    # Per-slab byte size of each shm ring (two slabs per direction).
+    # Bounds the largest data frame a ring carries; bigger frames
+    # (none today — score batches cap at ~20 KiB) fall back to TCP.
+    wire_shm_slab_bytes: int = 1 << 20
+    # -- autoscaler (serving/autoscale.py) --
+    # Controller tick cadence: each tick samples the router's
+    # admission-window occupancy + stall rates and re-evaluates the
+    # Little's-law replica target.
+    autoscale_interval_s: float = 0.5
+    # Hysteresis bands on EWMA'd per-replica window utilization:
+    # above `high` the controller scales up, below `low` it scales
+    # down, in between it holds — the gap is what keeps an oscillating
+    # load from flapping the fleet.
+    autoscale_high: float = 0.75
+    autoscale_low: float = 0.25
+    # EWMA half-life for the utilization signal (seconds): a sample
+    # this old carries half the weight of the current one.
+    autoscale_halflife_s: float = 2.0
+    # Minimum seconds between scaling actions (either direction): a
+    # join/drain is expensive (model pushes + warmup), so one must
+    # prove out before the next is considered.
+    autoscale_cooldown_s: float = 5.0
+    # Replica-count clamp for controller decisions.  The controller
+    # only ever drains replicas it spawned itself.
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 8
     # Replica liveness cadence: each ReplicaServer publishes a KV
     # heartbeat this often, and the router declares a replica lost —
     # promoting its tenants' shadows — after replica_heartbeat_miss

@@ -34,16 +34,32 @@ or the file store below) and layers membership on top:
     failures between heartbeat checks exactly like the allreduce
     wait-slice poll.
 
+``KVServer`` / ``TcpKVClient``
+    The cross-host transport: a tiny TCP KV daemon speaking
+    length-prefixed JSON frames, and a client with the exact same
+    surface as ``FileKVClient``.  ``ml_ops route --kv-listen`` runs the
+    server next to one router; every other router and replica connects
+    with ``--kv-connect host:port``, so membership, promotion claims,
+    and failure relay all work across machines with zero extra
+    coordination (replica placement stays a pure function of the
+    roster).
+
 ``HeartbeatPublisher``
     The replica-side daemon thread publishing liveness every
     ``interval_s`` until ``stop()``.
+
+Records are JSON (base64-wrapped to honour the string-value KV
+convention) — the membership plane carries no pickle, which is what
+lets the ``no-pickle-wire`` graftlint rule cover this module.
 """
 
 from __future__ import annotations
 
 import base64
+import json
 import os
-import pickle
+import socket
+import struct
 import threading
 import time
 
@@ -139,13 +155,183 @@ def kv_list(client, prefix: str) -> "dict[str, str]":
     )
 
 
+_KVLEN = struct.Struct("!I")
+_KV_MAX_FRAME = 16 << 20  # a KV value is a roster record, not a payload
+
+
+def _kv_send(sock: socket.socket, obj, lock=None) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    data = _KVLEN.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def _kv_recv(sock: socket.socket):
+    buf = b""
+    while len(buf) < _KVLEN.size:
+        chunk = sock.recv(_KVLEN.size - len(buf))
+        if not chunk:
+            raise ConnectionError("KV peer closed")
+        buf += chunk
+    (n,) = _KVLEN.unpack(buf)
+    if n > _KV_MAX_FRAME:
+        raise ConnectionError(f"oversized KV frame: {n} bytes")
+    parts, got = [], 0
+    while got < n:
+        chunk = sock.recv(min(65536, n - got))
+        if not chunk:
+            raise ConnectionError("KV peer closed mid-frame")
+        parts.append(chunk)
+        got += len(chunk)
+    return json.loads(b"".join(parts).decode("utf-8"))
+
+
+class KVServer:
+    """A TCP daemon exposing the coordination-client KV surface to the
+    whole fleet — the cross-host replacement for FileKVClient's shared
+    directory.  One in-memory dict under a lock; requests are
+    length-prefixed JSON frames (op/key/value), one response per
+    request, one thread per connection (fleet control traffic is a few
+    ops per heartbeat interval, nowhere near thread-pool territory).
+    Run it next to one router (``ml_ops route --kv-listen``); everyone
+    else connects a TcpKVClient."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._store: "dict[str, str]" = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept, name="oni-kv-server", daemon=True)
+        self._accept_thread.start()
+
+    def _accept(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._closed.is_set():
+                req = _kv_recv(conn)
+                _kv_send(conn, self._apply(req))
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _apply(self, req: dict) -> dict:
+        op = req.get("op")
+        key = req.get("key", "")
+        with self._lock:
+            if op == "set":
+                if not req.get("overwrite") and key in self._store:
+                    return {"ok": False, "err": f"ALREADY_EXISTS: {key}"}
+                self._store[key] = req.get("value", "")
+                return {"ok": True}
+            if op == "get":
+                if key in self._store:
+                    return {"ok": True, "value": self._store[key]}
+                return {"ok": False, "err": f"NOT_FOUND: {key}"}
+            if op == "delete":
+                self._store.pop(key, None)
+                return {"ok": True}
+            if op == "list":
+                prefix = req.get("prefix", "")
+                return {"ok": True,
+                        "items": {k: v for k, v in self._store.items()
+                                  if k.startswith(prefix)}}
+        return {"ok": False, "err": f"UNKNOWN_OP: {op}"}
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class TcpKVClient:
+    """FileKVClient's surface over one KVServer connection.  Blocking
+    gets poll client-side (same contract, same DEADLINE_EXCEEDED
+    error) so the server never parks a thread per waiter.  Thread-safe:
+    one socket, one lock around each request/response exchange."""
+
+    _POLL_S = 0.005
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout_s: float = 5.0) -> None:
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout_s)
+        self._sock.settimeout(30.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            _kv_send(self._sock, req)
+            return _kv_recv(self._sock)
+
+    def key_value_set(self, key: str, value: str,
+                      allow_overwrite: bool = False) -> None:
+        rsp = self._call({"op": "set", "key": key, "value": value,
+                          "overwrite": bool(allow_overwrite)})
+        if not rsp.get("ok"):
+            raise RuntimeError(rsp.get("err", "KV set failed"))
+
+    def blocking_key_value_get(self, key: str,
+                               timeout_in_ms: int) -> str:
+        deadline = time.monotonic() + timeout_in_ms / 1000.0
+        while True:
+            rsp = self._call({"op": "get", "key": key})
+            if rsp.get("ok"):
+                return rsp["value"]
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(f"DEADLINE_EXCEEDED: {key}")
+            time.sleep(min(self._POLL_S, remaining))
+
+    def key_value_delete(self, key: str) -> None:
+        rsp = self._call({"op": "delete", "key": key})
+        if not rsp.get("ok"):
+            raise RuntimeError(rsp.get("err", "KV delete failed"))
+
+    def key_value_list(self, prefix: str) -> "dict[str, str]":
+        rsp = self._call({"op": "list", "prefix": prefix})
+        if not rsp.get("ok"):
+            raise RuntimeError(rsp.get("err", "KV list failed"))
+        return dict(rsp.get("items", {}))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 def _enc(obj) -> str:
-    return base64.b64encode(pickle.dumps(obj, protocol=4)).decode(
-        "ascii")
+    """JSON-in-base64: keeps the string-value KV convention of the
+    coordination client while staying pickle-free (roster records are
+    plain dicts of scalars, so JSON is lossless here)."""
+    return base64.b64encode(
+        json.dumps(obj, sort_keys=True, separators=(",", ":"))
+        .encode("utf-8")).decode("ascii")
 
 
 def _dec(value: str):
-    return pickle.loads(base64.b64decode(value))
+    return json.loads(base64.b64decode(value).decode("utf-8"))
 
 
 class MembershipClient:
@@ -245,6 +431,34 @@ class MembershipClient:
 
     def clear_failure(self, replica_id: str) -> None:
         self._kv.key_value_delete(f"{self._ns}/fail/{replica_id}")
+
+    # -- promotion claims -------------------------------------------------
+
+    def claim_promotion(self, replica_id: str, router_id: str) -> bool:
+        """First-writer-wins claim on failing over `replica_id`.  With
+        N routers watching the same fleet, every one of them sees the
+        same dead link; exactly one should re-push tenant state to the
+        promoted successors.  The claim is an overwrite-forbidden set —
+        the KV's ALREADY_EXISTS is the election: True means this router
+        owns the backfill, False means a peer already claimed it (the
+        loser still promotes locally, placement being a pure function
+        of membership, and just skips the pushes)."""
+        try:
+            self._kv.key_value_set(
+                f"{self._ns}/promote/{replica_id}",
+                _enc({"router": router_id,
+                      "t": time.time()}),  # lint: ok(monotonic-clock, claim stamps are read by peer routers)
+                allow_overwrite=False,
+            )
+            return True
+        except Exception:
+            return False
+
+    def clear_promotion(self, replica_id: str) -> None:
+        """Forget a settled claim so a future respawn under the same id
+        can fail over again (called when a router [re]connects the
+        replica)."""
+        self._kv.key_value_delete(f"{self._ns}/promote/{replica_id}")
 
 
 class HeartbeatPublisher:

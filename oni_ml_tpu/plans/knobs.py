@@ -195,6 +195,18 @@ KNOBS = {
                 "micro-batch dimension (ops/featurize_kernel.py; bounds "
                 "the compiled-shape family below the flush cap)",
         ),
+        # Device-scoped like dispatch_calibration: the crossover where
+        # a device featurize dispatch beats the vectorized host parse
+        # is a property of the accelerator (dispatch glue + compile
+        # residency), not of the host's queueing policy.
+        Knob(
+            "featurize_break_even", None, valid=_pos_int,
+            candidates=(16, 32, 64, 128, 256, 512),
+            doc="minimum flush segment size for the device featurize "
+                "path (sources/device.py resolve_break_even; below it "
+                "the host featurizer wins — measured by the featurize "
+                "bench phase, 0 in ServingConfig = use this knob)",
+        ),
         Knob(
             "fleet_hot_tenants", None,
             candidates=(4, 8, 16, 32, 64),

@@ -53,6 +53,10 @@ def build_replica_parser() -> argparse.ArgumentParser:
                    help="shared file-KV membership directory "
                    "(parallel/membership.FileKVClient); empty = no "
                    "membership/heartbeats")
+    p.add_argument("--kv-connect", default="", metavar="HOST:PORT",
+                   help="TCP KV server to register membership with "
+                   "(parallel/membership.TcpKVClient) — the cross-host "
+                   "alternative to --kv-dir")
     p.add_argument("--port-file", default="",
                    help="write 'host port' here once listening (the "
                    "spawn handshake)")
@@ -62,6 +66,22 @@ def build_replica_parser() -> argparse.ArgumentParser:
                    help="int threshold, 'none' to pin host scoring, "
                    "or unset for the measured auto calibration")
     return p
+
+
+def _make_kv(kv_dir: str, kv_connect: str):
+    """Membership transport off the CLI flags: a TCP KV client
+    (cross-host), the shared file-KV directory (same-host), or None
+    (no membership)."""
+    if kv_connect:
+        from ..parallel.membership import TcpKVClient
+
+        host, _, port = kv_connect.partition(":")
+        return TcpKVClient(host or "127.0.0.1", int(port))
+    if kv_dir:
+        from ..parallel.membership import FileKVClient
+
+        return FileKVClient(kv_dir)
+    return None
 
 
 def _parse_device_score_min(v):
@@ -88,11 +108,7 @@ def replica_main(argv: "list[str] | None" = None) -> int:
     if args.fleet_max_wait_ms is not None:
         cfg = dataclasses.replace(
             cfg, fleet_max_wait_ms=args.fleet_max_wait_ms)
-    kv = None
-    if args.kv_dir:
-        from ..parallel.membership import FileKVClient
-
-        kv = FileKVClient(args.kv_dir)
+    kv = _make_kv(args.kv_dir, args.kv_connect)
     # Persistent compilation cache + compile counters BEFORE the first
     # trace: replicas share the cache, so a respawned replica (rolling
     # redeploy) warm-starts its compiled family from disk — the
@@ -146,6 +162,19 @@ def build_route_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-dir", default="",
                    help="membership directory shared with the "
                    "replicas (default: a temp dir when spawning)")
+    p.add_argument("--kv-listen", default="", metavar="[HOST][:PORT]",
+                   help="run the TCP KV membership server "
+                   "(parallel/membership.KVServer) here and point "
+                   "spawned replicas at it — the cross-host control "
+                   "plane (empty PORT = ephemeral)")
+    p.add_argument("--kv-connect", default="", metavar="HOST:PORT",
+                   help="join an existing TCP KV membership server "
+                   "(another router's --kv-listen)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the Little's-law autoscaler: spawn/drain "
+                   "replicas between autoscale_min_replicas and "
+                   "autoscale_max_replicas to hold admission-window "
+                   "occupancy inside the hysteresis band")
     p.add_argument("--threshold", type=float, default=None,
                    help="suspicion threshold for flagged output "
                    "(default: ServingConfig)")
@@ -162,11 +191,15 @@ def build_route_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _spawn_replica(rid: str, kv_dir: str, workdir: str,
+def _spawn_replica(rid: str, kv_flags: "str | list[str]", workdir: str,
                    extra: "list[str] | None" = None,
                    timeout_s: float = 120.0):
     """One `ml_ops replica` subprocess; returns (proc, host, port)
-    after the port-file handshake."""
+    after the port-file handshake.  `kv_flags` is either the shared
+    file-KV directory (the historical signature) or a ready-made flag
+    list (["--kv-connect", "host:port"] for the TCP control plane)."""
+    if isinstance(kv_flags, str):
+        kv_flags = ["--kv-dir", kv_flags]
     port_file = os.path.join(workdir, f"{rid}.port")
     try:
         os.remove(port_file)
@@ -174,8 +207,8 @@ def _spawn_replica(rid: str, kv_dir: str, workdir: str,
         pass
     cmd = [
         sys.executable, "-m", "oni_ml_tpu.runner.ml_ops", "replica",
-        "--id", rid, "--kv-dir", kv_dir, "--port-file", port_file,
-    ] + (extra or [])
+        "--id", rid, "--port-file", port_file,
+    ] + kv_flags + (extra or [])
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     # The child must import THIS checkout's package wherever the
@@ -265,7 +298,7 @@ class _FlagCollector:
         self._thread.join(timeout=300.0)
 
 
-def _rolling_redeploy(router, procs: dict, kv_dir: str, workdir: str,
+def _rolling_redeploy(router, procs: dict, kv_flags, workdir: str,
                       extra: "list[str]") -> "list[dict]":
     """Drain-one-respawn-one over every spawned replica: the fleet
     keeps serving throughout (the router promotes each drained
@@ -279,7 +312,7 @@ def _rolling_redeploy(router, procs: dict, kv_dir: str, workdir: str,
         proc.wait(timeout=60.0)
         new_id = f"{rid}v2"
         proc2, host, port = _spawn_replica(
-            new_id, kv_dir, workdir, extra)
+            new_id, kv_flags, workdir, extra)
         procs[new_id] = proc2
         joined = router.join_replica(new_id, host, port)
         out.append({"drained": drained, "joined": joined})
@@ -299,19 +332,40 @@ def route_stream(args) -> int:
     specs = load_manifest(args.fleet)
     cfg = ServingConfig()
     workdir = tempfile.mkdtemp(prefix="oni_route_")
-    kv_dir = args.kv_dir or os.path.join(workdir, "kv")
-    from ..parallel.membership import FileKVClient
+    kv_server = None
+    if args.kv_listen:
+        from ..parallel.membership import KVServer, TcpKVClient
 
-    kv = FileKVClient(kv_dir)
+        lhost, _, lport = args.kv_listen.partition(":")
+        kv_server = KVServer(lhost or "127.0.0.1",
+                             int(lport) if lport else 0)
+        print(f"KV_LISTEN {kv_server.host} {kv_server.port}",
+              file=sys.stderr, flush=True)
+        kv = TcpKVClient(kv_server.host, kv_server.port)
+        kv_flags = ["--kv-connect",
+                    f"{kv_server.host}:{kv_server.port}"]
+    elif args.kv_connect:
+        from ..parallel.membership import TcpKVClient
+
+        chost, _, cport = args.kv_connect.partition(":")
+        kv = TcpKVClient(chost or "127.0.0.1", int(cport))
+        kv_flags = ["--kv-connect", args.kv_connect]
+    else:
+        from ..parallel.membership import FileKVClient
+
+        kv_dir = args.kv_dir or os.path.join(workdir, "kv")
+        kv = FileKVClient(kv_dir)
+        kv_flags = ["--kv-dir", kv_dir]
     procs: dict = {}
     extra: "list[str]" = []
     router = FleetRouter(cfg, kv=kv)
+    scaler = None
     try:
         if args.replicas:
             for i in range(args.replicas):
                 rid = f"r{i}"
                 proc, host, port = _spawn_replica(
-                    rid, kv_dir, workdir, extra)
+                    rid, kv_flags, workdir, extra)
                 procs[rid] = proc
                 router.connect_replica(rid, host, port)
         elif args.connect:
@@ -342,6 +396,27 @@ def route_stream(args) -> int:
                 spec.threshold if spec.threshold is not None
                 else sc_threshold)
         router.start()
+        if args.autoscale:
+            from ..serving.autoscale import AutoScaler
+
+            spawn_seq = [len(procs)]
+
+            def _as_spawn():
+                rid = f"as{spawn_seq[0]}"
+                spawn_seq[0] += 1
+                proc, host, port = _spawn_replica(
+                    rid, kv_flags, workdir, extra)
+                procs[rid] = proc
+                return rid, host, port
+
+            def _as_stop(rid):
+                proc = procs.pop(rid, None)
+                if proc is not None:
+                    proc.terminate()
+
+            scaler = AutoScaler(router, spawn=_as_spawn,
+                                stop=_as_stop, config=cfg)
+            scaler.start()
         collector = _FlagCollector(thresholds, sys.stdout)
         routed = skipped = 0
         redeploys: "list[dict]" = []
@@ -363,7 +438,7 @@ def route_stream(args) -> int:
             if (args.redeploy_after and procs
                     and routed == args.redeploy_after):
                 redeploys = _rolling_redeploy(
-                    router, procs, kv_dir, workdir, extra)
+                    router, procs, kv_flags, workdir, extra)
         router.flush()
         collector.close()
         summary = {
@@ -376,10 +451,17 @@ def route_stream(args) -> int:
             "redeploys": len(redeploys),
             "stats": router.stats(),
         }
+        if scaler is not None:
+            summary["autoscale"] = [
+                d for d in scaler.decisions if d["action"] != "hold"]
         print(json.dumps(summary), file=sys.stderr, flush=True)
         return 0 if collector.errors == 0 else 1
     finally:
+        if scaler is not None:
+            scaler.close()
         router.close()
+        if kv_server is not None:
+            kv_server.close()
         for proc in procs.values():
             proc.terminate()
         for proc in procs.values():

@@ -56,8 +56,21 @@ the whole stack above replicated across N processes —
 `ml_ops route --replicas N` / `ml_ops replica` are the CLI front ends;
 aggregate events/s scales with the replica count and a dead replica
 costs a promotion window, not the fleet.
+
+Cross-host, self-scaling serving (wire.py + autoscale.py): the fleet's
+default frame is a versioned COLUMNAR wire (typed per-column
+descriptors, zero-copy numpy decode, pickle only as a negotiated
+one-release fallback); same-host router<->replica pairs upgrade to a
+shared-memory double-buffered ring so local hops skip TCP entirely;
+membership rides any KV client — the file store same-host, the TCP
+``KVServer``/``TcpKVClient`` pair cross-host — so N routers run with
+zero coordination (placement is a pure function of the roster,
+failover backfill is settled by a first-writer-wins promotion claim);
+and ``AutoScaler`` sizes the fleet by Little's law from the measured
+admission-window occupancy, journaling every decision.
 """
 
+from .autoscale import AutoScaler
 from .batcher import BatchScorer, ScoreFuture
 from .fleet import (
     FleetRegistry,
@@ -90,6 +103,7 @@ from .placement import (
 from .refresh import RefreshLoop, topic_probs_from_log_beta
 from .replica import ReplicaServer, featurizer_for
 from .router import FleetRouter, ReplicaLink
+from .wire import ShmRing, decode_payload, encode_payload
 from .registry import ModelRegistry, ModelSnapshot, validate_model
 from .residency import (
     TIER_COLD,
@@ -128,6 +142,10 @@ __all__ = [
     "featurizer_for",
     "FleetRouter",
     "ReplicaLink",
+    "AutoScaler",
+    "ShmRing",
+    "encode_payload",
+    "decode_payload",
     "RefreshLoop",
     "topic_probs_from_log_beta",
     "ModelRegistry",

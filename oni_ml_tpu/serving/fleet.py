@@ -596,12 +596,27 @@ class FleetScorer:
         self._featurize_engine = eng
         fb, fb_src = resolve("featurize_block", self.config.featurize_block)
         self._featurize_block = int(fb)
+        # Size-aware engine gate: below the measured break-even a
+        # device featurize dispatch LOSES to the vectorized host parse
+        # on pure glue (the 0.91x paged A/B), so small segments stay
+        # host-side even under a device/fused engine.  Resolved once,
+        # like the engine itself.
+        if eng == "host":
+            be, be_src = 1, "engine"
+        else:
+            from ..sources.device import resolve_break_even
+
+            be, be_src = resolve_break_even(
+                self.config.featurize_break_even)
+        self._featurize_break_even = int(be)
         self.plan = {
             "max_batch": {"value": self.max_batch, "source": mb_src},
             "max_wait_ms": {"value": self.max_wait_ms, "source": mw_src},
             "featurize_engine": {"value": eng, "source": eng_src},
             "featurize_block": {"value": self._featurize_block,
                                 "source": fb_src},
+            "featurize_break_even": {
+                "value": self._featurize_break_even, "source": be_src},
         }
         if self.max_batch < 1:
             raise ValueError(f"fleet_max_batch ({self.max_batch}) must "
@@ -966,7 +981,8 @@ class FleetScorer:
         featurizer (the golden oracle; also the fallback for unlowerable
         vocabularies, which `device_batch` reports as None after
         journaling one `featurize_compile` record)."""
-        if model is not None and self._featurize_engine != "host":
+        if (model is not None and self._featurize_engine != "host"
+                and len(items) >= self._featurize_break_even):
             rows = [p.row for p in items]
             if all(r is not None for r in rows):
                 batch, info = device_batch(

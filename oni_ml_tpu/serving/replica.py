@@ -10,9 +10,14 @@ family — a wedged backend (heartbeat -> BackendLost) now kills ONE
 replica's tenants for the promotion window instead of the whole fleet
 (ROADMAP item 5).
 
-Wire protocol (router <-> replica): length-prefixed pickle frames over
-TCP (same-host trust domain, exactly like the PR 11 KV ring's pickled
-payloads).  Every request carries an ``id``; every response echoes it.
+Wire protocol (router <-> replica): length-prefixed **columnar**
+frames over TCP (serving/wire.py — typed arrays as raw buffers with
+dtype/shape descriptors, zero-copy numpy decode; pickle only as the
+negotiated fallback one release back).  Every request carries an
+``id``; every response echoes it.  A ``hello`` op negotiates the codec
+per link and, for same-host peers, upgrades the data path to a
+shared-memory ring pair (wire.ShmRing) so local hops never touch the
+TCP stack — the socket stays open purely as the liveness/EOF signal.
 Control ops (add_tenant / publish / warmup / stats / drain / shutdown
 / ping) answer synchronously from the connection's reader thread.
 ``submit`` is ASYNC: the reader enqueues the event into the tenant's
@@ -35,59 +40,23 @@ family its new traffic dispatches.
 from __future__ import annotations
 
 import os
-import pickle
 import socket
-import struct
 import threading
 import time
 from collections import deque
 
 from ..config import ServingConfig
+from . import wire
 from .fleet import FleetRegistry, FleetScorer
 from .tenants import TenantSpec
 
-_LEN = struct.Struct("!I")
-# One frame holds a pickled op (a submit is one event line; the bulkiest
-# is add_tenant carrying a tenant's model) — bound it so a corrupted
-# length prefix fails loudly instead of allocating gigabytes.
-MAX_FRAME_BYTES = 256 << 20
-
-
-def send_frame(sock: socket.socket, obj, lock: "threading.Lock | None"
-               = None) -> int:
-    """Pickle `obj` and write one length-prefixed frame.  `lock`
-    serializes concurrent writers on a shared socket (sendall is not
-    atomic across threads).  Returns the payload byte count."""
-    data = pickle.dumps(obj, protocol=4)
-    if len(data) > MAX_FRAME_BYTES:
-        raise ValueError(f"frame too large: {len(data)} bytes")
-    buf = _LEN.pack(len(data)) + data
-    if lock is not None:
-        with lock:
-            sock.sendall(buf)
-    else:
-        sock.sendall(buf)
-    return len(data)
-
-
-def recv_frame(sock: socket.socket):
-    """Read one frame; raises ConnectionError on EOF / short read."""
-    head = _recv_exact(sock, _LEN.size)
-    (n,) = _LEN.unpack(head)
-    if n > MAX_FRAME_BYTES:
-        raise ConnectionError(f"oversized frame announced: {n} bytes")
-    return pickle.loads(_recv_exact(sock, n))
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    parts = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
-            raise ConnectionError("peer closed mid-frame")
-        parts.append(chunk)
-        n -= len(chunk)
-    return b"".join(parts)
+# Framing lives in serving/wire.py since the columnar wire landed;
+# re-exported here because this module IS the protocol endpoint and
+# existing callers/tests import the frame helpers from it.
+MAX_FRAME_BYTES = wire.MAX_FRAME_BYTES
+send_frame = wire.send_frame
+recv_frame = wire.recv_frame
+_recv_exact = wire._recv_exact
 
 
 def featurizer_for(dsource: str, cuts: tuple):
@@ -102,16 +71,17 @@ class _Resolver:
     response frames.  FIFO matches flush-resolution order closely
     enough that head-of-line waiting costs microseconds, and it keeps
     the response path single-writer per purpose (control responses
-    share the socket under the same write lock)."""
+    share the socket under the same write lock).  `send_fn` abstracts
+    the response transport — a framed socket write for TCP
+    connections, a ring push for same-host shm links; the resolver
+    just streams batches."""
 
     # Periodic liveness poll while blocked on an unresolved future, so
     # a shutdown/kill never strands the thread on .result(None).
     _WAIT_SLICE_S = 0.25
 
-    def __init__(self, sock: socket.socket,
-                 wlock: threading.Lock) -> None:
-        self._sock = sock
-        self._wlock = wlock
+    def __init__(self, send_fn) -> None:
+        self._send = send_fn
         self._cond = threading.Condition()
         self._queue: deque = deque()
         self._stopped = False
@@ -184,9 +154,7 @@ class _Resolver:
                         nrsp["error"] = repr(e)[:300]
                     batch.append(nrsp)
             try:
-                send_frame(self._sock,
-                           batch if len(batch) > 1 else rsp,
-                           self._wlock)
+                self._send(batch if len(batch) > 1 else rsp)
             except OSError:
                 return  # connection gone; reader thread handles it
 
@@ -227,6 +195,7 @@ class ReplicaServer:
         self.stopped = threading.Event()
         self._conns: "list[socket.socket]" = []
         self._resolvers: "list[_Resolver]" = []
+        self._rings: "list" = []
         self._cuts: dict = {}
         self._router_versions: dict = {}
         self._srv = socket.create_server((host, port))
@@ -304,13 +273,23 @@ class ReplicaServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
-        resolver = _Resolver(conn, wlock)
+        # Responses mirror the codec of the last request on this
+        # connection: a negotiated-fallback (pickle) peer is answered
+        # in pickle, a columnar peer in columnar, with no per-link
+        # negotiation state to carry between the data and ctrl conns.
+        codec = ["pickle" if self.config.wire_format == "pickle"
+                 else "columnar"]
+
+        def reply(obj) -> int:
+            return wire.send_frame(conn, obj, wlock, codec=codec[0])
+
+        resolver = _Resolver(reply)
         with self._lock:
             self._resolvers.append(resolver)
         try:
             while True:
                 try:
-                    req = recv_frame(conn)
+                    req, codec[0] = wire.recv_frame_tagged(conn)
                 except (ConnectionError, OSError):
                     return
                 op = req.get("op")
@@ -322,11 +301,7 @@ class ReplicaServer:
                         resolver.enqueue(rid, fut)
                     except Exception as e:
                         try:
-                            send_frame(
-                                conn,
-                                {"id": rid, "error": repr(e)[:300]},
-                                wlock,
-                            )
+                            reply({"id": rid, "error": repr(e)[:300]})
                         except OSError:
                             return
                     continue
@@ -342,7 +317,7 @@ class ReplicaServer:
                                 {"id": eid, "error": repr(e)[:300]})
                     if errors:
                         try:
-                            send_frame(conn, errors, wlock)
+                            reply(errors)
                         except OSError:
                             return
                     continue
@@ -351,7 +326,7 @@ class ReplicaServer:
                 except Exception as e:
                     rsp = {"id": rid, "error": repr(e)[:300]}
                 try:
-                    send_frame(conn, rsp, wlock)
+                    reply(rsp)
                 except OSError:
                     return
                 if op == "shutdown":
@@ -369,6 +344,8 @@ class ReplicaServer:
     def _handle(self, op: str, req: dict) -> dict:
         if op == "ping":
             return {"ok": True, "replica": self.replica_id}
+        if op == "hello":
+            return self._op_hello(req)
         if op == "add_tenant":
             return self._op_add_tenant(req)
         if op == "publish":
@@ -394,6 +371,105 @@ class ReplicaServer:
         if op == "shutdown":
             return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
+
+    def _op_hello(self, req: dict) -> dict:
+        """Wire negotiation: pick the frame codec for this link from
+        the peer's offer (our own ``wire_format`` config can force the
+        one-release pickle fallback), and for a same-host peer that
+        asked, stand up a shared-memory ring pair so data frames skip
+        the TCP stack entirely.  The response names the rings; the
+        caller attaches and the TCP data socket degrades to a
+        liveness/EOF signal + oversize-frame escape."""
+        offered = req.get("wire") or ["pickle"]
+        chosen = ("pickle"
+                  if (self.config.wire_format == "pickle"
+                      or "columnar" not in offered)
+                  else "columnar")
+        shm = None
+        if (chosen == "columnar" and req.get("shm")
+                and self.config.wire_shm
+                and req.get("host") == socket.gethostname()):
+            try:
+                shm = self._make_rings()
+            except Exception:
+                shm = None    # ring setup must never break the link
+        return {"ok": True, "wire": chosen, "shm": shm}
+
+    def _make_rings(self) -> dict:
+        slab = int(self.config.wire_shm_slab_bytes)
+        c2s = wire.ShmRing.create(slab)     # router -> replica submits
+        s2c = wire.ShmRing.create(slab)     # replica -> router scores
+        with self._lock:
+            if self._closed:
+                c2s.close()
+                s2c.close()
+                raise RuntimeError("replica closed")
+            self._rings += [c2s, s2c]
+        threading.Thread(
+            target=self._serve_ring, args=(c2s, s2c),
+            name=f"oni-replica-{self.replica_id}-ring", daemon=True,
+        ).start()
+        return {"c2s": c2s.name, "s2c": s2c.name, "slab": slab}
+
+    def _serve_ring(self, c2s: "wire.ShmRing",
+                    s2c: "wire.ShmRing") -> None:
+        """Data-path twin of _serve_conn over a ring pair: pop submit
+        frames, stream score batches back.  Control ops stay on the
+        TCP ctrl connection; a ring frame carrying one is answered
+        with an error instead of silently absorbed."""
+
+        def reply(obj) -> int:
+            payload = wire.encode_payload(obj)
+            if not s2c.push(payload,
+                            timeout_s=self.config.route_op_timeout_s):
+                raise BrokenPipeError("response ring closed")
+            return len(payload)
+
+        resolver = _Resolver(reply)
+        with self._lock:
+            self._resolvers.append(resolver)
+        try:
+            while True:
+                payload = c2s.pop(0.25)
+                if payload is None:
+                    if c2s.closed or self._closed:
+                        return
+                    continue
+                try:
+                    req = wire.decode_payload(payload)
+                except ConnectionError:
+                    return
+                op = req.get("op")
+                rid = req.get("id")
+                try:
+                    if op == "submit":
+                        fut = self.scorer.submit(
+                            req["tenant"], req["raw"])
+                        resolver.enqueue(rid, fut)
+                    elif op == "submit_many":
+                        tenant = req["tenant"]
+                        for eid, raw in zip(req["ids"], req["raws"]):
+                            try:
+                                fut = self.scorer.submit(tenant, raw)
+                                resolver.enqueue(eid, fut)
+                            except Exception as e:
+                                reply([{"id": eid,
+                                        "error": repr(e)[:300]}])
+                    else:
+                        reply({"id": rid,
+                               "error": f"op {op!r} is control-path "
+                                        "only; rings carry data frames"})
+                except OSError:
+                    return
+                except Exception as e:
+                    try:
+                        reply({"id": rid, "error": repr(e)[:300]})
+                    except OSError:
+                        return
+        finally:
+            resolver.stop()
+            c2s.close()
+            s2c.close()
 
     def _op_add_tenant(self, req: dict) -> dict:
         """Idempotent placement push: first call registers the tenant,
@@ -517,6 +593,7 @@ class ReplicaServer:
                 return
             self._closed = True
             conns = list(self._conns)
+            rings = list(self._rings)
         if self._heartbeat is not None:
             self._heartbeat.stop()
         if self._membership is not None:
@@ -534,6 +611,8 @@ class ReplicaServer:
                 c.close()
             except OSError:
                 pass
+        for r in rings:
+            r.close()
         self.stopped.set()
 
     def kill(self) -> None:
@@ -546,6 +625,7 @@ class ReplicaServer:
                 return
             self._closed = True
             conns = list(self._conns)
+            rings = list(self._rings)
         if self._heartbeat is not None:
             self._heartbeat.stop()
         try:
@@ -557,4 +637,6 @@ class ReplicaServer:
                 c.close()
             except OSError:
                 pass
+        for r in rings:
+            r.close()
         self.stopped.set()

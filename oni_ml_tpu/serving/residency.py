@@ -107,7 +107,7 @@ def spill_model(path: str, model: ScoringModel) -> int:
 
 
 def load_spill(path: str) -> ScoringModel:
-    with np.load(path, allow_pickle=True) as z:
+    with np.load(path, allow_pickle=True) as z:  # lint: ok(no-pickle-wire, host-spill snapshot this process wrote itself — object-dtype string arrays, never wire input)
         ips = [str(s) for s in z["ips"]]
         words = [str(s) for s in z["words"]]
         return ScoringModel(

@@ -46,10 +46,13 @@ import threading
 import time
 
 from ..config import ServingConfig
+from . import wire
 from .batcher import ScoreFuture
 from .placement import Placement, place, shadow_for
-from .replica import recv_frame, send_frame
 from .tenants import TenantSpec
+
+recv_frame = wire.recv_frame
+send_frame = wire.send_frame
 
 
 class _Hop:
@@ -77,7 +80,9 @@ class ReplicaLink:
     blocked admission lane backpressures only the data path)."""
 
     def __init__(self, replica_id: str, host: str, port: int, *,
-                 op_timeout_s: float, on_score, on_down) -> None:
+                 op_timeout_s: float, on_score, on_down,
+                 wire_format: str = "columnar",
+                 want_shm: bool = False) -> None:
         import socket
 
         self.replica_id = replica_id
@@ -85,6 +90,9 @@ class ReplicaLink:
         self._op_timeout_s = op_timeout_s
         self._on_score = on_score
         self._on_down = on_down
+        self.codec = wire_format
+        self.shm_tx: "wire.ShmRing | None" = None
+        self.shm_rx: "wire.ShmRing | None" = None
         self._data = socket.create_connection((host, port))
         self._ctrl = socket.create_connection((host, port))
         for s in (self._data, self._ctrl):
@@ -100,6 +108,58 @@ class ReplicaLink:
                 target=self._reader, args=(sock, name == "data"),
                 name=f"oni-route-{replica_id}-{name}", daemon=True,
             ).start()
+        if wire_format == "columnar":
+            self._negotiate(want_shm)
+
+    def _negotiate(self, want_shm: bool) -> None:
+        """hello handshake: settle the frame codec (a peer whose
+        config forces the fallback answers "pickle"; a pre-columnar
+        peer rejects the op — both downgrade this link) and attach the
+        shm ring pair a same-host replica offered."""
+        import socket as socket_mod
+
+        try:
+            rsp = self.call({
+                "op": "hello", "wire": ["columnar", "pickle"],
+                "shm": want_shm, "host": socket_mod.gethostname(),
+            })
+        except (RuntimeError, TimeoutError):
+            self.codec = "pickle"  # lint: ok(lock-discipline, negotiate runs once from __init__ before the link is published to any caller)
+            return
+        self.codec = rsp.get("wire", "columnar")  # lint: ok(lock-discipline, negotiate runs once from __init__ before the link is published to any caller)
+        shm = rsp.get("shm")
+        if not shm:
+            return
+        try:
+            tx = wire.ShmRing.attach(shm["c2s"], int(shm["slab"]))
+            rx = wire.ShmRing.attach(shm["s2c"], int(shm["slab"]))
+        except Exception:
+            return              # ring attach must never break the link
+        self.shm_tx, self.shm_rx = tx, rx  # lint: ok(lock-discipline, negotiate runs once from __init__ before the link is published to any caller)
+        threading.Thread(
+            target=self._ring_reader, args=(rx,),
+            name=f"oni-route-{self.replica_id}-ring", daemon=True,
+        ).start()
+
+    def _ring_reader(self, rx: "wire.ShmRing") -> None:
+        """Shm twin of the data-socket reader: score batches pop off
+        the response ring.  Link death stays the TCP reader's job —
+        this thread just drains and exits when the ring closes."""
+        while True:
+            payload = rx.pop(0.25)
+            if payload is None:
+                if rx.closed or self._closed:
+                    return
+                continue
+            try:
+                msg = wire.decode_payload(payload)
+            except ConnectionError:
+                return
+            if isinstance(msg, list):
+                for m in msg:
+                    self._on_score(self.replica_id, m)
+            else:
+                self._on_score(self.replica_id, msg)
 
     def _reader(self, sock, is_data: bool) -> None:
         while True:
@@ -143,7 +203,8 @@ class ReplicaLink:
             cid = self._call_seq
             entry = [threading.Event(), None]
             self._calls[cid] = entry
-        send_frame(self._ctrl, {**req, "id": cid}, self._ctrl_wlock)
+        wire.send_frame(self._ctrl, {**req, "id": cid},
+                        self._ctrl_wlock, codec=self.codec)
         if not entry[0].wait(timeout_s or self._op_timeout_s):
             with self._call_lock:
                 self._calls.pop(cid, None)
@@ -160,28 +221,38 @@ class ReplicaLink:
         return rsp
 
     def send_submit(self, rid: int, tenant: str, raw) -> int:
-        return send_frame(
-            self._data,
-            {"op": "submit", "id": rid, "tenant": tenant, "raw": raw},
-            self._data_wlock,
-        )
+        return self._send_data(
+            {"op": "submit", "id": rid, "tenant": tenant, "raw": raw})
 
     def send_submit_many(self, rids: "list[int]", tenant: str,
                          raws: list) -> int:
-        """One frame carrying a whole ingest chunk: per-event pickle +
-        syscall overhead amortizes across the chunk, which is what
+        """One frame carrying a whole ingest chunk: per-event framing
+        + syscall overhead amortizes across the chunk, which is what
         lets the router's feed path keep N replicas busy instead of
         spending its core on framing."""
-        return send_frame(
-            self._data,
+        return self._send_data(
             {"op": "submit_many", "ids": rids, "tenant": tenant,
-             "raws": raws},
-            self._data_wlock,
-        )
+             "raws": raws})
+
+    def _send_data(self, msg: dict) -> int:
+        """Data-frame send: the shm ring when negotiated and the frame
+        fits a slab, the TCP socket otherwise.  A closed ring means
+        the replica is going (or gone) — fall through to the socket,
+        whose failure raises the OSError the failover path expects."""
+        tx = self.shm_tx
+        if tx is not None:
+            payload = wire.encode_payload(msg)
+            if len(payload) <= tx.capacity() and tx.push(payload):
+                return len(payload)
+        return wire.send_frame(self._data, msg, self._data_wlock,
+                               codec=self.codec)
 
     def close(self) -> None:
         with self._call_lock:
             self._closed = True
+        for ring in (self.shm_tx, self.shm_rx):
+            if ring is not None:
+                ring.close()
         for s in (self._data, self._ctrl):
             try:
                 s.close()
@@ -197,8 +268,16 @@ class FleetRouter:
 
     def __init__(self, config: "ServingConfig | None" = None, *,
                  journal=None, recorder=None, kv=None,
-                 membership_ns: str = "oni/fleet") -> None:
+                 membership_ns: str = "oni/fleet",
+                 router_id: "str | None" = None) -> None:
+        import os
+
         self.config = config or ServingConfig()
+        # Distinct per router PROCESS: N routers run with zero
+        # coordination (placement is a pure function of membership),
+        # and this id is what first-writer-wins promotion claims and
+        # per-router journal records key on.
+        self.router_id = router_id or f"router-{os.getpid()}"
         self._journal = getattr(journal, "journal", journal)
         self._recorder = recorder
         self._cond = threading.Condition()
@@ -222,6 +301,11 @@ class FleetRouter:
             from ..parallel.membership import MembershipClient
 
             self._membership = MembershipClient(kv, membership_ns)
+            self._journal_safe({
+                "kind": "membership", "event": "transport",
+                "router": self.router_id,
+                "transport": type(kv).__name__,
+            })
 
     # -- setup ---------------------------------------------------------------
 
@@ -231,6 +315,8 @@ class FleetRouter:
             replica_id, host, port,
             op_timeout_s=self.config.route_op_timeout_s,
             on_score=self._on_score, on_down=self._on_link_down,
+            wire_format=self.config.wire_format,
+            want_shm=self.config.wire_shm,
         )
         with self._cond:
             if replica_id in self._links:
@@ -239,12 +325,20 @@ class FleetRouter:
                                  "connected")
             self._links[replica_id] = link
             self._dead.discard(replica_id)
+        self._journal_safe({
+            "kind": "wire", "edge": replica_id,
+            "router": self.router_id, "format": link.codec,
+            "shm": link.shm_tx is not None,
+        })
         if self._membership is not None:
             # A respawned replica under a previously-failed id must
             # not be re-killed by its own stale fail key on the
-            # monitor's next poll.
+            # monitor's next poll — and a stale promotion claim from
+            # its previous death must not make the NEXT failover
+            # believe someone already owns it.
             try:
                 self._membership.clear_failure(replica_id)
+                self._membership.clear_promotion(replica_id)
             except Exception:
                 pass
         with self._cond:
@@ -255,6 +349,31 @@ class FleetRouter:
                 "admission_stall_s": 0.0,
                 "window_events": 0, "window_bytes": 0,
             })
+
+    def connect_from_membership(self) -> "list[str]":
+        """Discover and connect every replica registered in the KV
+        roster — how a second (third, ...) router joins an already
+        running fleet without a host/port list: replicas register
+        their endpoint at startup, placement is a pure function of the
+        roster, so any router that reads it computes the same routes.
+        Idempotent; returns the connected replica ids."""
+        if self._membership is None:
+            raise RuntimeError(
+                "connect_from_membership needs a KV client "
+                "(FleetRouter(kv=...))")
+        for rid, rec in sorted(self._membership.members().items()):
+            meta = rec.get("meta", {})
+            with self._cond:
+                known = rid in self._links or rid in self._dead
+            if known or "host" not in meta or "port" not in meta:
+                continue
+            try:
+                self.connect_replica(rid, meta["host"],
+                                     int(meta["port"]))
+            except (OSError, ValueError):
+                continue    # raced a dying/duplicate registration
+        with self._cond:
+            return sorted(self._links)
 
     def add_tenant(self, spec: TenantSpec, cuts: tuple, model, *,
                    featurizer=None) -> None:
@@ -564,6 +683,7 @@ class FleetRouter:
             if every and e["window_events"] >= every:
                 emit = {
                     "kind": "route", "edge": replica_id,
+                    "router": self.router_id,
                     "events": e["window_events"],
                     "bytes": e["window_bytes"],
                     "inflight": len(self._inflight),
@@ -611,8 +731,21 @@ class FleetRouter:
             self._inflight_by_replica.pop(replica_id, None)
             self._cond.notify_all()
         link.close()
+        # Concurrent-router idempotence: first-writer-wins on the KV
+        # promotion key decides which router owns the fleet-level
+        # side of this failover (the model backfill pushes).  LOSERS
+        # still promote locally — placement is a pure function of the
+        # live roster, so every router computes the identical new
+        # routes from its own copy — and still replay their OWN
+        # admission journals (those futures live in this process).
+        # What losing skips is the duplicate backfill churn.
+        claimed = True
+        if self._membership is not None:
+            claimed = self._membership.claim_promotion(
+                replica_id, self.router_id)
         self._journal_safe({
             "kind": "failover", "replica": replica_id,
+            "router": self.router_id, "claimed": claimed,
             "reason": str(reason)[:300], "promoted": len(promoted),
             "reshadowed": len(reshadowed), "inflight": len(victims),
         })
@@ -629,20 +762,24 @@ class FleetRouter:
         # Backfill: make sure every promoted tenant's NEW primary and
         # refilled shadow actually hold the tenant (they do unless the
         # same tenant lost primary and shadow in quick succession).
-        for t in promoted + reshadowed:
-            with self._cond:
-                targets = [self._route.get(t), self._shadow.get(t)]
-                hosted = {r: self._hosted.get(r, set())
-                          for r in targets if r}
-            for r in targets:
-                if r and t not in hosted.get(r, set()):
-                    try:
-                        self._push_tenant(r, t)
-                    except Exception:
-                        pass
+        # Claim losers skip this — the winner pushes, and add_tenant
+        # is router_version-idempotent on the replica even if both do.
+        if claimed:
+            for t in promoted + reshadowed:
+                with self._cond:
+                    targets = [self._route.get(t), self._shadow.get(t)]
+                    hosted = {r: self._hosted.get(r, set())
+                              for r in targets if r}
+                for r in targets:
+                    if r and t not in hosted.get(r, set()):
+                        try:
+                            self._push_tenant(r, t)
+                        except Exception:
+                            pass
         recovery_s = time.perf_counter() - t_detect
         record = {
             "kind": "failover", "replica": replica_id,
+            "router": self.router_id, "claimed": claimed,
             "event": "recovered", "promoted": len(promoted),
             "resent": resent, "resend_failures": failed,
             "recovery_s": round(recovery_s, 6),
@@ -875,10 +1012,16 @@ class FleetRouter:
                 "tenants": len(self._tenants),
                 "inflight": len(self._inflight),
                 "edges": {
-                    r: {k: v for k, v in e.items()
-                        if not k.startswith("window_")}
+                    r: {
+                        **{k: v for k, v in e.items()
+                           if not k.startswith("window_")},
+                        # Live occupancy of this edge's admission
+                        # window — the autoscaler's utilization signal.
+                        "inflight": self._inflight_by_replica.get(r, 0),
+                    }
                     for r, e in self._edge.items()
                 },
+                "max_inflight": self.config.route_max_inflight,
                 "failovers": list(self._failovers),
             }
 
@@ -932,6 +1075,7 @@ class FleetRouter:
         for r, e in edges.items():
             self._journal_safe({
                 "kind": "route", "edge": r, "event": "close",
+                "router": self.router_id,
                 "events": e["events"], "bytes": e["bytes"],
                 "errors": e["errors"], "resends": e["resends"],
                 "admission_stall_s": round(e["admission_stall_s"], 6),

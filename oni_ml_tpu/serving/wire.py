@@ -1,0 +1,683 @@
+"""Columnar zero-copy serving wire + same-host shared-memory ring.
+
+The replicated fleet's original wire was length-prefixed **pickle**
+frames (serving/replica.py, PR 15) — fine inside one trust domain, but
+every submit re-serialized typed arrays as Python object graphs, and
+unpickling is the one place a frame's bytes execute code, which a
+cross-host fleet cannot accept.  This module replaces it with a
+versioned columnar frame in the dataplane's own vocabulary
+(dataplane/columns.py): typed arrays travel as raw buffers with
+dtype/shape descriptors and decode as **zero-copy numpy views** over
+the received frame; everything scalar rides a compact JSON meta blob.
+
+Frame layout (`encode_payload`):
+
+    header      !4sBBHI — magic b"OCWF", version, kind, ncols, meta_len
+    descriptors per column: name (!H + utf8), dtype str (!B + utf8,
+                numpy dtype.str, byte order explicit), ndim (!B),
+                dims (!q each)
+    meta        meta_len bytes of JSON (op name, scalar fields, the
+                per-key encoding tags)
+    buffers     each column's raw bytes, 8-byte aligned
+
+`decode_payload` auto-detects the codec by magic: a frame that does
+not open with ``OCWF`` is a **negotiated pickle fallback** frame
+(serving/wire_pickle.py) — the one-release compatibility path for
+peers that answered the ``hello`` negotiation with ``"pickle"``.
+Version mismatches, truncated buffers, and length drift all fail
+loudly as ConnectionError before any allocation-by-attacker.
+
+Typed encodings (tagged per top-level message key):
+
+    ``nd``     numpy array -> one column, zero-copy both ways
+    ``i8l``    list[int] (submit_many ids) -> int64 column
+    ``s1``     list[str] -> utf8 blob + int64 offsets
+    ``s2``     list[list[str]] (submit_many raws) -> flattened utf8
+               blob + offsets + per-row field counts
+    ``cuts``   tuple of numeric sequences -> one float64 column each
+    ``model``  ScoringModel -> theta/p columns + key/value columns
+    ``colset`` dataplane ColumnSet -> one column per schema field
+    ``opq``    no columnar encoding (the featurizer push) ->
+               wire_pickle opaque bytes, tagged so the lint budget for
+               pickle stays exactly one module
+
+Score batches (the replica resolver's coalesced responses) get a
+dedicated frame kind: ids/scores/versions as three columns — the bulk
+response path never materializes per-event dicts on the wire, and
+float64 scores round-trip bit-identical by construction.
+
+``ShmRing``: same-host router<->replica pairs negotiated via ``hello``
+upgrade the DATA path to a pair of these — two fixed shared-memory
+slabs (``multiprocessing.shared_memory``) double-buffered under a
+futex-free seqlock header.  The producer fills slab ``wseq % 2`` while
+the consumer drains the other; publication is a seqlock'd counter
+bump (writer makes the guard odd, writes, makes it even; the reader
+rereads until stable), so neither side ever takes a lock the other
+can die holding, and a SIGKILL'd peer leaves nothing to clean but the
+segment itself.  Local hops never touch the TCP stack; the TCP
+connection stays open purely as the liveness/EOF signal and the
+oversize-frame escape.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from . import wire_pickle
+
+MAGIC = b"OCWF"
+WIRE_VERSION = 1
+KIND_MSG = 1
+KIND_SCORES = 2
+_ALIGN = 8
+_HDR = struct.Struct("!4sBBHI")
+_LEN = struct.Struct("!I")
+# One frame holds one op (the bulkiest is add_tenant carrying a
+# tenant's model) — bound it so a corrupted length prefix fails loudly
+# instead of allocating gigabytes.
+MAX_FRAME_BYTES = 256 << 20
+
+
+# ---------------------------------------------------------------------------
+# scalar-field classification
+# ---------------------------------------------------------------------------
+
+
+def _jsonable(v) -> bool:
+    """True when `v` survives the JSON meta blob faithfully (tuples
+    coerce to lists — accepted and documented; non-str dict keys do
+    NOT, so they fall through to a typed encoding or the opaque tag)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return True
+    if isinstance(v, (list, tuple)):
+        return all(_jsonable(x) for x in v)
+    if isinstance(v, dict):
+        return all(isinstance(k, str) and _jsonable(x)
+                   for k, x in v.items())
+    return False
+
+
+def _is_model(v) -> bool:
+    return (hasattr(v, "theta") and hasattr(v, "p")
+            and hasattr(v, "ip_index") and hasattr(v, "word_index"))
+
+
+def _is_colset(v) -> bool:
+    return (hasattr(v, "schema") and hasattr(v, "columns")
+            and hasattr(v, "names"))
+
+
+def _is_cuts(v) -> bool:
+    if not isinstance(v, (tuple, list)) or not v:
+        return False
+    for part in v:
+        if isinstance(part, np.ndarray):
+            if part.ndim != 1:
+                return False
+        elif isinstance(part, (list, tuple)):
+            if not all(isinstance(x, (int, float)) for x in part):
+                return False
+        else:
+            return False
+    return True
+
+
+def _pack_strs(strs) -> "tuple[np.ndarray, np.ndarray]":
+    bs = [s.encode("utf-8") for s in strs]
+    off = np.zeros(len(bs) + 1, np.int64)
+    if bs:
+        np.cumsum([len(b) for b in bs], out=off[1:])
+    blob = np.frombuffer(b"".join(bs), np.uint8)
+    return blob, off
+
+
+def _unpack_strs(blob: np.ndarray, off: np.ndarray) -> "list[str]":
+    raw = blob.tobytes()
+    bounds = off.tolist()
+    return [raw[bounds[i]:bounds[i + 1]].decode("utf-8")
+            for i in range(len(bounds) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+def encode_payload(obj) -> bytes:
+    """One message -> one columnar frame payload.  Messages are the
+    op dicts replica.py/router.py already exchange, or the resolver's
+    list-of-score-responses batches."""
+    if isinstance(obj, list):
+        return _encode_scores(obj)
+    if not isinstance(obj, dict):
+        raise TypeError(
+            f"wire payload must be an op dict or a score batch, "
+            f"got {type(obj).__name__}")
+    fields: dict = {}
+    enc: dict = {}
+    cuts_n: dict = {}
+    cols: "list[tuple[str, np.ndarray]]" = []
+
+    def add(name: str, arr: np.ndarray) -> None:
+        cols.append((name, np.ascontiguousarray(arr)))
+
+    for k, v in obj.items():
+        if isinstance(v, np.ndarray):
+            enc[k] = "nd"
+            add(k, v)
+        elif _is_model(v):
+            enc[k] = "model"
+            add(f"{k}.theta", np.asarray(v.theta))
+            add(f"{k}.p", np.asarray(v.p))
+            ikb, iko = _pack_strs(v.ip_index.keys())
+            add(f"{k}.ikb", ikb)
+            add(f"{k}.iko", iko)
+            add(f"{k}.ikv", np.fromiter(
+                v.ip_index.values(), np.int64, len(v.ip_index)))
+            wkb, wko = _pack_strs(v.word_index.keys())
+            add(f"{k}.wkb", wkb)
+            add(f"{k}.wko", wko)
+            add(f"{k}.wkv", np.fromiter(
+                v.word_index.values(), np.int64, len(v.word_index)))
+        elif _is_colset(v):
+            enc[k] = "colset"
+            for name in v.names():
+                add(f"{k}.{name}", v.columns[name].values)
+        elif (k == "raws" and isinstance(v, list)
+                and all(isinstance(r, (list, tuple)) for r in v)):
+            enc[k] = "s2"
+            flat = [f for row in v for f in row]
+            blob, off = _pack_strs(flat)
+            add(f"{k}.b", blob)
+            add(f"{k}.o", off)
+            add(f"{k}.n", np.fromiter(
+                (len(row) for row in v), np.int32, len(v)))
+        elif (k == "ids" and isinstance(v, list)
+                and all(isinstance(x, int) for x in v)):
+            enc[k] = "i8l"
+            add(k, np.asarray(v, np.int64))
+        elif _is_cuts(v):
+            enc[k] = "cuts"
+            cuts_n[k] = len(v)
+            for i, part in enumerate(v):
+                add(f"{k}.{i}", np.asarray(part, np.float64))
+        elif _jsonable(v):
+            fields[k] = v
+        else:
+            enc[k] = "opq"
+            add(k, np.frombuffer(wire_pickle.encode_opaque(v),
+                                 np.uint8))
+    meta = {"f": fields}
+    if enc:
+        meta["e"] = enc
+    if cuts_n:
+        meta["cn"] = cuts_n
+    return _frame(KIND_MSG, meta, cols)
+
+
+def _encode_scores(batch: list) -> bytes:
+    n = len(batch)
+    ids = np.empty(n, np.int64)
+    scores = np.zeros(n, np.float64)
+    versions = np.zeros(n, np.int64)
+    errors = []
+    for i, rsp in enumerate(batch):
+        extra = set(rsp) - {"id", "score", "version", "error"}
+        if extra:
+            raise TypeError(
+                f"score batch entry has non-score keys {sorted(extra)}")
+        ids[i] = rsp["id"]
+        if "error" in rsp:
+            errors.append([i, str(rsp["error"])])
+        else:
+            scores[i] = rsp["score"]
+            versions[i] = rsp.get("version", 0)
+    meta = {"err": errors} if errors else {}
+    return _frame(KIND_SCORES, meta,
+                  [("id", ids), ("score", scores), ("ver", versions)])
+
+
+def _frame(kind: int, meta: dict, cols) -> bytes:
+    desc = bytearray()
+    for name, arr in cols:
+        nb = name.encode("utf-8")
+        db = arr.dtype.str.encode("ascii")
+        desc += struct.pack("!H", len(nb)) + nb
+        desc += struct.pack("!B", len(db)) + db
+        desc += struct.pack("!B", arr.ndim)
+        for d in arr.shape:
+            desc += struct.pack("!q", d)
+    mb = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    head = _HDR.pack(MAGIC, WIRE_VERSION, kind, len(cols), len(mb))
+    parts = [head, bytes(desc), mb]
+    off = len(head) + len(desc) + len(mb)
+    for _, arr in cols:
+        pad = (-off) % _ALIGN
+        if pad:
+            parts.append(b"\0" * pad)
+            off += pad
+        parts.append(memoryview(arr).cast("B"))
+        off += arr.nbytes
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+
+def decode_payload(buf):
+    """Frame payload -> message.  Columnar frames (magic match) decode
+    as zero-copy views over `buf`; anything else is a negotiated
+    pickle-fallback frame."""
+    mv = memoryview(buf)
+    if len(mv) >= 4 and bytes(mv[:4]) == MAGIC:
+        return _decode_columnar(mv)
+    return wire_pickle.decode_payload(mv)
+
+
+def _short(mv, need: int, pos: int, what: str) -> None:
+    if pos + need > len(mv):
+        raise ConnectionError(
+            f"truncated wire frame: {what} needs {need} bytes at "
+            f"offset {pos}, frame is {len(mv)}")
+
+
+def _decode_columnar(mv: memoryview):
+    _short(mv, _HDR.size, 0, "header")
+    magic, ver, kind, ncols, meta_len = _HDR.unpack_from(mv, 0)
+    if ver != WIRE_VERSION:
+        raise ConnectionError(
+            f"wire version mismatch: frame v{ver}, this end speaks "
+            f"v{WIRE_VERSION}")
+    pos = _HDR.size
+    descs = []
+    for _ in range(ncols):
+        _short(mv, 2, pos, "descriptor")
+        (nlen,) = struct.unpack_from("!H", mv, pos)
+        pos += 2
+        _short(mv, nlen + 2, pos, "descriptor")
+        name = bytes(mv[pos:pos + nlen]).decode("utf-8")
+        pos += nlen
+        (dlen,) = struct.unpack_from("!B", mv, pos)
+        pos += 1
+        _short(mv, dlen + 1, pos, "descriptor")
+        dt = bytes(mv[pos:pos + dlen]).decode("ascii")
+        pos += dlen
+        (ndim,) = struct.unpack_from("!B", mv, pos)
+        pos += 1
+        _short(mv, 8 * ndim, pos, "descriptor dims")
+        shape = struct.unpack_from(f"!{ndim}q", mv, pos)
+        pos += 8 * ndim
+        descs.append((name, dt, shape))
+    _short(mv, meta_len, pos, "meta")
+    meta = json.loads(bytes(mv[pos:pos + meta_len]))
+    pos += meta_len
+    cols: "dict[str, np.ndarray]" = {}
+    for name, dt, shape in descs:
+        pos += (-pos) % _ALIGN
+        dtype = np.dtype(dt)
+        count = 1
+        for d in shape:
+            count *= d
+        nbytes = count * dtype.itemsize
+        _short(mv, nbytes, pos, f"column {name!r}")
+        arr = np.frombuffer(mv[pos:pos + nbytes], dtype=dtype)
+        if len(shape) != 1:
+            arr = arr.reshape(shape)
+        cols[name] = arr
+        pos += nbytes
+    if pos != len(mv):
+        raise ConnectionError(
+            f"wire frame length drift: decoded {pos} of {len(mv)} "
+            "bytes")
+    if kind == KIND_SCORES:
+        return _decode_scores(meta, cols)
+    if kind == KIND_MSG:
+        return _decode_msg(meta, cols)
+    raise ConnectionError(f"unknown wire frame kind {kind}")
+
+
+def _decode_scores(meta: dict, cols: dict) -> list:
+    ids = cols["id"].tolist()
+    scores = cols["score"]
+    versions = cols["ver"].tolist()
+    errs = {i: msg for i, msg in meta.get("err", [])}
+    out = []
+    for i, rid in enumerate(ids):
+        if i in errs:
+            out.append({"id": rid, "error": errs[i]})
+        else:
+            out.append({"id": rid, "score": float(scores[i]),
+                        "version": versions[i]})
+    return out
+
+
+def _decode_msg(meta: dict, cols: dict) -> dict:
+    obj = dict(meta.get("f", {}))
+    for k, tag in meta.get("e", {}).items():
+        if tag == "nd":
+            obj[k] = cols[k]
+        elif tag == "i8l":
+            obj[k] = cols[k].tolist()
+        elif tag == "s1":
+            obj[k] = _unpack_strs(cols[f"{k}.b"], cols[f"{k}.o"])
+        elif tag == "s2":
+            flat = _unpack_strs(cols[f"{k}.b"], cols[f"{k}.o"])
+            rows = []
+            i = 0
+            for n in cols[f"{k}.n"].tolist():
+                rows.append(flat[i:i + n])
+                i += n
+            obj[k] = rows
+        elif tag == "cuts":
+            obj[k] = tuple(
+                cols[f"{k}.{i}"].tolist()
+                for i in range(meta["cn"][k]))
+        elif tag == "model":
+            from ..scoring.score import ScoringModel
+
+            ik = _unpack_strs(cols[f"{k}.ikb"], cols[f"{k}.iko"])
+            wk = _unpack_strs(cols[f"{k}.wkb"], cols[f"{k}.wko"])
+            obj[k] = ScoringModel(
+                ip_index=dict(zip(ik, cols[f"{k}.ikv"].tolist())),
+                theta=cols[f"{k}.theta"],
+                word_index=dict(zip(wk, cols[f"{k}.wkv"].tolist())),
+                p=cols[f"{k}.p"],
+            )
+        elif tag == "colset":
+            from ..dataplane.columns import Column, ColumnSet
+
+            prefix = f"{k}."
+            obj[k] = ColumnSet({
+                name[len(prefix):]: Column(name[len(prefix):],
+                                           cols[name])
+                for name in cols if name.startswith(prefix)
+            })
+        elif tag == "opq":
+            obj[k] = wire_pickle.decode_opaque(cols[k])
+        else:
+            raise ConnectionError(
+                f"unknown wire field encoding {tag!r} for key {k!r}")
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# socket framing
+# ---------------------------------------------------------------------------
+
+
+def send_frame(sock: socket.socket, obj,
+               lock: "threading.Lock | None" = None, *,
+               codec: str = "columnar") -> int:
+    """Encode `obj` with the link's negotiated codec and write one
+    length-prefixed frame.  `lock` serializes concurrent writers on a
+    shared socket (sendall is not atomic across threads).  Returns the
+    payload byte count — the edges' wire_bytes accounting."""
+    if codec == "pickle":
+        data = wire_pickle.encode_payload(obj)
+    else:
+        data = encode_payload(obj)
+    if len(data) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame too large: {len(data)} bytes")
+    buf = _LEN.pack(len(data)) + data
+    if lock is not None:
+        with lock:
+            sock.sendall(buf)
+    else:
+        sock.sendall(buf)
+    return len(data)
+
+
+def recv_frame(sock: socket.socket):
+    """Read one frame; raises ConnectionError on EOF / short read /
+    oversized announcement / malformed columnar payload."""
+    return recv_frame_tagged(sock)[0]
+
+
+def recv_frame_tagged(sock: socket.socket) -> "tuple[object, str]":
+    """recv_frame plus the codec the peer used — the replica mirrors
+    it on responses, so a negotiated-fallback peer is answered in the
+    codec it can actually read without per-link state."""
+    head = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionError(f"oversized frame announced: {n} bytes")
+    payload = _recv_exact(sock, n)
+    codec = ("columnar" if payload[:4] == MAGIC else "pickle")
+    return decode_payload(payload), codec
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    parts = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# same-host shared-memory ring
+# ---------------------------------------------------------------------------
+
+_RING_MAGIC = b"OCWR"
+# Header: magic+ver (8) | pseq (8) | wseq (8) | len0 (8) | len1 (8)
+#         | cseq (8) | rseq (8) | closed (8)
+_RING_HDR = 64
+_Q = struct.Struct("<Q")
+_OFF_PSEQ, _OFF_WSEQ, _OFF_LEN0, _OFF_LEN1 = 8, 16, 24, 32
+_OFF_CSEQ, _OFF_RSEQ, _OFF_CLOSED = 40, 48, 56
+
+
+class ShmRing:
+    """Single-producer single-consumer frame ring over one shared-memory
+    segment: two fixed slabs, double-buffered, published through a
+    futex-free seqlock header.  The producer fills slab ``wseq % 2``
+    while the consumer drains slab ``rseq % 2``; a slab is reused only
+    after the consumer's seqlock'd ``rseq`` bump acknowledges it, so
+    frame bytes are never overwritten while the peer may still read
+    them.  No locks, no fds, no syscalls on the hot path — a SIGKILL'd
+    peer leaves the ring in a consistent state and the survivor's
+    poll loop simply times out."""
+
+    def __init__(self, shm, slab_bytes: int, *, owner: bool) -> None:
+        self._shm = shm
+        self._buf = shm.buf
+        self._slab = slab_bytes
+        self._owner = owner
+        self._unlinked = False
+        self.name = shm.name
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(cls, slab_bytes: int) -> "ShmRing":
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(
+            create=True, size=_RING_HDR + 2 * slab_bytes)
+        shm.buf[:_RING_HDR] = bytes(_RING_HDR)
+        shm.buf[:4] = _RING_MAGIC
+        shm.buf[4] = WIRE_VERSION
+        return cls(shm, slab_bytes, owner=True)
+
+    @classmethod
+    def attach(cls, name: str, slab_bytes: int) -> "ShmRing":
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name=name)
+        # On < 3.13 the attach side's resource_tracker would UNLINK the
+        # segment when this process exits, yanking it from the owner —
+        # deregister it; the creating side owns cleanup.
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        if bytes(shm.buf[:4]) != _RING_MAGIC:
+            shm.close()
+            raise ConnectionError(f"shm segment {name!r} is not a ring")
+        if shm.buf[4] != WIRE_VERSION:
+            ver = shm.buf[4]
+            shm.close()
+            raise ConnectionError(
+                f"ring version mismatch: segment v{ver}, this end "
+                f"speaks v{WIRE_VERSION}")
+        return cls(shm, slab_bytes, owner=False)
+
+    # -- seqlock'd header fields ------------------------------------------
+
+    def _read_u64(self, off: int) -> int:
+        return _Q.unpack_from(self._buf, off)[0]
+
+    def _write_u64(self, off: int, value: int) -> None:
+        _Q.pack_into(self._buf, off, value)
+
+    def _locked_write(self, seq_off: int, field_writes) -> None:
+        """Writer side of the seqlock: guard odd -> fields -> guard
+        even.  Each guard has exactly one writer (pseq: producer,
+        cseq: consumer), so no CAS is needed."""
+        seq = self._read_u64(seq_off)
+        self._write_u64(seq_off, seq + 1)
+        for off, value in field_writes:
+            self._write_u64(off, value)
+        self._write_u64(seq_off, seq + 2)
+
+    def _stable_read(self, seq_off: int, field_offs) -> "list[int]":
+        """Reader side: retry until the guard is even and unchanged
+        across the field reads (a torn 8-byte read is theoretical on
+        CPython but the seqlock makes it impossible, not unlikely)."""
+        while True:
+            s0 = self._read_u64(seq_off)
+            if s0 & 1:
+                continue
+            vals = [self._read_u64(off) for off in field_offs]
+            if self._read_u64(seq_off) == s0:
+                return vals
+
+    # -- data path ---------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        try:
+            return bool(self._buf[_OFF_CLOSED])
+        except (TypeError, ValueError):
+            return True    # this side's mapping already released
+
+    def capacity(self) -> int:
+        return self._slab
+
+    def push(self, payload, timeout_s: float = 5.0) -> bool:
+        """Producer: claim the free slab, copy `payload` in, publish.
+        False when the peer closed the ring or no slab freed within
+        the timeout (caller falls back to the TCP path)."""
+        try:
+            return self._push(payload, timeout_s)
+        except (TypeError, ValueError) as e:
+            if "released" in str(e):
+                return False    # close() raced this push — ring is gone
+            raise
+
+    def _push(self, payload, timeout_s: float) -> bool:
+        n = len(payload)
+        if n > self._slab:
+            raise ValueError(
+                f"frame of {n} bytes exceeds ring slab "
+                f"({self._slab} bytes)")
+        deadline = time.monotonic() + timeout_s
+        spin = 0
+        while True:
+            if self.closed:
+                return False
+            wseq = self._stable_read(_OFF_PSEQ, (_OFF_WSEQ,))[0]
+            rseq = self._stable_read(_OFF_CSEQ, (_OFF_RSEQ,))[0]
+            if wseq - rseq < 2:
+                break
+            spin += 1
+            if spin > 64:
+                if time.monotonic() > deadline:
+                    return False
+                time.sleep(min(1e-3, 1e-5 * spin))
+        slab = wseq % 2
+        start = _RING_HDR + slab * self._slab
+        self._buf[start:start + n] = payload
+        self._locked_write(_OFF_PSEQ, (
+            (_OFF_LEN0 if slab == 0 else _OFF_LEN1, n),
+            (_OFF_WSEQ, wseq + 1),
+        ))
+        return True
+
+    def pop(self, timeout_s: float = 0.25) -> "bytes | None":
+        """Consumer: copy the oldest published slab out and ack it.
+        None on timeout; check `closed` to tell quiescence from
+        shutdown (pending slabs still drain after close)."""
+        try:
+            return self._pop(timeout_s)
+        except (TypeError, ValueError) as e:
+            if "released" in str(e):
+                return None     # close() raced this pop — ring is gone
+            raise
+
+    def _pop(self, timeout_s: float) -> "bytes | None":
+        deadline = time.monotonic() + timeout_s
+        spin = 0
+        while True:
+            rseq = self._stable_read(_OFF_CSEQ, (_OFF_RSEQ,))[0]
+            wseq, len0, len1 = self._stable_read(
+                _OFF_PSEQ, (_OFF_WSEQ, _OFF_LEN0, _OFF_LEN1))
+            if wseq > rseq:
+                break
+            if self.closed or time.monotonic() > deadline:
+                return None
+            spin += 1
+            time.sleep(0 if spin < 64 else min(1e-3, 1e-5 * spin))
+        slab = rseq % 2
+        n = len0 if slab == 0 else len1
+        start = _RING_HDR + slab * self._slab
+        payload = bytes(self._buf[start:start + n])
+        self._locked_write(_OFF_CSEQ, ((_OFF_RSEQ, rseq + 1),))
+        return payload
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Signal the peer and drop this side's mapping.  The owner
+        also unlinks the segment (idempotent)."""
+        try:
+            self._buf[_OFF_CLOSED] = 1
+        except (TypeError, ValueError):
+            pass    # mapping already released
+        try:
+            self._shm.close()
+        except Exception:
+            pass
+        if self._owner and not self._unlinked:
+            self._unlinked = True
+            # When both ends live in ONE process (in-process replicas)
+            # the attach side's tracker deregistration removed the
+            # shared cache entry; unlink() deregisters again and the
+            # tracker daemon logs a KeyError.  Re-registering first
+            # makes the owner's unlink clean in both topologies, and
+            # the once-flag keeps a double close from re-registering a
+            # segment that no longer exists.
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.register(
+                    self._shm._name, "shared_memory")
+            except Exception:
+                pass
+            try:
+                self._shm.unlink()
+            except Exception:
+                pass

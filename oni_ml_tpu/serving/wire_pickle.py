@@ -1,0 +1,56 @@
+"""Negotiated pickle fallback for the serving wire — the ONE sanctioned
+pickle endpoint.
+
+The columnar wire (serving/wire.py) is the default frame for every
+router<->replica op.  This module keeps the pre-wire pickle codec
+alive for exactly two negotiated cases:
+
+1. **Whole-frame fallback** (`encode_payload`/`decode_payload`): a peer
+   that answers the ``hello`` negotiation with ``{"wire": "pickle"}``
+   (``ServingConfig.wire_format = "pickle"``), or a pre-columnar peer
+   that rejects ``hello`` as an unknown op, downgrades the link to
+   length-prefixed pickle frames — byte-parity pinned against the
+   columnar path in tests/test_wire.py.  Scheduled for removal one
+   release after the columnar wire ships.
+2. **Opaque fields** (`encode_opaque`/`decode_opaque`): message fields
+   with no columnar encoding — today only the prebuilt ``featurizer``
+   object the day-dir loading path pushes with ``add_tenant``.  They
+   ride INSIDE a columnar frame as a tagged byte column.
+
+Everything else in serving/ and parallel/membership.py is banned from
+pickling by the ``no-pickle-wire`` graftlint rule; the suppressions
+below are that rule's sanctioned escape hatch.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+
+def encode_payload(obj) -> bytes:
+    """Pickle one whole frame payload (negotiated-fallback links)."""
+    return pickle.dumps(obj, protocol=4)  # lint: ok(no-pickle-wire, negotiated whole-frame fallback — the single sanctioned pickle encode on the wire)
+
+
+def decode_payload(buf) -> object:
+    """Decode a negotiated-fallback (or pre-columnar peer) frame.
+    Garbage — including a columnar frame truncated below its 4-byte
+    magic, which lands here by misdetection — fails as the wire's
+    uniform ConnectionError, never a codec-specific error."""
+    try:
+        return pickle.loads(bytes(buf))  # lint: ok(no-pickle-wire, negotiated whole-frame fallback decode — auto-detected by the missing columnar magic)
+    except ConnectionError:
+        raise
+    except Exception as e:
+        raise ConnectionError(
+            f"undecodable wire frame ({len(buf)} bytes): {e!r}")
+
+
+def encode_opaque(obj) -> bytes:
+    """Serialize one message field with no columnar encoding (the
+    add_tenant featurizer push)."""
+    return pickle.dumps(obj, protocol=4)  # lint: ok(no-pickle-wire, opaque-field escape hatch for the featurizer push inside a columnar frame)
+
+
+def decode_opaque(buf) -> object:
+    return pickle.loads(bytes(buf))  # lint: ok(no-pickle-wire, opaque-field escape hatch decode)

@@ -946,3 +946,84 @@ def resolve_engine(config_value: str = "auto") -> "tuple[str, str]":
     except Exception:
         pass
     return "device", "default"
+
+
+# Shipped floor for the device featurize path when nothing measured it:
+# 1 = always device, the historical behaviour.  Small segments LOSE to
+# the vectorized host parse on pure dispatch glue (the 0.91x paged
+# A/B), but the crossover is a property of the backend — so the gate
+# only engages once the featurize bench phase has MEASURED it on this
+# machine (measure_break_even -> plans.record_value), never on a
+# guessed constant.
+DEFAULT_BREAK_EVEN = 1
+
+
+def resolve_break_even(config_value: int = 0) -> "tuple[int, str]":
+    """(break_even, origin): the minimum flush-segment size at which
+    the device featurize path engages.  ONI_ML_TPU_FEATURIZE_BREAK_EVEN
+    > nonzero ServingConfig.featurize_break_even > measured plan knob >
+    shipped default.  1 means "always device" (the historical
+    behaviour); the 0 config default means "consult the plan"."""
+    env = os.environ.get("ONI_ML_TPU_FEATURIZE_BREAK_EVEN", "").strip()
+    if env:
+        try:
+            return max(1, int(env)), "env"
+        except ValueError:
+            pass
+    if config_value:
+        return max(1, int(config_value)), "config"
+    try:
+        from .. import plans
+
+        val, origin = plans.resolve("featurize_break_even", None)
+        if isinstance(val, int) and not isinstance(val, bool) and val > 0:
+            return val, origin
+    except Exception:
+        pass
+    return DEFAULT_BREAK_EVEN, "default"
+
+
+def measure_break_even(featurizer, rows, raws, model,
+                       sizes=(16, 32, 64, 128, 256, 512),
+                       repeats: int = 3) -> "tuple[int | None, list]":
+    """Time host featurize vs device featurize+gather over segment
+    sizes and return (measured break-even, per-size samples).  The
+    crossover is the smallest size where the device path wins on
+    median; None when the device path never wins (host-pinned backends)
+    or the model is unlowerable.  Callers persist the result through
+    plans.record_value("featurize_break_even", ...)."""
+    import time
+
+    # Warm the compile caches outside the timed region — the measured
+    # quantity is the steady-state per-flush cost, not the once-per-
+    # model table compile.
+    warm, _ = device_batch(featurizer, rows[:1], raws[:1], model)
+    if warm is None:
+        return None, []
+    warm.pair_rows()
+    samples = []
+    crossover = None
+    for size in sizes:
+        if size > len(raws):
+            break
+        seg_rows, seg_raws = rows[:size], raws[:size]
+        host_ts, dev_ts = [], []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            featurizer(seg_raws)
+            host_ts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            batch, _info = device_batch(
+                featurizer, seg_rows, seg_raws, model)
+            if batch is None:
+                return None, samples
+            batch.pair_rows()
+            dev_ts.append(time.perf_counter() - t0)
+        host_s = sorted(host_ts)[len(host_ts) // 2]
+        dev_s = sorted(dev_ts)[len(dev_ts) // 2]
+        samples.append({"size": size,
+                        "host_us": round(host_s * 1e6, 2),
+                        "device_us": round(dev_s * 1e6, 2)})
+        if crossover is None and dev_s < host_s:
+            crossover = size
+    return crossover, samples

@@ -324,6 +324,30 @@ def _patch_phases(bench, monkeypatch):
         },
     )
     monkeypatch.setattr(
+        bench, "bench_serving_crosshost",
+        lambda *a, **k: {
+            "fanin": {
+                "router_counts": [1, 2], "n_replicas": 1,
+                "aggregate_eps_by_routers": {"1": 358.5, "2": 682.2},
+                "router_scaling_efficiency": 0.95,
+                "fanin_exceeds_single_router": True,
+                "wire_bytes_per_event": 134.0, "errors": 0,
+                "chaos": {"survivor_errors": 0, "redriven_events": 64,
+                          "survivor_bit_identical": True},
+            },
+            "autoscale": {
+                "errors": 0, "wire_bytes_per_event": 131.0,
+                "scale_up_reaction_s": 0.4, "max_replicas_reached": 3,
+            },
+            "sustained_eps": 682.2,
+            "router_scaling_efficiency": 0.95,
+            "fanin_exceeds_single_router": True,
+            "wire_bytes_per_event": 134.0,
+            "scale_up_reaction_s": 0.4,
+            "max_replicas_reached": 3, "errors": 0,
+        },
+    )
+    monkeypatch.setattr(
         bench, "bench_streaming_freshness",
         lambda *a, **k: {
             "dsource": "flow", "tenant": "stream", "slices": 96,
@@ -515,6 +539,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "serving_slo_fleet_paged",
         "featurize_device",
         "serving_slo_replicated",
+        "serving_crosshost",
         "streaming_freshness",
         "detection_quality",
         "distributed_em",

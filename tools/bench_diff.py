@@ -221,6 +221,45 @@ def _distributed_rows(name: str, old: dict, new: dict,
     return rows
 
 
+# Cross-host serving phase: direction per key — aggregate sustained
+# events/s (at the winning router count) and the router scaling
+# efficiency are higher-better; the columnar wire's bytes-per-event is
+# overhead on every frame (lower-better — a fatter encoding IS the
+# regression the zero-copy wire exists to prevent); the autoscaler's
+# scale-up reaction is dead time between the band breach and the
+# joined replica (lower-better).  The fanin_exceeds_single_router /
+# bit_identical / zero-error bits are asserted by the test suite and
+# the bench gate, not trended here.
+_CROSSHOST_PHASE = "serving_crosshost"
+_CROSSHOST_KEYS = (
+    ("sustained_eps", "events/sec"),           # higher-better
+    ("router_scaling_efficiency", "fraction"),  # higher-better
+    ("wire_bytes_per_event", "bytes/event"),   # lower-better
+    ("scale_up_reaction_s", "s"),              # lower-better
+)
+
+
+def _crosshost_rows(name: str, old: dict, new: dict,
+                    threshold_pct: float) -> "list[dict]":
+    rows = []
+    for key, unit in _CROSSHOST_KEYS:
+        r = _rel_row(f"{name}.{key}", old.get(key), new.get(key), unit,
+                     threshold_pct)
+        if r:
+            rows.append(r)
+    old_eps = (old.get("fanin") or {}).get(
+        "aggregate_eps_by_routers") or {}
+    new_eps = (new.get("fanin") or {}).get(
+        "aggregate_eps_by_routers") or {}
+    for count in sorted(set(old_eps) & set(new_eps), key=int):
+        r = _rel_row(f"{name}.aggregate_eps[{count}r]",
+                     old_eps.get(count), new_eps.get(count),
+                     "events/sec", threshold_pct)
+        if r:
+            rows.append(r)
+    return rows
+
+
 def _serving_groups(payload: dict) -> "dict[str, dict]":
     """label -> latency-summary dict for every comparable group in a
     serving SLO payload: arrival patterns (serving_slo), the fleet
@@ -289,6 +328,8 @@ def load_payload(path: str) -> dict:
 
 def _higher_is_better(unit: str) -> bool:
     u = (unit or "").lower()
+    if u in ("bytes", "bytes/event"):   # wire overhead: lower-better
+        return False
     if "/" in u:          # docs/sec, events/sec, ...
         return True
     return u not in ("seconds", "second", "s", "ms", "milliseconds",
@@ -381,6 +422,17 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
             and "replica_scaling_efficiency" in new):
         rows.extend(_replicated_rows("headline", old, new,
                                      threshold_pct))
+    # Cross-host serving keys (fan-in eps + scaling efficiency
+    # higher-better; wire bytes/event + autoscale reaction
+    # lower-better) — phase payloads and crosshost-headline captures.
+    o, n = old_sec.get(_CROSSHOST_PHASE), new_sec.get(_CROSSHOST_PHASE)
+    if isinstance(o, dict) and isinstance(n, dict):
+        rows.extend(_crosshost_rows(f"phase:{_CROSSHOST_PHASE}", o, n,
+                                    threshold_pct))
+    if ("router_scaling_efficiency" in old
+            and "router_scaling_efficiency" in new):
+        rows.extend(_crosshost_rows("headline", old, new,
+                                    threshold_pct))
     # Device-featurization keys (events/s per engine per micro-batch
     # tier + fleet drain rates, all higher-better) — phase payloads
     # and featurize-headline captures.

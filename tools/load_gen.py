@@ -1125,6 +1125,770 @@ def run_replicated_slo(replica_counts=(1, 2, 4), *,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Cross-host serving: multi-router fan-in + Little's-law autoscaling
+# ---------------------------------------------------------------------------
+
+
+def _crosshost_census(n_tenants: int, zipf_s: float,
+                      day_events: int):
+    """The shared census, built DETERMINISTICALLY from its parameters
+    alone: every fan-in router worker is a separate process with no
+    channel to ship models over, so each rebuilds the identical day,
+    tenant mix, and per-tenant models from the same seeds — placement
+    is a pure function of membership, the census a pure function of
+    its size."""
+    from oni_ml_tpu.runner.serve import _synthetic_day
+
+    rows, base_model, cuts = _synthetic_day(
+        n_events=day_events, n_clients=64, n_doms=16, seed=100)
+    tenant_mix = fleet_mix(n_tenants, "poisson:1", 1000.0, zipf_s)
+    models = _tenant_models(base_model, n_tenants)
+    return rows, cuts, tenant_mix, models
+
+
+def _worker_drive(router, rows, cuts, models, tenant_index, cmd,
+                  timeout_s: float) -> dict:
+    """One closed-loop drive inside a router worker: feeders grouped
+    by primary replica (a full admission window on one edge must not
+    stall the others) push submit_many chunks, progress checkpoints
+    stream to stdout (the parent's router-kill reassignment reads
+    them), and the optional `verify` tenant's scores are pinned
+    bit-identical against the single-process host oracle."""
+    from oni_ml_tpu.serving import DnsEventFeaturizer, score_features
+
+    counts = {t: int(n) for t, n in cmd["counts"].items()
+              if int(n) > 0}
+    start = {t: int(v) for t, v in (cmd.get("start") or {}).items()}
+    chunk = max(1, int(cmd.get("chunk", 8)))
+    verify = cmd.get("verify")
+    placement = router.placement()
+    by_rep: dict = {}
+    for t in counts:
+        by_rep.setdefault(placement[t].primary, []).append(t)
+    futs: dict = {t: [] for t in counts}
+    sent = {t: start.get(t, 0) for t in counts}
+    plock = threading.Lock()
+    reported = [0]
+    feed_errors = [0]
+
+    def _report(force: bool = False) -> None:
+        done_n = sum(sent[t] - start.get(t, 0) for t in counts)
+        if force or done_n - reported[0] >= 256:
+            reported[0] = done_n
+            print(json.dumps({"progress": done_n,
+                              "sent": dict(sent)}), flush=True)
+
+    edges0 = {r: dict(e)
+              for r, e in router.stats()["edges"].items()}
+    t0 = time.perf_counter()
+
+    def feed(tenants):
+        try:
+            remaining = {t: counts[t] for t in tenants}
+            while any(remaining.values()):
+                for t in tenants:
+                    take = min(chunk, remaining[t])
+                    if not take:
+                        continue
+                    futs[t] += router.submit_many(t, [
+                        rows[(sent[t] + j) % len(rows)]
+                        for j in range(take)
+                    ])
+                    with plock:
+                        sent[t] += take
+                        remaining[t] -= take
+                        _report()
+        except Exception:
+            with plock:
+                feed_errors[0] += 1
+
+    feeders = [
+        threading.Thread(target=feed, args=(ts,), daemon=True,
+                         name=f"loadgen-fanin-{r}")
+        for r, ts in by_rep.items()
+    ]
+    for f in feeders:
+        f.start()
+    for f in feeders:
+        f.join(timeout=timeout_s + 60.0)
+    router.flush()
+    errors = feed_errors[0]
+    scores: dict = {}
+    for t, fs in futs.items():
+        vals = []
+        for f in fs:
+            try:
+                vals.append(f.result(timeout=timeout_s)[0])
+            except Exception:
+                errors += 1
+                vals.append(None)
+        scores[t] = vals
+    wall = time.perf_counter() - t0
+    with plock:
+        _report(force=True)
+    edges1 = router.stats()["edges"]
+    d_bytes = sum(e["bytes"] - edges0.get(r, {}).get("bytes", 0)
+                  for r, e in edges1.items())
+    d_events = sum(e["events"] - edges0.get(r, {}).get("events", 0)
+                   for r, e in edges1.items())
+    total = sum(len(v) for v in scores.values())
+    out = {
+        "router": router.router_id,
+        "events": total,
+        "wall_s": round(wall, 3),
+        "eps": round(total / wall, 1) if wall else None,
+        "errors": errors,
+        "wire_bytes": d_bytes,
+        "wire_events": d_events,
+        "wire_bytes_per_event": (round(d_bytes / d_events, 1)
+                                 if d_events else None),
+    }
+    if verify and scores.get(verify):
+        got = scores[verify]
+        off = start.get(verify, 0)
+        used = [rows[(off + j) % len(rows)] for j in range(len(got))]
+        feats = DnsEventFeaturizer(cuts)(used)
+        oracle = score_features(models[tenant_index[verify]], feats,
+                                "dns")
+        out["verify_tenant"] = verify
+        out["bit_identical"] = (
+            all(s is not None for s in got)
+            and bool(np.array_equal(np.asarray(got, np.float64),
+                                    oracle))
+        )
+    return out
+
+
+def _router_worker_main(config_json: str) -> int:
+    """Subprocess entry for one fan-in router (`--router-worker`,
+    spawned by run_router_fanin): its own Python, its own GIL — the
+    per-router submit-loop ceiling is real, so aggregate events/s can
+    exceed what one router process sustains.  Rebuilds the census
+    deterministically (_crosshost_census), discovers replicas through
+    the shared KV roster, then serves line-delimited JSON commands on
+    stdin: drive / stats / exit."""
+    from oni_ml_tpu.config import ServingConfig
+    from oni_ml_tpu.parallel.membership import FileKVClient
+    from oni_ml_tpu.serving import FleetRouter, TenantSpec
+
+    cfg_in = json.loads(config_json)
+    timeout_s = float(cfg_in.get("timeout_s", 300.0))
+    rows, cuts, tenant_mix, models = _crosshost_census(
+        int(cfg_in["n_tenants"]), float(cfg_in["zipf_s"]),
+        int(cfg_in.get("day_events", 256)))
+    tenant_index = {tm["tenant"]: i
+                    for i, tm in enumerate(tenant_mix)}
+    cfg = ServingConfig(
+        fleet_max_batch=int(cfg_in["max_batch"]),
+        fleet_max_wait_ms=float(cfg_in["max_wait_ms"]),
+        route_max_inflight=int(cfg_in["route_window"]),
+        device_score_min=cfg_in.get("device_score_min", 0),
+    )
+    router = FleetRouter(cfg, kv=FileKVClient(cfg_in["kv_dir"]),
+                         router_id=cfg_in["router_id"])
+    expect = set(cfg_in.get("expect") or [])
+    deadline = time.monotonic() + timeout_s
+    connected = router.connect_from_membership()
+    while expect - set(connected) and time.monotonic() < deadline:
+        time.sleep(0.1)
+        connected = router.connect_from_membership()
+    missing = sorted(expect - set(connected))
+    if missing:
+        print(json.dumps({"error": f"missing replicas {missing}"}),
+              flush=True)
+        router.close()
+        return 3
+    for i, tm in enumerate(tenant_mix):
+        router.add_tenant(
+            TenantSpec(tenant=tm["tenant"], dsource="dns",
+                       weight=tm["weight"]),
+            cuts, models[i],
+        )
+    router.start(warmup=bool(cfg_in.get("warmup", True)))
+    print(json.dumps({"ready": True, "router": router.router_id,
+                      "replicas": connected}), flush=True)
+    try:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            cmd = json.loads(line)
+            op = cmd.get("cmd")
+            if op == "drive":
+                res = _worker_drive(router, rows, cuts, models,
+                                    tenant_index, cmd, timeout_s)
+                print(json.dumps({"result": res}), flush=True)
+            elif op == "stats":
+                print(json.dumps({"stats": router.stats()}),
+                      flush=True)
+            elif op == "exit":
+                break
+    finally:
+        router.close()
+    return 0
+
+
+class _RouterWorker:
+    """Parent-side handle on one `--router-worker` subprocess:
+    line-delimited JSON over stdin/stdout, a reader thread folding
+    progress checkpoints into `self.progress` (what the router-kill
+    reassignment reads off a freshly-dead victim) and queuing
+    results."""
+
+    def __init__(self, worker_cfg: dict) -> None:
+        import subprocess
+
+        self.router_id = worker_cfg["router_id"]
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--router-worker", json.dumps(worker_cfg)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, bufsize=1,
+        )
+        self.ready = threading.Event()
+        self.ready_info: dict = {}
+        self.progress: dict = {"progress": 0, "sent": {}}
+        self._results: list = []
+        self._cond = threading.Condition()
+        threading.Thread(
+            target=self._read, daemon=True,
+            name=f"loadgen-worker-{self.router_id}").start()
+
+    def _read(self) -> None:
+        for line in self.proc.stdout:
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if "ready" in msg:
+                with self._cond:
+                    self.ready_info = msg
+                self.ready.set()
+            elif "progress" in msg:
+                with self._cond:
+                    self.progress = msg
+            else:
+                with self._cond:
+                    self._results.append(msg)
+                    self._cond.notify_all()
+        self.ready.set()    # EOF unblocks a waiter on a dead worker
+
+    def wait_ready(self, timeout_s: float) -> dict:
+        if not self.ready.wait(timeout_s) or not self.ready_info:
+            raise RuntimeError(
+                f"router worker {self.router_id} never came up")
+        return self.ready_info
+
+    def send(self, obj: dict) -> None:
+        self.proc.stdin.write(json.dumps(obj) + "\n")
+        self.proc.stdin.flush()
+
+    def drive(self, counts: dict, *, start=None, verify=None,
+              chunk: int = 8) -> None:
+        self.send({"cmd": "drive", "counts": counts,
+                   "start": start or {}, "chunk": chunk,
+                   "verify": verify})
+
+    def result(self, timeout_s: float) -> dict:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while not self._results:
+                left = deadline - time.monotonic()
+                if left <= 0 or self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"router worker {self.router_id} gave no "
+                        "result")
+                self._cond.wait(min(left, 0.1))
+            msg = self._results.pop(0)
+        if "result" not in msg:
+            raise RuntimeError(
+                f"router worker {self.router_id}: {msg}")
+        return msg["result"]
+
+    def kill(self) -> None:
+        self.proc.kill()
+
+    def close(self) -> None:
+        try:
+            self.send({"cmd": "exit"})
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=30.0)
+        except Exception:
+            self.proc.kill()
+
+
+def _fanin_leg(n_routers: int, worker_cfg: dict, tenant_mix,
+               events_total: int, *, chunk: int,
+               timeout_s: float) -> dict:
+    """Aggregate throughput at one router count: the census split
+    round-robin across N router processes, each driving its slice
+    closed-loop against the SAME replica fleet (zero router
+    coordination — placement is a pure function of the shared
+    roster)."""
+    tenants = [tm["tenant"] for tm in tenant_mix]
+    weight = {tm["tenant"]: tm["weight"] for tm in tenant_mix}
+    counts = _zipf_counts(tenants, [weight[t] for t in tenants],
+                          events_total)
+    workers = [
+        _RouterWorker({**worker_cfg,
+                       "router_id": f"fanin{n_routers}-{i}",
+                       "warmup": i == 0})
+        for i in range(n_routers)
+    ]
+    try:
+        for w in workers:
+            w.wait_ready(timeout_s)
+        # Greedy weight-balanced slices: every router gets an equal
+        # share of the OFFERED load, not just of the tenant count —
+        # under skew a head-tenant slice would otherwise spend its
+        # tail draining one admission window while the others idle.
+        order = sorted(tenants, key=lambda t: -weight[t])
+        slices: "list[list[str]]" = [[] for _ in range(n_routers)]
+        loads = [0.0] * n_routers
+        for t in order:
+            i = min(range(n_routers), key=loads.__getitem__)
+            slices[i].append(t)
+            loads[i] += weight[t]
+        t0 = time.perf_counter()
+        for w, sl in zip(workers, slices):
+            w.drive({t: counts[t] for t in sl}, verify=sl[0],
+                    chunk=chunk)
+        results = [w.result(timeout_s + 120.0) for w in workers]
+        parent_wall = time.perf_counter() - t0
+        # The serving window is each worker's submit->resolved wall;
+        # the parent's wall additionally serializes result retrieval
+        # and the in-worker oracle verify, which is measurement
+        # overhead, not routing.
+        wall = max(r["wall_s"] for r in results)
+        total = sum(r["events"] for r in results)
+        wb = sum(r["wire_bytes"] for r in results)
+        we = sum(r["wire_events"] for r in results)
+        return {
+            "routers": n_routers,
+            "events": total,
+            "wall_s": round(wall, 3),
+            "parent_wall_s": round(parent_wall, 3),
+            "aggregate_eps": round(total / wall, 1) if wall else None,
+            "per_router_eps": {r["router"]: r["eps"]
+                               for r in results},
+            "errors": sum(r["errors"] for r in results),
+            "bit_identical": all(r.get("bit_identical")
+                                 for r in results),
+            "wire_bytes_per_event": (round(wb / we, 1)
+                                     if we else None),
+        }
+    finally:
+        for w in workers:
+            w.close()
+
+
+def _router_chaos_leg(worker_cfg: dict, tenant_mix,
+                      chaos_events: int, *, kill_frac: float,
+                      chunk: int, timeout_s: float) -> dict:
+    """Router-kill chaos at 2 routers: SIGKILL one router process
+    mid-census and have the survivor ABSORB the victim's remaining
+    slice from its last progress checkpoint — replicas never notice
+    (no replica died, no failover), the survivor resolves every one
+    of its own futures, and the absorbed slice stays bit-identical to
+    the host oracle.  Events between the victim's last checkpoint and
+    the kill are re-driven (scoring is pure, duplicates are
+    harmless); the count is reported, never hidden."""
+    tenants = [tm["tenant"] for tm in tenant_mix]
+    weight = {tm["tenant"]: tm["weight"] for tm in tenant_mix}
+    counts = _zipf_counts(tenants, [weight[t] for t in tenants],
+                          chaos_events)
+    survivor = _RouterWorker({**worker_cfg, "router_id": "chaos-a",
+                              "warmup": True})
+    victim = _RouterWorker({**worker_cfg, "router_id": "chaos-b",
+                            "warmup": False})
+    try:
+        survivor.wait_ready(timeout_s)
+        victim.wait_ready(timeout_s)
+        sl_a = tenants[0::2]
+        sl_b = tenants[1::2]
+        counts_b = {t: counts[t] for t in sl_b}
+        total_b = sum(counts_b.values())
+        survivor.drive({t: counts[t] for t in sl_a}, verify=sl_a[0],
+                       chunk=chunk)
+        victim.drive(counts_b, chunk=chunk)
+        kill_at = int(total_b * kill_frac)
+        deadline = time.monotonic() + timeout_s
+        while victim.progress["progress"] < kill_at:
+            if victim.proc.poll() is not None:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "victim router never reached the kill point")
+            time.sleep(0.002)
+        victim.kill()   # SIGKILL, the real thing
+        t_kill = time.perf_counter()
+        sent_b = dict(victim.progress.get("sent") or {})
+        remaining = {t: counts_b[t] - int(sent_b.get(t, 0))
+                     for t in counts_b}
+        remaining = {t: n for t, n in remaining.items() if n > 0}
+        redriven = sum(remaining.values())
+        absorb = None
+        if remaining:
+            verify_t = max(remaining, key=remaining.get)
+            survivor.drive(
+                remaining,
+                start={t: int(sent_b.get(t, 0)) for t in remaining},
+                verify=verify_t, chunk=chunk)
+        res_a = survivor.result(timeout_s + 120.0)
+        if remaining:
+            absorb = survivor.result(timeout_s + 120.0)
+        t_done = time.perf_counter()
+        return {
+            "routers": 2,
+            "killed": victim.router_id,
+            "events": chaos_events,
+            "victim_checkpointed_events": int(
+                victim.progress.get("progress", 0)),
+            "redriven_events": redriven,
+            "survivor_errors": (res_a["errors"]
+                                + (absorb["errors"] if absorb else 0)),
+            "survivor_bit_identical": (
+                bool(res_a.get("bit_identical"))
+                and (absorb is None
+                     or bool(absorb.get("bit_identical")))),
+            "time_to_absorb_s": round(t_done - t_kill, 3),
+        }
+    finally:
+        survivor.close()
+        victim.close()
+
+
+def run_router_fanin(router_counts=(1, 2), *, n_replicas: int = 1,
+                     n_tenants: int = 8, zipf_s: float = 0.0,
+                     events_total: int = 2048, chunk: int = 8,
+                     max_batch: int = 256, max_wait_ms: float = 40.0,
+                     route_window: int = 16, chaos: bool = True,
+                     chaos_events: int = 1024,
+                     kill_frac: float = 0.4, day_events: int = 256,
+                     device_score_min=None,
+                     timeout_s: float = 300.0) -> dict:
+    """Multi-router fan-in over one replica fleet: the same census
+    driven by 1 then N router PROCESSES, aggregate events/s compared
+    across counts, plus the router-kill chaos leg.  The single-router
+    ceiling being beaten is the ADMISSION ceiling, so the defaults pin
+    it deliberately: each router bounds its own per-edge outstanding
+    events (route_window), the replica micro-batch wait puts a
+    latency floor under the round trip, and Little's law caps one
+    router at window/RTT per edge with the host mostly idle — a
+    second router process brings its own windows, and the aggregate
+    doubles without any router-to-router coordination.  The default
+    fleet is a SINGLE replica: this leg isolates the ROUTER plane,
+    and with one scorer both routers' events coalesce into the same
+    micro-batches, so the extra admission windows turn into larger
+    flushes rather than contending scorer threads (replica-plane
+    scaling is the replicated bench's measurement).  Replicas are
+    host-pinned by default (device_score_min=None) for the same
+    reason — on a small host the shared device-dispatch cost would
+    otherwise cap both legs at the same compute ceiling.  The
+    replica fleet is spawned once and shared across legs (tenant
+    re-pushes are version-idempotent)."""
+    from oni_ml_tpu.runner.route import _spawn_replica
+
+    workdir = tempfile.mkdtemp(prefix="oni_fanin_")
+    kv_dir = os.path.join(workdir, "kv")
+    _, _, tenant_mix, _ = _crosshost_census(n_tenants, zipf_s,
+                                            day_events)
+    procs: dict = {}
+    extra = ["--fleet-max-batch", str(max_batch),
+             "--fleet-max-wait-ms", str(max_wait_ms)]
+    if device_score_min is None:
+        extra += ["--device-score-min", "none"]
+    try:
+        for i in range(n_replicas):
+            rid = f"r{i}"
+            proc, _, _ = _spawn_replica(rid, kv_dir, workdir, extra)
+            procs[rid] = proc
+        worker_cfg = {
+            "kv_dir": kv_dir, "n_tenants": n_tenants,
+            "zipf_s": zipf_s, "day_events": day_events,
+            "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+            "route_window": route_window,
+            "device_score_min": device_score_min,
+            "expect": sorted(procs), "timeout_s": timeout_s,
+        }
+        legs: dict = {}
+        for n in router_counts:
+            legs[str(n)] = _fanin_leg(
+                n, worker_cfg, tenant_mix, events_total,
+                chunk=chunk, timeout_s=timeout_s)
+        eps = {int(k): v["aggregate_eps"] for k, v in legs.items()}
+        ns = sorted(eps)
+        base = eps.get(ns[0])
+        efficiency = {
+            str(n): (round(eps[n] / (n / ns[0] * base), 4)
+                     if base and eps.get(n) else None)
+            for n in ns
+        }
+        out = {
+            "n_replicas": n_replicas,
+            "n_tenants": n_tenants,
+            "router_counts": ns,
+            "fanin": legs,
+            "aggregate_eps_by_routers": {str(n): eps[n] for n in ns},
+            "router_scaling_efficiency": (
+                efficiency.get(str(ns[-1])) if len(ns) > 1 else None),
+            "router_scaling_efficiency_by_count": efficiency,
+            "fanin_exceeds_single_router": (
+                (eps[ns[-1]] or 0) > (eps[ns[0]] or 0)
+                if len(ns) > 1 else None),
+            "errors": sum(v["errors"] for v in legs.values()),
+            "bit_identical": all(v["bit_identical"]
+                                 for v in legs.values()),
+            "wire_bytes_per_event": (
+                legs[str(ns[-1])]["wire_bytes_per_event"]),
+        }
+        if chaos:
+            out["chaos"] = _router_chaos_leg(
+                worker_cfg, tenant_mix, chaos_events,
+                kill_frac=kill_frac, chunk=chunk,
+                timeout_s=timeout_s)
+        return out
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=30.0)
+            except Exception:
+                proc.kill()
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_autoscale_sweep(steps=((500.0, 2.0), (5000.0, 6.0),
+                               (400.0, 6.0)), *,
+                        n_tenants: int = 16, zipf_s: float = 1.1,
+                        route_window: int = 32, max_batch: int = 256,
+                        max_wait_ms: float = 20.0,
+                        day_events: int = 256, device_score_min=0,
+                        interval_s: float = 0.2,
+                        halflife_s: float = 1.0,
+                        cooldown_s: float = 2.0,
+                        max_replicas: int = 4,
+                        sample_every: int = 16, seed: int = 0,
+                        timeout_s: float = 300.0) -> dict:
+    """Offered load swept through the AutoScaler: open-loop Poisson
+    steps (rate, duration) against a fleet that starts at ONE replica
+    and is sized by the controller alone.  Per step: sampled p99,
+    achieved events/s, and the replica count the controller chose;
+    overall: the full decision ledger, the up-reaction time (band
+    breach -> replica joined), and wire bytes/event off the router's
+    edge counters.  When a window fills, submit blocks — the backlog
+    IS the occupancy signal the controller steers on."""
+    import queue as queue_mod
+
+    from oni_ml_tpu.config import ServingConfig
+    from oni_ml_tpu.serving import (
+        AutoScaler,
+        FleetRouter,
+        ReplicaServer,
+        TenantSpec,
+    )
+
+    rows, cuts, tenant_mix, models = _crosshost_census(
+        n_tenants, zipf_s, day_events)
+    tenants = [tm["tenant"] for tm in tenant_mix]
+    cfg = ServingConfig(
+        fleet_max_batch=max_batch, fleet_max_wait_ms=max_wait_ms,
+        route_max_inflight=route_window,
+        device_score_min=device_score_min,
+        autoscale_interval_s=interval_s,
+        autoscale_halflife_s=halflife_s,
+        autoscale_cooldown_s=cooldown_s,
+        autoscale_max_replicas=max_replicas,
+    )
+    journal: list = []
+    servers: dict = {}
+    spawned = [0]
+
+    def _spawn():
+        rid = f"as{spawned[0]}"
+        spawned[0] += 1
+        srv = ReplicaServer(rid, cfg)
+        servers[rid] = srv
+        return rid, srv.host, srv.port
+
+    def _stop(rid):
+        srv = servers.pop(rid, None)
+        if srv is not None:
+            srv.stop()
+
+    router = FleetRouter(cfg, journal=journal)
+    rid0, host0, port0 = _spawn()
+    router.connect_replica(rid0, host0, port0)
+    for i, tm in enumerate(tenant_mix):
+        router.add_tenant(
+            TenantSpec(tenant=tm["tenant"], dsource="dns",
+                       weight=tm["weight"]),
+            cuts, models[i],
+        )
+    router.start(warmup=True)
+    scaler = AutoScaler(router, spawn=_spawn, stop=_stop,
+                        config=cfg, journal=journal)
+    scaler.start()
+    try:
+        step_out = []
+        for si, (rate, dur) in enumerate(steps):
+            n = int(rate * dur)
+            offs = arrival_offsets("poisson", n, rate,
+                                   seed=seed + si)
+            lat: list = []
+            errs = [0]
+            q: "queue_mod.Queue" = queue_mod.Queue()
+
+            def collect(q=q, lat=lat, errs=errs):
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    fut, t_sub = item
+                    try:
+                        fut.result(timeout=timeout_s)
+                        lat.append(
+                            (time.perf_counter() - t_sub) * 1e3)
+                    except Exception:
+                        errs[0] += 1
+
+            col = threading.Thread(target=collect, daemon=True,
+                                   name=f"loadgen-as-{si}")
+            col.start()
+            futs = []
+            t0 = time.perf_counter()
+            for j in range(n):
+                target = t0 + float(offs[j])
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                t_sub = time.perf_counter()
+                fut = router.submit(tenants[j % len(tenants)],
+                                    rows[j % len(rows)])
+                futs.append(fut)
+                if j % sample_every == 0:
+                    q.put((fut, t_sub))
+            router.flush()
+            q.put(None)
+            col.join(timeout=timeout_s + 60.0)
+            step_errors = errs[0]
+            # Drain the step entirely (every future, not just the
+            # samples): "zero failed futures" is a gate, and the
+            # inter-step drain is what lets a scale-down show up in
+            # the NEXT low step instead of mid-backlog.
+            for f in futs:
+                try:
+                    f.result(timeout=timeout_s)
+                except Exception:
+                    step_errors += 1
+            wall = time.perf_counter() - t0
+            arr = np.sort(np.asarray(lat)) if lat else None
+            step_out.append({
+                "offered_eps": rate,
+                "duration_s": dur,
+                "events": n,
+                "achieved_eps": round(n / wall, 1) if wall else None,
+                "p50_ms": (round(float(
+                    arr[int(0.50 * (len(arr) - 1))]), 3)
+                    if arr is not None else None),
+                "p99_ms": (round(float(
+                    arr[int(0.99 * (len(arr) - 1))]), 3)
+                    if arr is not None else None),
+                "errors": step_errors,
+                "replicas_after": len(router.stats()["replicas"]),
+            })
+        decisions = list(scaler.decisions)
+        actions = [d for d in decisions
+                   if d["action"] in ("up", "down")]
+        ups = [d for d in actions if d["action"] == "up"]
+        edges = router.stats()["edges"]
+        tb = sum(e["bytes"] for e in edges.values())
+        te = sum(e["events"] for e in edges.values())
+        return {
+            "steps": step_out,
+            "replica_counts": [s["replicas_after"]
+                               for s in step_out],
+            "max_replicas_reached": max(
+                (s["replicas_after"] for s in step_out), default=1),
+            "ledger": decisions,
+            "actions": actions,
+            "scaled_up": len(ups),
+            "scaled_down": sum(1 for d in actions
+                               if d["action"] == "down"),
+            "scale_up_reaction_s": (
+                round(min(d.get("reaction_s", 0.0) for d in ups), 3)
+                if ups else None),
+            "wire_bytes_per_event": (round(tb / te, 1)
+                                     if te else None),
+            "errors": sum(s["errors"] for s in step_out),
+        }
+    finally:
+        scaler.close()
+        router.close()
+        for srv in list(servers.values()):
+            srv.stop()
+
+
+def run_crosshost_slo(router_counts=(1, 2), *, n_replicas: int = 1,
+                      n_tenants: int = 8, zipf_s: float = 1.1,
+                      events_total: int = 2048, chunk: int = 8,
+                      max_batch: int = 256, max_wait_ms: float = 40.0,
+                      route_window: int = 16, chaos: bool = True,
+                      chaos_events: int = 1024,
+                      autoscale_steps=((500.0, 2.0), (5000.0, 6.0),
+                                       (400.0, 6.0)),
+                      day_events: int = 256, device_score_min=None,
+                      seed: int = 0,
+                      timeout_s: float = 300.0) -> dict:
+    """The serving_crosshost measurement: router fan-in + router-kill
+    chaos (run_router_fanin) and the Little's-law autoscale sweep
+    (run_autoscale_sweep), with the bench_diff headline keys hoisted
+    to the top level.  The fan-in knobs here feed the fan-in leg
+    only; the autoscale sweep keeps its own control-law-tuned
+    defaults (tighter wait, wider window, device scoring on) because
+    it measures the REPLICA plane, not the admission plane."""
+    fanin = run_router_fanin(
+        router_counts, n_replicas=n_replicas, n_tenants=n_tenants,
+        events_total=events_total, chunk=chunk,
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        route_window=route_window, chaos=chaos,
+        chaos_events=chaos_events, day_events=day_events,
+        device_score_min=device_score_min, timeout_s=timeout_s)
+    autoscale = run_autoscale_sweep(
+        autoscale_steps, n_tenants=max(8, n_tenants),
+        zipf_s=zipf_s, max_batch=max_batch,
+        day_events=day_events, seed=seed,
+        timeout_s=timeout_s)
+    eps_by = fanin["aggregate_eps_by_routers"]
+    errors = fanin["errors"] + autoscale["errors"]
+    chaos_out = fanin.get("chaos")
+    if chaos_out:
+        errors += chaos_out["survivor_errors"]
+    return {
+        "fanin": fanin,
+        "autoscale": autoscale,
+        "sustained_eps": max(
+            (v for v in eps_by.values() if v), default=None),
+        "router_scaling_efficiency": (
+            fanin["router_scaling_efficiency"]),
+        "fanin_exceeds_single_router": (
+            fanin["fanin_exceeds_single_router"]),
+        "wire_bytes_per_event": (
+            fanin["wire_bytes_per_event"]
+            or autoscale["wire_bytes_per_event"]),
+        "scale_up_reaction_s": autoscale["scale_up_reaction_s"],
+        "max_replicas_reached": autoscale["max_replicas_reached"],
+        "errors": errors,
+    }
+
+
 def _stack(n_events: int, *, max_batch: int, max_wait_ms: float,
            device_score_min):
     """Synthetic day + the real serving stack over it (the dry-run
@@ -1291,6 +2055,14 @@ def main(argv=None) -> int:
                     metavar="N",
                     help="replicated mode: bounded per-replica "
                     "admission window (route_max_inflight)")
+    ap.add_argument("--routers", default="", metavar="N,N,...",
+                    help="multi-router fan-in mode: the same census "
+                    "driven by each router-process count against one "
+                    "shared replica fleet (zero router coordination), "
+                    "plus the router-kill chaos leg — aggregate "
+                    "events/s by count (run_router_fanin)")
+    ap.add_argument("--router-worker", default="",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--dsource", default="dns",
                     help="with --emit-lines: which registered source's "
@@ -1302,6 +2074,28 @@ def main(argv=None) -> int:
                     "running the in-process harness (pipe into "
                     "`ml_ops serve`); requires a single --pattern")
     args = ap.parse_args(argv)
+    if args.router_worker:
+        # Subprocess half of run_router_fanin: stdout is the JSON
+        # command protocol, nothing else may print there.
+        return _router_worker_main(args.router_worker)
+    if args.routers:
+        counts = tuple(
+            int(c) for c in args.routers.split(",") if c.strip()
+        )
+        # The fan-in leg's admission-plane defaults (window 16, wait
+        # 40ms, host-pinned single replica) are tuned; only forward a
+        # knob the user actually moved off the generic CLI default.
+        kw: dict = {}
+        if args.route_window != 64:
+            kw["route_window"] = args.route_window
+        if args.max_wait_ms != 10.0:
+            kw["max_wait_ms"] = args.max_wait_ms
+        res = run_router_fanin(
+            counts, n_tenants=args.tenants or 8,
+            zipf_s=args.zipf, max_batch=args.max_batch, **kw,
+        )
+        print(json.dumps(res), flush=True)
+        return 0
     if args.emit_lines:
         if args.pattern == "both":
             print("load_gen: --emit-lines needs a single --pattern",

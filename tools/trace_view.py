@@ -315,14 +315,61 @@ def journal_to_trace(records: "list[dict]") -> dict:
             # Per-edge fan-out counter lane: forwarded events/bytes and
             # the router's in-flight depth against the bounded
             # admission window — the replicated fleet's dataplane
-            # edges next to the channel-depth lanes.
+            # edges next to the channel-depth lanes.  Under multi-
+            # router fan-in the records carry the originating router
+            # id, so each router gets its OWN lane per edge and the
+            # fan-in is visible as parallel tracks.
             edge = rec.get("edge", "?")
+            router = rec.get("router")
+            lane = (f"route {router}->{edge}" if router
+                    else f"route {edge}")
             events.append({
-                "name": f"route {edge}", "ph": "C",
+                "name": lane, "ph": "C",
                 "ts": us(ns), "pid": pid, "tid": 0,
                 "args": {"events": rec.get("events", 0),
                          "inflight": rec.get("inflight", 0)},
             })
+        elif kind == "wire":
+            # Transport negotiation instant: which codec the edge
+            # settled on (columnar vs pickle fallback) and whether the
+            # same-host shm ring upgrade engaged.
+            events.append({
+                "name": (f"wire {rec.get('edge', '?')}: "
+                         f"{rec.get('format', '?')}"
+                         + (" +shm" if rec.get("shm") else "")),
+                "ph": "i", "s": "t",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("router", "format", "shm") if k in rec},
+            })
+        elif kind == "autoscale":
+            # Controller lane: the measured occupancy fraction and its
+            # EWMA as counters (the control signal plotted against the
+            # hysteresis band) plus an instant per join/drain decision
+            # carrying the full reasoning and reaction_s.
+            events.append({
+                "name": "autoscale util", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"util": rec.get("util", 0.0),
+                         "util_ewma": rec.get("util_ewma", 0.0)},
+            })
+            events.append({
+                "name": "autoscale replicas", "ph": "C",
+                "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"replicas": rec.get("replicas", 0)},
+            })
+            action = rec.get("action")
+            if action in ("up", "down", "error"):
+                events.append({
+                    "name": f"AUTOSCALE {action}: "
+                            f"{rec.get('replica', rec.get('error', ''))}",
+                    "ph": "i", "s": "g" if action == "error" else "t",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {k: rec[k] for k in
+                             ("reason", "util", "util_ewma",
+                              "lambda_eps", "stall_rate",
+                              "reaction_s") if k in rec},
+                })
         elif kind == "membership":
             events.append({
                 "name": (f"fleet {rec.get('event', '?')}: "
