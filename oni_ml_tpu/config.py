@@ -422,10 +422,20 @@ class ServingConfig:
     # -- replicated elastic serving (serving/router.py / replica.py) --
     # Frame codec for the router<->replica wire (serving/wire.py):
     # "columnar" (default — typed arrays as zero-copy buffers) or
-    # "pickle", the negotiated one-release fallback.  Receivers always
-    # auto-detect by magic; this knob sets what THIS side sends and
-    # what the hello negotiation answers.
+    # "pickle", the negotiated one-release fallback.  This knob sets
+    # what THIS side sends and what the hello negotiation answers;
+    # what a receiver will DECODE is gated per link — a non-columnar
+    # frame only unpickles on a link whose negotiation settled on the
+    # fallback, and then through wire_pickle's allowlisted unpickler.
     wire_format: str = "columnar"
+    # Accept the negotiated pickle fallback from PEERS?  Off
+    # (default): a hello offering only "pickle" is refused and
+    # non-columnar frames fail as ConnectionError — a cross-host
+    # fleet keeps zero pickle decode surface on its ports.  On: a
+    # peer may negotiate the one-release fallback (same trust
+    # domain).  Forcing wire_format="pickle" implies acceptance on
+    # that side — the operator chose the fallback fleet-wide.
+    wire_accept_pickle: bool = False
     # Same-host shm upgrade: when both ends opt in and the hello
     # handshake proves the peer shares this host, data frames move to
     # a wire.ShmRing pair and the TCP data socket degrades to a

@@ -442,7 +442,16 @@ class MembershipClient:
         the KV's ALREADY_EXISTS is the election: True means this router
         owns the backfill, False means a peer already claimed it (the
         loser still promotes locally, placement being a pure function
-        of membership, and just skips the pushes)."""
+        of membership, and just skips the pushes).
+
+        Only a genuine ALREADY_EXISTS loses the election.  A transport
+        error (KV server unreachable or timing out — likely in exactly
+        the degraded scenario failover exists for) claims by DEFAULT:
+        if every router treated it as a loss, none would push the
+        promoted tenants' models and the new primaries would serve
+        nothing.  Duplicate pushes are safe (replica add_tenant is
+        router_version-idempotent); zero pushes are silent data-path
+        loss."""
         try:
             self._kv.key_value_set(
                 f"{self._ns}/promote/{replica_id}",
@@ -451,8 +460,10 @@ class MembershipClient:
                 allow_overwrite=False,
             )
             return True
-        except Exception:
-            return False
+        except Exception as e:
+            if "ALREADY_EXISTS" in str(e):
+                return False
+            return True
 
     def clear_promotion(self, replica_id: str) -> None:
         """Forget a settled claim so a future respawn under the same id

@@ -273,15 +273,22 @@ class ReplicaServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
-        # Responses mirror the codec of the last request on this
-        # connection: a negotiated-fallback (pickle) peer is answered
-        # in pickle, a columnar peer in columnar, with no per-link
-        # negotiation state to carry between the data and ctrl conns.
-        codec = ["pickle" if self.config.wire_format == "pickle"
-                 else "columnar"]
+        # Per-connection wire state.  `accept` is what a NON-columnar
+        # frame may decode as: "pickle" only when this side's config
+        # forces the fallback or the hello negotiation settled on it —
+        # never because a frame merely failed the magic check.
+        # `reply` mirrors the codec of the last request, so a
+        # negotiated-fallback peer is answered in the codec it can
+        # actually read.  `rings` are the shm pair (if any) this
+        # connection's hello negotiated — their lifecycle is tied to
+        # the connection, torn down in the finally below.
+        initial = ("pickle" if self.config.wire_format == "pickle"
+                   else "columnar")
+        state = {"accept": initial, "reply": initial, "rings": []}
 
         def reply(obj) -> int:
-            return wire.send_frame(conn, obj, wlock, codec=codec[0])
+            return wire.send_frame(conn, obj, wlock,
+                                   codec=state["reply"])
 
         resolver = _Resolver(reply)
         with self._lock:
@@ -289,7 +296,8 @@ class ReplicaServer:
         try:
             while True:
                 try:
-                    req, codec[0] = wire.recv_frame_tagged(conn)
+                    req, state["reply"] = wire.recv_frame_tagged(
+                        conn, codec=state["accept"])
                 except (ConnectionError, OSError):
                     return
                 op = req.get("op")
@@ -322,7 +330,7 @@ class ReplicaServer:
                             return
                     continue
                 try:
-                    rsp = {"id": rid, **self._handle(op, req)}
+                    rsp = {"id": rid, **self._handle(op, req, state)}
                 except Exception as e:
                     rsp = {"id": rid, "error": repr(e)[:300]}
                 try:
@@ -334,6 +342,12 @@ class ReplicaServer:
                     return
         finally:
             resolver.stop()
+            # Ring lifecycle = connection lifecycle: a SIGKILL'd or
+            # reconnecting router EOFs this socket, and the rings its
+            # hello negotiated close (and unlink) here instead of
+            # accumulating shm segments + polling threads until full
+            # replica shutdown.
+            self._drop_rings(state["rings"])
             try:
                 conn.close()
             except OSError:
@@ -341,11 +355,12 @@ class ReplicaServer:
 
     # -- op handlers ---------------------------------------------------------
 
-    def _handle(self, op: str, req: dict) -> dict:
+    def _handle(self, op: str, req: dict,
+                state: "dict | None" = None) -> dict:
         if op == "ping":
             return {"ok": True, "replica": self.replica_id}
         if op == "hello":
-            return self._op_hello(req)
+            return self._op_hello(req, state)
         if op == "add_tenant":
             return self._op_add_tenant(req)
         if op == "publish":
@@ -372,30 +387,52 @@ class ReplicaServer:
             return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
 
-    def _op_hello(self, req: dict) -> dict:
+    def _op_hello(self, req: dict,
+                  state: "dict | None" = None) -> dict:
         """Wire negotiation: pick the frame codec for this link from
         the peer's offer (our own ``wire_format`` config can force the
         one-release pickle fallback), and for a same-host peer that
         asked, stand up a shared-memory ring pair so data frames skip
         the TCP stack entirely.  The response names the rings; the
         caller attaches and the TCP data socket degrades to a
-        liveness/EOF signal + oversize-frame escape."""
+        liveness/EOF signal + oversize-frame escape.
+
+        Acceptance gate: settling on "pickle" arms the unpickler for
+        this connection's future frames, so a peer only gets it when
+        this replica actually accepts the fallback
+        (``wire_accept_pickle``, or our own ``wire_format`` already
+        forces it).  Otherwise a pickle-only offer is an error, not a
+        silent downgrade."""
         offered = req.get("wire") or ["pickle"]
         chosen = ("pickle"
                   if (self.config.wire_format == "pickle"
                       or "columnar" not in offered)
                   else "columnar")
+        if chosen == "pickle" and not (
+                self.config.wire_accept_pickle
+                or self.config.wire_format == "pickle"):
+            raise ValueError(
+                "peer offered only the pickle fallback, which this "
+                "replica refuses (wire_accept_pickle=False)")
+        if state is not None:
+            state["accept"] = chosen
         shm = None
         if (chosen == "columnar" and req.get("shm")
                 and self.config.wire_shm
                 and req.get("host") == socket.gethostname()):
             try:
-                shm = self._make_rings()
+                shm = self._make_rings(state)
             except Exception:
                 shm = None    # ring setup must never break the link
         return {"ok": True, "wire": chosen, "shm": shm}
 
-    def _make_rings(self) -> dict:
+    def _make_rings(self, state: "dict | None" = None) -> dict:
+        # A repeated hello on the same connection replaces its rings:
+        # drop the stale pair first so reconnect-negotiate loops can't
+        # accumulate segments behind one socket.
+        if state is not None and state["rings"]:
+            self._drop_rings(state["rings"])
+            state["rings"] = []
         slab = int(self.config.wire_shm_slab_bytes)
         c2s = wire.ShmRing.create(slab)     # router -> replica submits
         s2c = wire.ShmRing.create(slab)     # replica -> router scores
@@ -405,11 +442,25 @@ class ReplicaServer:
                 s2c.close()
                 raise RuntimeError("replica closed")
             self._rings += [c2s, s2c]
+        if state is not None:
+            state["rings"] = [c2s, s2c]
         threading.Thread(
             target=self._serve_ring, args=(c2s, s2c),
             name=f"oni-replica-{self.replica_id}-ring", daemon=True,
         ).start()
         return {"c2s": c2s.name, "s2c": s2c.name, "slab": slab}
+
+    def _drop_rings(self, rings: list) -> None:
+        """Close a connection's negotiated rings and forget them:
+        close() flips the shared closed flag (the _serve_ring poller
+        exits on its next timeslice) and, on the owning side, unlinks
+        the segments — reclaimed now, not at process exit."""
+        if not rings:
+            return
+        for r in rings:
+            r.close()
+        with self._lock:
+            self._rings = [r for r in self._rings if r not in rings]
 
     def _serve_ring(self, c2s: "wire.ShmRing",
                     s2c: "wire.ShmRing") -> None:

@@ -82,7 +82,8 @@ class ReplicaLink:
     def __init__(self, replica_id: str, host: str, port: int, *,
                  op_timeout_s: float, on_score, on_down,
                  wire_format: str = "columnar",
-                 want_shm: bool = False) -> None:
+                 want_shm: bool = False,
+                 accept_pickle: bool = False) -> None:
         import socket
 
         self.replica_id = replica_id
@@ -91,6 +92,11 @@ class ReplicaLink:
         self._on_score = on_score
         self._on_down = on_down
         self.codec = wire_format
+        # Whether this router will DECODE pickle responses at all: a
+        # link only enters pickle mode through negotiation, and
+        # negotiation only downgrades when the operator opted in
+        # (wire_accept_pickle) or forced the fallback codec outright.
+        self._accept_pickle = accept_pickle or wire_format == "pickle"
         self.shm_tx: "wire.ShmRing | None" = None
         self.shm_rx: "wire.ShmRing | None" = None
         self._data = socket.create_connection((host, port))
@@ -109,24 +115,43 @@ class ReplicaLink:
                 name=f"oni-route-{replica_id}-{name}", daemon=True,
             ).start()
         if wire_format == "columnar":
-            self._negotiate(want_shm)
+            try:
+                self._negotiate(want_shm)
+            except ConnectionError:
+                self.close()
+                raise
 
     def _negotiate(self, want_shm: bool) -> None:
         """hello handshake: settle the frame codec (a peer whose
         config forces the fallback answers "pickle"; a pre-columnar
-        peer rejects the op — both downgrade this link) and attach the
-        shm ring pair a same-host replica offered."""
+        peer rejects the op — both downgrade this link, but ONLY when
+        this router accepts the fallback: otherwise the downgrade is
+        a refused connection, never a silent switch to an unpickling
+        link) and attach the shm ring pair a same-host replica
+        offered."""
         import socket as socket_mod
 
         try:
             rsp = self.call({
-                "op": "hello", "wire": ["columnar", "pickle"],
+                "op": "hello", "wire": (["columnar", "pickle"]
+                                        if self._accept_pickle
+                                        else ["columnar"]),
                 "shm": want_shm, "host": socket_mod.gethostname(),
             })
         except (RuntimeError, TimeoutError):
+            if not self._accept_pickle:
+                raise ConnectionError(
+                    f"replica {self.replica_id} rejected the columnar "
+                    "hello and this router refuses the pickle "
+                    "fallback (wire_accept_pickle=False)")
             self.codec = "pickle"  # lint: ok(lock-discipline, negotiate runs once from __init__ before the link is published to any caller)
             return
-        self.codec = rsp.get("wire", "columnar")  # lint: ok(lock-discipline, negotiate runs once from __init__ before the link is published to any caller)
+        chosen = rsp.get("wire", "columnar")
+        if chosen != "columnar" and not self._accept_pickle:
+            raise ConnectionError(
+                f"replica {self.replica_id} negotiated {chosen!r}, "
+                "which this router refuses (wire_accept_pickle=False)")
+        self.codec = chosen  # lint: ok(lock-discipline, negotiate runs once from __init__ before the link is published to any caller)
         shm = rsp.get("shm")
         if not shm:
             return
@@ -164,7 +189,10 @@ class ReplicaLink:
     def _reader(self, sock, is_data: bool) -> None:
         while True:
             try:
-                msg = recv_frame(sock)
+                # self.codec re-read each frame: responses only
+                # unpickle after THIS link's negotiation settled on
+                # the fallback.
+                msg = recv_frame(sock, codec=self.codec)
             except (ConnectionError, OSError) as e:
                 with self._call_lock:
                     closed = self._closed
@@ -317,6 +345,7 @@ class FleetRouter:
             on_score=self._on_score, on_down=self._on_link_down,
             wire_format=self.config.wire_format,
             want_shm=self.config.wire_shm,
+            accept_pickle=self.config.wire_accept_pickle,
         )
         with self._cond:
             if replica_id in self._links:

@@ -20,12 +20,17 @@ Frame layout (`encode_payload`):
                 per-key encoding tags)
     buffers     each column's raw bytes, 8-byte aligned
 
-`decode_payload` auto-detects the codec by magic: a frame that does
-not open with ``OCWF`` is a **negotiated pickle fallback** frame
-(serving/wire_pickle.py) — the one-release compatibility path for
-peers that answered the ``hello`` negotiation with ``"pickle"``.
-Version mismatches, truncated buffers, and length drift all fail
-loudly as ConnectionError before any allocation-by-attacker.
+`decode_payload` decodes columnar frames (``OCWF`` magic) always; a
+frame that does not open with the magic is unpickled ONLY when the
+``codec`` argument says this link actually negotiated the **pickle
+fallback** (serving/wire_pickle.py — the one-release compatibility
+path, behind ``ServingConfig.wire_accept_pickle`` and an allowlisted
+unpickler).  On a columnar link a non-magic frame is rejected
+outright: a peer can never force the pickle codec onto a receiver by
+sending non-magic bytes.  Version mismatches, truncated buffers,
+hostile descriptors, and length drift all fail loudly as
+ConnectionError — the wire's single failure mode — before any
+allocation-by-attacker.
 
 Typed encodings (tagged per top-level message key):
 
@@ -38,7 +43,8 @@ Typed encodings (tagged per top-level message key):
     ``model``  ScoringModel -> theta/p columns + key/value columns
     ``colset`` dataplane ColumnSet -> one column per schema field
     ``opq``    no columnar encoding (the featurizer push) ->
-               wire_pickle opaque bytes, tagged so the lint budget for
+               wire_pickle opaque bytes (decoded through the
+               allowlisted unpickler), tagged so the lint budget for
                pickle stays exactly one module
 
 Score batches (the replica resolver's coalesced responses) get a
@@ -272,14 +278,21 @@ def _frame(kind: int, meta: dict, cols) -> bytes:
 # ---------------------------------------------------------------------------
 
 
-def decode_payload(buf):
+def decode_payload(buf, codec: str = "columnar"):
     """Frame payload -> message.  Columnar frames (magic match) decode
-    as zero-copy views over `buf`; anything else is a negotiated
-    pickle-fallback frame."""
+    as zero-copy views over `buf`.  A non-magic frame decodes through
+    the pickle fallback ONLY when `codec` says this link negotiated
+    it; on a columnar link it is rejected as a ConnectionError, so
+    the unpickler is unreachable for peers that never negotiated the
+    fallback."""
     mv = memoryview(buf)
     if len(mv) >= 4 and bytes(mv[:4]) == MAGIC:
         return _decode_columnar(mv)
-    return wire_pickle.decode_payload(mv)
+    if codec == "pickle":
+        return wire_pickle.decode_payload(mv)
+    raise ConnectionError(
+        f"non-columnar frame ({len(mv)} bytes) on a link that did "
+        "not negotiate the pickle fallback")
 
 
 def _short(mv, need: int, pos: int, what: str) -> None:
@@ -290,6 +303,21 @@ def _short(mv, need: int, pos: int, what: str) -> None:
 
 
 def _decode_columnar(mv: memoryview):
+    """Every decode failure — truncation, hostile descriptors (bad
+    dtype strings, negative dims), missing columns, bad UTF-8/JSON —
+    surfaces as the wire's uniform ConnectionError, never a
+    codec-specific TypeError/ValueError/KeyError that would escape a
+    reader's ``except (ConnectionError, OSError)``."""
+    try:
+        return _decode_columnar_body(mv)
+    except ConnectionError:
+        raise
+    except Exception as e:
+        raise ConnectionError(
+            f"undecodable columnar frame ({len(mv)} bytes): {e!r}")
+
+
+def _decode_columnar_body(mv: memoryview):
     _short(mv, _HDR.size, 0, "header")
     magic, ver, kind, ncols, meta_len = _HDR.unpack_from(mv, 0)
     if ver != WIRE_VERSION:
@@ -325,6 +353,9 @@ def _decode_columnar(mv: memoryview):
         dtype = np.dtype(dt)
         count = 1
         for d in shape:
+            if d < 0:
+                raise ConnectionError(
+                    f"negative dim {d} in column {name!r} descriptor")
             count *= d
         nbytes = count * dtype.itemsize
         _short(mv, nbytes, pos, f"column {name!r}")
@@ -435,23 +466,28 @@ def send_frame(sock: socket.socket, obj,
     return len(data)
 
 
-def recv_frame(sock: socket.socket):
+def recv_frame(sock: socket.socket, codec: str = "columnar"):
     """Read one frame; raises ConnectionError on EOF / short read /
-    oversized announcement / malformed columnar payload."""
-    return recv_frame_tagged(sock)[0]
+    oversized announcement / malformed payload.  `codec` is this
+    link's NEGOTIATED frame codec: a non-columnar frame only decodes
+    when the link settled on the pickle fallback."""
+    return recv_frame_tagged(sock, codec)[0]
 
 
-def recv_frame_tagged(sock: socket.socket) -> "tuple[object, str]":
-    """recv_frame plus the codec the peer used — the replica mirrors
-    it on responses, so a negotiated-fallback peer is answered in the
-    codec it can actually read without per-link state."""
+def recv_frame_tagged(sock: socket.socket,
+                      codec: str = "columnar") -> "tuple[object, str]":
+    """recv_frame plus the codec the peer used on THIS frame — the
+    replica mirrors it on responses, so a negotiated-fallback peer is
+    answered in the codec it can actually read.  Decoding is gated by
+    `codec` (what the link negotiated), not by the tag: a pickle
+    frame on a columnar link raises instead of unpickling."""
     head = _recv_exact(sock, _LEN.size)
     (n,) = _LEN.unpack(head)
     if n > MAX_FRAME_BYTES:
         raise ConnectionError(f"oversized frame announced: {n} bytes")
     payload = _recv_exact(sock, n)
-    codec = ("columnar" if payload[:4] == MAGIC else "pickle")
-    return decode_payload(payload), codec
+    tag = ("columnar" if payload[:4] == MAGIC else "pickle")
+    return decode_payload(payload, codec=codec), tag
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -470,6 +506,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 # ---------------------------------------------------------------------------
 
 _RING_MAGIC = b"OCWR"
+
+
+class _RingStuck(ConnectionError):
+    """Seqlock guard never stabilized: the peer died between its odd
+    and even guard writes (SIGKILL mid-_locked_write).  push/pop
+    translate this into their closed-ring return values so callers
+    fall back to the TCP path."""
+
+
+
 # Header: magic+ver (8) | pseq (8) | wseq (8) | len0 (8) | len1 (8)
 #         | cseq (8) | rseq (8) | closed (8)
 _RING_HDR = 64
@@ -487,7 +533,17 @@ class ShmRing:
     frame bytes are never overwritten while the peer may still read
     them.  No locks, no fds, no syscalls on the hot path — a SIGKILL'd
     peer leaves the ring in a consistent state and the survivor's
-    poll loop simply times out."""
+    poll loop simply times out.  The one inconsistent death — killed
+    BETWEEN the odd and even guard writes of a seqlock publish — is
+    bounded by ``_SEQLOCK_STUCK_S``: a guard that never stabilizes
+    marks the ring closed and the survivor degrades to TCP instead of
+    spinning forever."""
+
+    # How long a reader rereads an odd/unstable seqlock guard before
+    # declaring the writer dead mid-publish.  A live writer holds the
+    # guard odd for a handful of header stores (microseconds); seconds
+    # of instability means the peer died inside _locked_write.
+    _SEQLOCK_STUCK_S = 2.0
 
     def __init__(self, shm, slab_bytes: int, *, owner: bool) -> None:
         self._shm = shm
@@ -556,14 +612,35 @@ class ShmRing:
     def _stable_read(self, seq_off: int, field_offs) -> "list[int]":
         """Reader side: retry until the guard is even and unchanged
         across the field reads (a torn 8-byte read is theoretical on
-        CPython but the seqlock makes it impossible, not unlikely)."""
+        CPython but the seqlock makes it impossible, not unlikely).
+        Bounded: a guard that stays odd/unstable past
+        ``_SEQLOCK_STUCK_S`` means the writer was SIGKILL'd between
+        its guard writes — mark the ring closed (for both ends) and
+        raise _RingStuck so push/pop report the ring dead instead of
+        busy-looping at 100% CPU forever."""
+        deadline = None
+        spin = 0
         while True:
             s0 = self._read_u64(seq_off)
-            if s0 & 1:
-                continue
-            vals = [self._read_u64(off) for off in field_offs]
-            if self._read_u64(seq_off) == s0:
-                return vals
+            if not (s0 & 1):
+                vals = [self._read_u64(off) for off in field_offs]
+                if self._read_u64(seq_off) == s0:
+                    return vals
+            spin += 1
+            if spin <= 64:
+                continue    # genuine contention resolves in a few reads
+            if deadline is None:
+                deadline = time.monotonic() + self._SEQLOCK_STUCK_S
+            elif time.monotonic() > deadline:
+                try:
+                    self._buf[_OFF_CLOSED] = 1
+                except (TypeError, ValueError):
+                    pass    # this side's mapping already released
+                raise _RingStuck(
+                    f"shm ring seqlock stuck for "
+                    f"{self._SEQLOCK_STUCK_S}s — peer died mid-write; "
+                    "ring closed")
+            time.sleep(1e-5)
 
     # -- data path ---------------------------------------------------------
 
@@ -583,6 +660,8 @@ class ShmRing:
         the timeout (caller falls back to the TCP path)."""
         try:
             return self._push(payload, timeout_s)
+        except _RingStuck:
+            return False        # peer died mid-publish — ring is dead
         except (TypeError, ValueError) as e:
             if "released" in str(e):
                 return False    # close() raced this push — ring is gone
@@ -623,6 +702,8 @@ class ShmRing:
         shutdown (pending slabs still drain after close)."""
         try:
             return self._pop(timeout_s)
+        except _RingStuck:
+            return None         # peer died mid-publish — ring is dead
         except (TypeError, ValueError) as e:
             if "released" in str(e):
                 return None     # close() raced this pop — ring is gone

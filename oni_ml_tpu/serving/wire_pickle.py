@@ -17,6 +17,19 @@ alive for exactly two negotiated cases:
    object the day-dir loading path pushes with ``add_tenant``.  They
    ride INSIDE a columnar frame as a tagged byte column.
 
+Reaching either decode is gated twice before this module runs: the
+receive path only routes a frame here when the LINK negotiated the
+pickle codec (serving/wire.py threads the negotiated codec into
+``decode_payload`` — no magic-sniff fallback), and negotiation itself
+only answers ``"pickle"`` when ``ServingConfig.wire_accept_pickle``
+(or a ``wire_format="pickle"`` override) says this deployment accepts
+the fallback at all.  Even then, decoding goes through
+``_WireUnpickler``: an allowlisted unpickler that refuses to resolve
+any global outside the vocabulary the wire legitimately carries
+(numpy array internals, this package's own classes, stdlib
+containers) — an ``os.system``-style reduce gadget fails the decode
+instead of executing.
+
 Everything else in serving/ and parallel/membership.py is banned from
 pickling by the ``no-pickle-wire`` graftlint rule; the suppressions
 below are that rule's sanctioned escape hatch.
@@ -24,7 +37,39 @@ below are that rule's sanctioned escape hatch.
 
 from __future__ import annotations
 
+import io
 import pickle
+
+# The serving wire's legitimate pickle vocabulary: plain containers
+# and scalars need no global lookup at all; everything that does is
+# numpy's array-reconstruction machinery, this package's own classes
+# (ScoringModel, the source featurizers and their specs), stdlib
+# container types, and a handful of safe builtins.
+_SAFE_MODULE_ROOTS = frozenset(("numpy", "oni_ml_tpu", "collections"))
+_SAFE_BUILTINS = frozenset((
+    "complex", "set", "frozenset", "bytearray", "range", "slice",
+))
+
+
+class _WireUnpickler(pickle.Unpickler):
+    """Allowlisted unpickler for negotiated-fallback frames and opaque
+    fields: ``find_class`` is the one place a pickle stream names code
+    to run, so refusing everything off the allowlist removes the
+    arbitrary-code surface even from links that DID negotiate the
+    fallback."""
+
+    def find_class(self, module: str, name: str):
+        root = module.split(".", 1)[0]
+        if root in _SAFE_MODULE_ROOTS or (
+                module == "builtins" and name in _SAFE_BUILTINS):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"wire pickle refuses {module}.{name}: not on the "
+            "serving-wire allowlist")
+
+
+def _loads(buf) -> object:
+    return _WireUnpickler(io.BytesIO(bytes(buf))).load()
 
 
 def encode_payload(obj) -> bytes:
@@ -33,12 +78,13 @@ def encode_payload(obj) -> bytes:
 
 
 def decode_payload(buf) -> object:
-    """Decode a negotiated-fallback (or pre-columnar peer) frame.
-    Garbage — including a columnar frame truncated below its 4-byte
-    magic, which lands here by misdetection — fails as the wire's
-    uniform ConnectionError, never a codec-specific error."""
+    """Decode a negotiated-fallback (or pre-columnar peer) frame —
+    only reachable on a link whose hello negotiation settled on the
+    pickle codec, and through the allowlisted unpickler.  Garbage and
+    off-allowlist globals both fail as the wire's uniform
+    ConnectionError, never a codec-specific error."""
     try:
-        return pickle.loads(bytes(buf))  # lint: ok(no-pickle-wire, negotiated whole-frame fallback decode — auto-detected by the missing columnar magic)
+        return _loads(buf)
     except ConnectionError:
         raise
     except Exception as e:
@@ -53,4 +99,8 @@ def encode_opaque(obj) -> bytes:
 
 
 def decode_opaque(buf) -> object:
-    return pickle.loads(bytes(buf))  # lint: ok(no-pickle-wire, opaque-field escape hatch decode)
+    """Opaque-field decode, through the same allowlisted unpickler —
+    the featurizer column inside a columnar frame is pickle bytes,
+    so it gets the same non-executing treatment as a whole fallback
+    frame."""
+    return _loads(buf)

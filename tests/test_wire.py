@@ -95,13 +95,39 @@ def test_frame_roundtrip_model_and_scores_bit_identical():
     assert got[2] == batch[2]
 
 
-def test_pickle_fallback_autodetected_by_magic():
-    """A frame that does not open with the columnar magic decodes
-    through the negotiated fallback — the one-release compat path."""
+def test_pickle_fallback_gated_by_negotiated_codec():
+    """A non-columnar frame decodes only on a link whose negotiation
+    settled on the pickle fallback; on a columnar link it is rejected
+    outright — the receiver never sniffs its way into the unpickler."""
     msg = {"op": "stats", "x": [1, 2, 3]}
     blob = wire_pickle.encode_payload(msg)
     assert bytes(blob[:4]) != wire_mod.MAGIC
-    assert decode_payload(blob) == msg
+    assert decode_payload(blob, codec="pickle") == msg
+    with pytest.raises(ConnectionError, match="did not negotiate"):
+        decode_payload(blob)
+
+
+def test_fallback_unpickler_refuses_code_execution_gadgets():
+    """Even a negotiated-fallback link never executes frame bytes:
+    the allowlisted unpickler refuses globals outside the wire's
+    legitimate vocabulary, so an os.system reduce gadget fails the
+    decode instead of running."""
+    import os
+    import pickle as _pickle
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("true",))
+
+    blob = _pickle.dumps(Evil())
+    with pytest.raises(ConnectionError, match="allowlist"):
+        wire_pickle.decode_payload(blob)
+    with pytest.raises(_pickle.UnpicklingError, match="allowlist"):
+        wire_pickle.decode_opaque(blob)
+    # The legitimate vocabulary still round-trips.
+    arr = np.arange(4.0)
+    np.testing.assert_array_equal(
+        wire_pickle.decode_opaque(wire_pickle.encode_opaque(arr)), arr)
 
 
 # ---------------------------------------------------------------------------
@@ -130,6 +156,22 @@ def test_version_mismatch_and_unknown_kind_rejected():
     kind[5] = 250                         # byte 5 = frame kind
     with pytest.raises(ConnectionError, match="kind"):
         decode_payload(bytes(kind))
+
+
+def test_malformed_columnar_frames_fail_as_connection_error():
+    """Hostile descriptors — meta referencing a missing column, a
+    garbage dtype string — surface as the wire's uniform
+    ConnectionError, never a TypeError/ValueError/KeyError that would
+    escape a reader thread's ``except (ConnectionError, OSError)``."""
+    missing = wire_mod._frame(wire_mod.KIND_MSG,
+                              {"f": {}, "e": {"x": "nd"}}, [])
+    with pytest.raises(ConnectionError):
+        decode_payload(missing)
+    good = bytearray(encode_payload({"op": "x", "arr": np.arange(4.0)}))
+    i = good.index(b"<f8")
+    good[i:i + 3] = b"zzz"       # np.dtype("zzz") raises TypeError
+    with pytest.raises(ConnectionError):
+        decode_payload(bytes(good))
 
 
 def test_oversized_announcement_rejected_before_allocation():
@@ -212,6 +254,92 @@ def test_wire_parity_pin_all_sources_columnar_vs_pickle():
 
 
 # ---------------------------------------------------------------------------
+# receive-side pickle gating (the replica's ports)
+# ---------------------------------------------------------------------------
+
+
+def test_unnegotiated_pickle_frame_drops_the_connection():
+    """A peer that skips negotiation and throws a pickle frame at a
+    columnar replica gets its connection dropped — the frame is never
+    unpickled (default wire_accept_pickle=False)."""
+    rep = ReplicaServer("r0", ServingConfig())
+    try:
+        s = socket.create_connection((rep.host, rep.port))
+        try:
+            payload = wire_pickle.encode_payload(
+                {"op": "ping", "id": 1})
+            s.sendall(struct.pack("!I", len(payload)) + payload)
+            with pytest.raises(ConnectionError):
+                wire_mod.recv_frame(s)    # replica hung up, no reply
+        finally:
+            s.close()
+    finally:
+        rep.stop()
+
+
+def test_hello_pickle_only_offer_refused_unless_accepted():
+    """The hello negotiation is the only gate into the fallback: a
+    pickle-only offer is an error under the default config and only
+    negotiates the fallback when wire_accept_pickle is on."""
+    rep = ReplicaServer("r0", ServingConfig())
+    try:
+        s = socket.create_connection((rep.host, rep.port))
+        try:
+            wire_mod.send_frame(
+                s, {"op": "hello", "id": 1, "wire": ["pickle"]})
+            rsp = wire_mod.recv_frame(s)
+            assert "wire_accept_pickle" in rsp["error"]
+        finally:
+            s.close()
+    finally:
+        rep.stop()
+    rep = ReplicaServer("r1", ServingConfig(wire_accept_pickle=True))
+    try:
+        s = socket.create_connection((rep.host, rep.port))
+        try:
+            wire_mod.send_frame(
+                s, {"op": "hello", "id": 1, "wire": ["pickle"]})
+            rsp = wire_mod.recv_frame(s)
+            assert rsp["wire"] == "pickle" and rsp["ok"]
+        finally:
+            s.close()
+    finally:
+        rep.stop()
+
+
+def test_hello_rings_are_torn_down_with_their_connection():
+    """Rings negotiated by a connection's hello die with the
+    connection (and a repeat hello replaces, not accumulates) — a
+    reconnecting or SIGKILL'd router leaks no shm segments or polling
+    threads on the replica."""
+    rep = ReplicaServer("r0", ServingConfig())
+    try:
+        hello = {"op": "hello", "wire": ["columnar"], "shm": True,
+                 "host": socket.gethostname()}
+        s = socket.create_connection((rep.host, rep.port))
+        try:
+            wire_mod.send_frame(s, {**hello, "id": 1})
+            rsp = wire_mod.recv_frame(s)
+            assert rsp["shm"] is not None
+            assert len(rep._rings) == 2
+            # Second hello on the same connection: replaced, not
+            # appended.
+            wire_mod.send_frame(s, {**hello, "id": 2})
+            rsp2 = wire_mod.recv_frame(s)
+            assert rsp2["shm"] is not None
+            assert rsp2["shm"]["c2s"] != rsp["shm"]["c2s"]
+            assert len(rep._rings) == 2
+        finally:
+            s.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and rep._rings:
+            time.sleep(0.01)
+        assert rep._rings == []
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
 # shm ring
 # ---------------------------------------------------------------------------
 
@@ -278,6 +406,28 @@ def test_shm_ring_concurrent_stress_columnar_frames():
         t.join(timeout=60.0)
         assert not t.is_alive()
         assert got == sent
+    finally:
+        peer.close()
+        ring.close()
+
+
+def test_shm_ring_stuck_seqlock_times_out_and_closes():
+    """A peer SIGKILL'd between its seqlock guard writes leaves the
+    guard odd forever: the survivor's read bounds out, marks the ring
+    closed, and degrades instead of busy-looping at 100% CPU."""
+    ring = ShmRing.create(slab_bytes=1024)
+    peer = ShmRing.attach(ring.name, 1024)
+    try:
+        peer._SEQLOCK_STUCK_S = 0.2
+        # Producer dies mid-_locked_write: pseq stays odd.
+        ring._write_u64(wire_mod._OFF_PSEQ, 1)
+        t0 = time.monotonic()
+        assert peer.pop(timeout_s=30.0) is None
+        assert time.monotonic() - t0 < 5.0
+        assert peer.closed
+        # Both ends now see the ring dead and return immediately.
+        assert peer.pop(timeout_s=1.0) is None
+        assert not ring.push(b"x", timeout_s=0.2)
     finally:
         peer.close()
         ring.close()
@@ -424,6 +574,27 @@ def test_autoscaler_decisions_are_journaled():
 # ---------------------------------------------------------------------------
 # concurrent-router failover claim
 # ---------------------------------------------------------------------------
+
+
+def test_claim_promotion_error_taxonomy():
+    """Only a genuine ALREADY_EXISTS loses the promotion election; a
+    KV transport failure mid-failover claims by default — duplicate
+    backfills are router_version-idempotent on the replica, zero
+    backfills silently lose the promoted tenants' data path."""
+    from oni_ml_tpu.parallel.membership import MembershipClient
+
+    class _DeadKV:
+        def key_value_set(self, *a, **k):
+            raise RuntimeError("connection refused")
+
+    class _TakenKV:
+        def key_value_set(self, *a, **k):
+            raise RuntimeError("ALREADY_EXISTS: oni/fleet/promote/r0")
+
+    assert MembershipClient(_DeadKV()).claim_promotion(
+        "r0", "ra") is True
+    assert MembershipClient(_TakenKV()).claim_promotion(
+        "r0", "ra") is False
 
 
 def test_concurrent_router_failover_single_claim_both_resolve(tmp_path):
