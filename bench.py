@@ -2186,6 +2186,197 @@ def phase_streaming_freshness():
             **res}
 
 
+# -- composed standing service (continuous x fleet x cosched) -----------
+
+
+def _trim_fleet_payload(payload):
+    """Bench-payload hygiene for a FleetContinuousService result: the
+    per-tenant refresh ledgers and the router failover detail scale
+    with run length — the journal holds them; the bench keeps counts."""
+    for t in (payload.get("tenants") or {}).values():
+        t.pop("refresh_records", None)
+    router = payload.get("router") or {}
+    if isinstance(router.get("failovers"), list):
+        router["failovers"] = len(router["failovers"])
+    return payload
+
+
+def _drive_fleet(fleet, tagged, speed, *, kill=False):
+    """Replay a multi-tenant tagged day through a standing fleet; when
+    `kill`, SIGKILL the first tenant's primary replica mid-run —
+    preferring a moment a refresh fit is actually in flight, forcing
+    it by 60% of the replay otherwise."""
+    from oni_ml_tpu.runner.continuous import paced_tagged
+
+    killed = None
+    n_total = len(tagged)
+    for i, (tenant, sl) in enumerate(paced_tagged(tagged, speed)):
+        fleet.ingest(tenant, sl)
+        if kill and killed is None and fleet.binding is not None:
+            ready = all(fleet.binding.ready(t) for t in fleet.streams)
+            if ready and (fleet.cosched.refresh_active
+                          or i >= int(0.6 * n_total)):
+                victim = fleet.router.placement()[
+                    min(fleet.streams)].primary
+                if victim in fleet.replica_procs:
+                    fleet.kill_replica(victim)
+                    killed = victim
+    return killed
+
+
+def bench_continuous_replicated(n_events=12_000, n_src=200, n_dst=120,
+                                slice_s=900.0, speed=1440.0,
+                                window_s=4 * 3600.0,
+                                refresh_every_s=1800.0, k=6,
+                                em_max_iters=40, replicas=2):
+    """The ONE-standing-service composed bench: two tenants' synthetic
+    flow days interleaved in event time and replayed at ×speed through
+    `FleetContinuousService` — per-tenant continuous windows, warm-
+    start refreshes on the shared preemptible worker, drift-gated
+    publishes fanned out to `replicas` SIGKILL-able subprocess
+    replicas, every slice scored through the router.
+
+    Two legs, one payload:
+      * coscheduled leg (the product path): mid-run a chaos SIGKILL of
+        a primary replica, so freshness, serve-p99-during-refresh, AND
+        replica-kill recovery (zero failed futures, failovers > 0) are
+        measured in the SAME run;
+      * uncoscheduled control leg (`CoScheduler(enabled=False)`): same
+        topology and measurement, no arbitration — the denominator for
+        the co-scheduler's serve-tail claim.
+
+    Acceptance (bench_diff keys): serve p99 during refresh stays
+    within 2x idle p99, event-time freshness in minutes no worse than
+    the single-tenant streaming_freshness phase, failed_futures == 0
+    through the kill, zero post-warmup retraces."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from oni_ml_tpu.config import ContinuousConfig, PipelineConfig
+    from oni_ml_tpu.runner.continuous import (
+        FleetContinuousService,
+        interleave_streams,
+        slice_events,
+    )
+
+    workdir = tempfile.mkdtemp(
+        prefix="oni_e2e_fleet_", dir=os.environ.get("BENCH_E2E_DIR")
+    )
+    try:
+        per_tenant = {}
+        for idx, tenant in enumerate(("acme", "globex")):
+            day_path = os.path.join(workdir, f"{tenant}.csv")
+            with open(day_path, "w") as f:
+                _write_flow_day(f, n_events // 2, n_src=n_src,
+                                n_dst=n_dst, seed=23 + idx)
+            with open(day_path) as f:
+                lines = f.readlines()
+            per_tenant[tenant] = slice_events(lines, "flow", slice_s)
+        tagged = interleave_streams(per_tenant)
+        streams = {t: "flow" for t in per_tenant}
+        config = PipelineConfig(
+            data_dir=workdir,
+            continuous=ContinuousConfig(
+                window_s=window_s, refresh_every_s=refresh_every_s,
+            ),
+        )
+        config = dataclasses.replace(
+            config,
+            lda=dataclasses.replace(
+                config.lda, num_topics=k, em_max_iters=em_max_iters
+            ),
+        )
+
+        def _leg(name, coscheduled, kill):
+            fleet = FleetContinuousService(
+                config, streams,
+                out_dir=os.path.join(workdir, name),
+                replicated=replicas, coscheduler=coscheduled,
+            )
+            t0 = time.perf_counter()
+            try:
+                killed = _drive_fleet(
+                    fleet, tagged, speed, kill=kill)
+            finally:
+                payload = fleet.close()
+            payload["replay_wall_s"] = round(
+                time.perf_counter() - t0, 1)
+            payload["killed_replica"] = killed
+            return _trim_fleet_payload(payload)
+
+        main = _leg("cosched", True, kill=True)
+        control = _leg("control", False, kill=False)
+
+        serving = main.get("serving") or {}
+        ctrl_serving = control.get("serving") or {}
+        cosched = main.get("cosched") or {}
+
+        def _ms(v):
+            return round(v * 1e3, 3) if v is not None else None
+
+        idle = serving.get("serve_idle_p99_ms")
+        during = serving.get("serve_refresh_p99_ms")
+        ratio = (round(during / idle, 3)
+                 if during and idle else None)
+        res = {
+            "replicas": replicas,
+            "replay_speed": speed,
+            "n_events": main.get("events"),
+            "events_scored": serving.get("events_scored"),
+            "failed_futures": serving.get("failed_futures"),
+            "failovers": (main.get("router") or {}).get("failovers"),
+            "killed_replica": main.get("killed_replica"),
+            "freshness_p50_s": main.get("freshness_p50_s"),
+            "freshness_p99_s": main.get("freshness_p99_s"),
+            "freshness_event_p50_min": main.get(
+                "freshness_event_p50_min"),
+            "freshness_event_p99_min": main.get(
+                "freshness_event_p99_min"),
+            "p99_idle_ms": idle,
+            "p99_during_refresh_ms": during,
+            "refresh_over_idle_ratio": ratio,
+            "p99_idle_uncoscheduled_ms": ctrl_serving.get(
+                "serve_idle_p99_ms"),
+            "p99_during_refresh_uncoscheduled_ms": ctrl_serving.get(
+                "serve_refresh_p99_ms"),
+            "yield_wait_p99_ms": _ms(cosched.get("yield_wait_p99_s")),
+            "preempt_wait_p99_ms": _ms(
+                cosched.get("preempt_wait_p99_s")),
+            "train_chunks": cosched.get("train_chunks"),
+            "yields": cosched.get("yields"),
+            "preempts": cosched.get("preempts"),
+            "refreshes": main.get("refreshes"),
+            "publishes": main.get("publishes"),
+            "coalesced_refreshes": main.get("coalesced_refreshes"),
+            "refresh_errors": main.get("refresh_errors"),
+            "retraces_after_warmup": main.get("retraces_after_warmup"),
+            "sustained_eps": (
+                round(main["events"] / main["replay_wall_s"], 1)
+                if main.get("events") and main.get("replay_wall_s")
+                else None),
+            "replay_wall_s": main.get("replay_wall_s"),
+            "coscheduled": main,
+            "uncoscheduled": control,
+        }
+        return res
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def phase_continuous_replicated():
+    """Composed standing service: headline value is the serve p99
+    DURING a refresh fit (lower better) on the coscheduled leg — the
+    number the two-priority chunk scheduler exists to hold down; the
+    payload carries the uncoscheduled control leg, the fleet freshness
+    quantiles, the chaos-kill recovery proof (failed_futures == 0,
+    failovers >= 1), yield/preempt tails, and the zero-retrace count —
+    bench_diff gates them with direction-aware keys."""
+    res = bench_continuous_replicated()
+    return {"value": res.get("p99_during_refresh_ms"),
+            "unit": "ms", **res}
+
+
 # -- detection quality (labeled-injection P/R@k) ------------------------
 
 
@@ -2555,6 +2746,14 @@ PHASES = [
     # Continuous ingestion: a paced day replay through the standing
     # window→warm-EM→gated-publish loop with co-resident serving.
     ("streaming_freshness", phase_streaming_freshness, 600.0, True),
+    # Composed standing service: two tenants x continuous windows x
+    # preemptible co-scheduled refreshes x replicated fleet, with a
+    # mid-run replica SIGKILL and an uncoscheduled control leg in the
+    # same payload.  Replica subprocesses are fresh JAX_PLATFORMS=cpu
+    # processes, so the phase stays runnable while the chip grant is
+    # wedged.
+    ("continuous_replicated", phase_continuous_replicated,
+     900.0, False),
     # Detection-quality SLO: labeled-injection P/R@k for every
     # registered source, trained and scored on CPU — runnable while
     # the chip grant is wedged.

@@ -144,6 +144,7 @@ class CorpusWindow:
         self._docs = _Interner()
         self._words = _Interner()
         self._chunks: deque = deque()
+        self._reserved_capacity = 0
         self._recorder = recorder
         self._journal = journal
         self.ingested_chunks = 0
@@ -265,7 +266,21 @@ class CorpusWindow:
         return len(self._words)
 
     def vocab_capacity(self) -> int:
-        return pow2_capacity(len(self._words), self.vocab_floor)
+        return pow2_capacity(
+            len(self._words),
+            max(self.vocab_floor, self._reserved_capacity),
+        )
+
+    def reserve_capacity(self, capacity: int) -> int:
+        """Raise the window's effective capacity floor to (at least)
+        `capacity`, monotone — the distributed-refresh tier sync
+        (parallel/tiers.py): every rank reserves the fleet-agreed tier
+        BEFORE snapshotting, so all ranks pad to the same [K, V] even
+        when their local vocabularies sit in different tiers.  Returns
+        the resulting capacity."""
+        cap = pow2_capacity(int(capacity), self.vocab_floor)
+        self._reserved_capacity = max(self._reserved_capacity, cap)
+        return self.vocab_capacity()
 
     def snapshot(self) -> WindowSnapshot:
         """Assemble the live window into a training Corpus.

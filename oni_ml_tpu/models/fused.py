@@ -27,6 +27,7 @@ data-parallel and vocab-sharded plans unchanged.
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from typing import Callable, NamedTuple, Sequence
 
 import jax
@@ -450,12 +451,19 @@ def make_chunk_runner(
     dense_e_step_fn: Callable | None = None,
     dense_precision: str = "f32",
     alpha_max_iters: int = 100,
+    yield_hook: Callable | None = None,
 ):
     """Build the jitted `run_chunk(log_beta, alpha, ll_prev, groups,
     n_steps)` executing up to min(chunk, n_steps) EM iterations on device.
 
     `n_steps` is a traced scalar, so checkpoint boundaries and the final
     partial chunk reuse the single compiled program.
+
+    `yield_hook` (a context-manager factory, e.g.
+    `serving.CoScheduler.train_chunk`) makes each chunk dispatch
+    PREEMPTIBLE: the runner enters one hook slot per dispatch, so a
+    co-resident serving plane wins the next dispatch slot at every
+    chunk boundary — the fused chunk is the natural preemption grain.
     """
     from .lda import update_alpha  # local import: lda.py imports this module
 
@@ -686,13 +694,15 @@ def make_chunk_runner(
         tunneled backend) — the quantity the chunked driver exists to
         amortize — not device compute; the driver's host-sync span
         covers the blocking side.  No recorder -> straight through."""
+        slot = yield_hook() if yield_hook is not None else nullcontext()
         rec = current_recorder()
         if rec is None:
-            return jitted(log_beta, alpha, ll_prev, groups, n_steps,
-                          *args, **kw)
-        with rec.span("em.run_chunk", chunk=chunk,
-                      n_steps=int(n_steps)
-                      if isinstance(n_steps, int) else None):
+            with slot:
+                return jitted(log_beta, alpha, ll_prev, groups, n_steps,
+                              *args, **kw)
+        with slot, rec.span("em.run_chunk", chunk=chunk,
+                            n_steps=int(n_steps)
+                            if isinstance(n_steps, int) else None):
             out = jitted(log_beta, alpha, ll_prev, groups, n_steps,
                          *args, **kw)
         rec.counter("em.chunk_dispatches").add(1)

@@ -21,6 +21,7 @@ did an `MPI_Reduce` across 20 ranks.
 from __future__ import annotations
 
 import os
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable, Sequence
@@ -246,6 +247,7 @@ class LDATrainer:
         collective=None,
         shard_plan=None,
         shard_batches=None,
+        yield_hook: Callable | None = None,
     ):
         """When `mesh` is set, batches are device_put ONCE with the
         data-axis layout (and beta with the vocab-sharded layout if
@@ -255,7 +257,14 @@ class LDATrainer:
         sufficient statistics through `collective`
         (parallel/allreduce.py) — `shard_plan`/`shard_batches` (shard
         index -> that shard's batches, doc_index GLOBAL) switch fit()
-        onto the distributed driver (`_distributed_loop`)."""
+        onto the distributed driver (`_distributed_loop`).
+
+        `yield_hook` (a context-manager factory; see
+        serving/coscheduler.py) makes the fit PREEMPTIBLE at its
+        natural dispatch grain: the fused driver enters one slot per
+        chunk dispatch, the stepwise driver one per EM iteration, the
+        distributed driver one per local E-step round — a co-resident
+        serving plane wins the next dispatch slot at every boundary."""
         self.config = config
         self.num_terms = num_terms
         self.mesh = mesh
@@ -263,6 +272,8 @@ class LDATrainer:
         self.collective = collective
         self.shard_plan = shard_plan
         self._shard_batches = shard_batches
+        self.yield_hook = yield_hook
+        self._partial_runner = None  # distributed-loop jit, fit-reused
         base = e_step_fn or estep.e_step
         self._e_base = base
         self._m_base = m_step_fn or estep.m_step
@@ -522,31 +533,42 @@ class LDATrainer:
         gammas = []
         it = start_it
         for it in range(start_it + 1, cfg.em_max_iters + 1):
-            total_ss = jnp.zeros((v, k), dtype)
-            total_ll = jnp.zeros((), dtype)
-            total_ass = jnp.zeros((), dtype)
-            prev_gammas = gammas if use_warm else []
-            gammas = []
-            for bi, (widx, cnts, mask) in enumerate(dev_batches):
-                if prev_gammas:
-                    res = self._e_step_warm(
-                        log_beta, alpha, widx, cnts, mask,
-                        prev_gammas[bi], jnp.asarray(1, jnp.int32),
-                    )
-                    n_warm_disp += 1
-                else:
-                    res = self._e_step(log_beta, alpha, widx, cnts, mask)
-                total_ss = total_ss + res.suff_stats
-                total_ll = total_ll + res.likelihood
-                total_ass = total_ass + res.alpha_ss
-                gammas.append(res.gamma)
-                n_e_disp += 1
+            # One EM iteration is the stepwise driver's preemption
+            # grain (fused_em_chunk=1 means the iteration IS the
+            # chunk): the whole dispatch burst — E-steps, M-step,
+            # alpha Newton — runs inside one yield-hook slot, and a
+            # co-resident scoring flush wins the slot between
+            # iterations.
+            slot = (self.yield_hook() if self.yield_hook is not None
+                    else nullcontext())
+            with slot:
+                total_ss = jnp.zeros((v, k), dtype)
+                total_ll = jnp.zeros((), dtype)
+                total_ass = jnp.zeros((), dtype)
+                prev_gammas = gammas if use_warm else []
+                gammas = []
+                for bi, (widx, cnts, mask) in enumerate(dev_batches):
+                    if prev_gammas:
+                        res = self._e_step_warm(
+                            log_beta, alpha, widx, cnts, mask,
+                            prev_gammas[bi], jnp.asarray(1, jnp.int32),
+                        )
+                        n_warm_disp += 1
+                    else:
+                        res = self._e_step(
+                            log_beta, alpha, widx, cnts, mask
+                        )
+                    total_ss = total_ss + res.suff_stats
+                    total_ll = total_ll + res.likelihood
+                    total_ass = total_ass + res.alpha_ss
+                    gammas.append(res.gamma)
+                    n_e_disp += 1
 
-            log_beta = self._m_step(total_ss)
-            if cfg.estimate_alpha:
-                alpha = update_alpha(total_ass, alpha, num_docs, k,
-                                     max_iters=cfg.alpha_max_iters)
-                n_a_disp += 1
+                log_beta = self._m_step(total_ss)
+                if cfg.estimate_alpha:
+                    alpha = update_alpha(total_ass, alpha, num_docs, k,
+                                         max_iters=cfg.alpha_max_iters)
+                    n_a_disp += 1
 
             # The per-iteration convergence read is the stepwise
             # driver's one deliberate device sync; span it like the
@@ -674,12 +696,24 @@ class LDATrainer:
                         max(filter(None, kibs))
                     )
                 }
-        runner = fused.make_partial_runner(
-            num_topics=k, num_terms=self.num_terms,
-            var_max_iters=cfg.var_max_iters, var_tol=cfg.var_tol,
-            e_step_fn=self._e_base, warm_start=cfg.warm_start_gamma,
-            compiler_options=compiler_options,
-        )
+        # The jitted partial-stats program is FIT-REUSED: a standing
+        # service (WindowTrainer with a collective) calls fit() every
+        # refresh with fresh shard batches but identical group shapes,
+        # and rebuilding the jit wrapper each fit would re-trace a
+        # program the compilation cache already holds.  Keyed by the
+        # compiler options in case the scoped-VMEM forwarding changes
+        # with the shard census.
+        co_key = (tuple(sorted(compiler_options.items()))
+                  if compiler_options else None)
+        if (self._partial_runner is None
+                or self._partial_runner[0] != co_key):
+            self._partial_runner = (co_key, fused.make_partial_runner(
+                num_topics=k, num_terms=self.num_terms,
+                var_max_iters=cfg.var_max_iters, var_tol=cfg.var_tol,
+                e_step_fn=self._e_base, warm_start=cfg.warm_start_gamma,
+                compiler_options=compiler_options,
+            ))
+        runner = self._partial_runner[1]
         shard_groups = [
             fused.stack_batches(
                 self._shard_batches[s], np.dtype(cfg.compute_dtype),
@@ -712,20 +746,29 @@ class LDATrainer:
             )
             shard_stats = {}
             new_gammas = []
-            for si, sg, gp in zip(owned, shard_groups, gammas_prev):
-                ss, ll, ass, gammas, _ = runner(
-                    log_beta, alpha, sg.arrays, gp, warm
-                )
-                new_gammas.append(gammas)
-                # The partial transfer is THE deliberate device sync of
-                # the distributed driver (one per shard per iteration);
-                # span it so the flight recorder prices it next to the
-                # allreduce wait instead of it hiding in iteration wall.
-                with maybe_span("em.host_sync", it=it, shard=si):
-                    shard_stats[si] = dict(zip(
-                        estep.PARTIAL_STAT_FIELDS,
-                        (np.asarray(ss), np.asarray(ll), np.asarray(ass)),
-                    ))
+            # The local E-step round is the distributed driver's
+            # preemption grain (the reduce that follows is host-side
+            # comms, never held under the slot — a slow peer must not
+            # block a co-resident scoring flush).
+            slot = (self.yield_hook() if self.yield_hook is not None
+                    else nullcontext())
+            with slot:
+                for si, sg, gp in zip(owned, shard_groups, gammas_prev):
+                    ss, ll, ass, gammas, _ = runner(
+                        log_beta, alpha, sg.arrays, gp, warm
+                    )
+                    new_gammas.append(gammas)
+                    # The partial transfer is THE deliberate device
+                    # sync of the distributed driver (one per shard per
+                    # iteration); span it so the flight recorder prices
+                    # it next to the allreduce wait instead of it
+                    # hiding in iteration wall.
+                    with maybe_span("em.host_sync", it=it, shard=si):
+                        shard_stats[si] = dict(zip(
+                            estep.PARTIAL_STAT_FIELDS,
+                            (np.asarray(ss), np.asarray(ll),
+                             np.asarray(ass)),
+                        ))
             gammas_prev, have_prev = new_gammas, True
             reduced = reduce_partials(coll, plan, shard_stats,
                                       f"em{it}", precision=ar_precision)
@@ -1241,6 +1284,7 @@ class LDATrainer:
             dense_e_step_fn=dense_e_fn,
             dense_precision=cfg.dense_precision,
             alpha_max_iters=cfg.alpha_max_iters,
+            yield_hook=self.yield_hook,
         )
 
         ll_prev_dev = jnp.asarray(
@@ -1401,12 +1445,29 @@ class WindowTrainer:
     (warm_start_log_beta pads for vocabulary growth) when given them;
     the existing float64 convergence check then early-exits after the
     few iterations the stream actually moved — the warm-start-vs-fresh
-    trade the streaming_freshness bench measures."""
+    trade the streaming_freshness bench measures.
 
-    def __init__(self, config: LDAConfig, num_terms: int) -> None:
+    With a `collective` (parallel/allreduce.py) the refresh trains
+    DISTRIBUTED: the warm-start seed broadcasts from the coordinator
+    (rank-identical topics even when only rank 0 holds the publish
+    history), documents shard by the PR 11 plan, the local E-steps
+    reduce through the collective, and — because a standing service
+    refits the SAME trainer forever — the per-shard batch census pads
+    to power-of-two counts (`pad_batch_census_pow2`) so the stacked
+    [NB, B, L] group shapes stay compiled-stable while the window's
+    doc count wobbles.  `yield_hook` threads through to the EM driver
+    (see LDATrainer) so refresh fits are preemptible by a co-resident
+    serving plane."""
+
+    def __init__(self, config: LDAConfig, num_terms: int, *,
+                 collective=None, yield_hook=None) -> None:
         self.config = config
         self.num_terms = num_terms
-        self._trainer = LDATrainer(config, num_terms=num_terms)
+        self.collective = collective
+        self._trainer = LDATrainer(
+            config, num_terms=num_terms, collective=collective,
+            yield_hook=yield_hook,
+        )
         self.fits = 0
 
     def fit(
@@ -1430,18 +1491,32 @@ class WindowTrainer:
                 "rebuild the trainer at the new tier (one program "
                 "family per tier, by design)"
             )
-        batches = make_batches(
-            corpus, batch_size=cfg.batch_size,
-            min_bucket_len=cfg.min_bucket_len,
-        )
+        if self.collective is not None:
+            # Rank-identical warm start: the coordinator's seed is THE
+            # seed (only it holds the drift-gated publish history);
+            # every rank trains from the broadcast copy.  The tag keys
+            # on the fit count, which advances in lockstep.
+            topic_probs, alpha = self.collective.broadcast_obj(
+                (topic_probs, alpha) if self.collective.rank == 0
+                else None,
+                f"window_seed{self.fits}",
+            )
         warm = topic_probs is not None
         init_lb = (
             warm_start_log_beta(topic_probs, self.num_terms)
             if warm else None
         )
+        if self.collective is not None:
+            batches, num_docs = self._shard_window(corpus)
+        else:
+            batches = make_batches(
+                corpus, batch_size=cfg.batch_size,
+                min_bucket_len=cfg.min_bucket_len,
+            )
+            num_docs = corpus.num_docs
         result = self._trainer.fit(
             batches,
-            corpus.num_docs,
+            num_docs,
             progress=progress,
             initial_log_beta=init_lb,
             initial_alpha=alpha if warm else None,
@@ -1450,7 +1525,90 @@ class WindowTrainer:
         result.plan["warm_start"] = {
             "value": bool(warm), "source": "window"
         }
+        if self.collective is not None:
+            result.plan["em_shards"] = {
+                "value": self._trainer.shard_plan.num_shards,
+                "source": "window",
+            }
+            result.plan["allreduce"] = {
+                "transport": self.collective.transport,
+                "nprocs": self.collective.num_processes,
+            }
         return result
+
+    def _shard_window(self, corpus: Corpus):
+        """Per-refresh shard plan + batches for the distributed driver.
+        The plan re-derives from the window's live doc count every
+        refresh (documents churn), but the trainer — and its jitted
+        partial-stats program — is REUSED: shard batches are plain
+        attributes on LDATrainer, and the census padding below keeps
+        the stacked group shapes the cached program was traced at."""
+        from ..parallel.shard_plan import plan_shards, resolve_em_shards
+
+        cfg = self.config
+        coll = self.collective
+        plan = plan_shards(
+            corpus.num_docs, coll.num_processes,
+            resolve_em_shards(cfg.em_shards, coll.num_processes),
+        )
+        shard_batches = {
+            s: pad_batch_census_pow2([
+                Batch(b.word_idx, b.counts,
+                      b.doc_index + plan.bounds[s][0], b.doc_mask)
+                for b in make_batches(
+                    corpus.shard(*plan.bounds[s]),
+                    batch_size=cfg.batch_size,
+                    min_bucket_len=cfg.min_bucket_len,
+                )
+            ])
+            for s in plan.owned(coll.rank)
+        }
+        self._trainer.shard_plan = plan
+        self._trainer._shard_batches = shard_batches
+        return (
+            [b for s in sorted(shard_batches)
+             for b in shard_batches[s]],
+            corpus.num_docs,
+        )
+
+
+def pad_batch_census_pow2(batches: "list[Batch]") -> "list[Batch]":
+    """Pad each (B, L)-shaped batch group's COUNT to a power of two
+    with fully-masked empty batches.
+
+    The window's vocab pads to pow2 capacity tiers and its batches pad
+    to the full batch size, but the distributed driver stacks same-
+    shaped batches into [NB, B, L] groups — and NB is the one shape
+    left keyed on the raw doc census, so a window gaining one batch
+    would retrace the partial-stats program.  Census tiers close the
+    gap: NB pads to pow2 exactly like the vocabulary does.  A pad
+    batch is inert by the same mechanism as in-batch pad rows —
+    doc_mask 0 zeroes its suff-stats/likelihood contributions, and the
+    gamma scatter selects no rows (doc_index 0 is never read)."""
+    groups: "dict[tuple, list[Batch]]" = {}
+    order: list = []
+    for b in batches:
+        key = b.word_idx.shape
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(b)
+    out: "list[Batch]" = []
+    for key in order:
+        grp = groups[key]
+        target = 1
+        while target < len(grp):
+            target *= 2
+        bb, ll = key
+        for _ in range(target - len(grp)):
+            grp.append(Batch(
+                np.zeros((bb, ll), np.int32),
+                np.zeros((bb, ll), np.float32),
+                np.zeros((bb,), np.int32),
+                np.zeros((bb,), np.float32),
+            ))
+        out.extend(grp)
+    return out
 
 
 def resolve_estep_engine(

@@ -25,6 +25,7 @@ from .mesh import (
     vocab_sharding,
 )
 from .shard_plan import ShardPlan, plan_shards, resolve_em_shards
+from .tiers import sync_capacity_tier
 from .sharded import (
     make_data_parallel_e_step,
     make_sharded_score_fn,
@@ -57,6 +58,7 @@ __all__ = [
     "ShardPlan",
     "plan_shards",
     "resolve_em_shards",
+    "sync_capacity_tier",
     "make_data_parallel_e_step",
     "make_sharded_score_fn",
     "make_vocab_sharded_dense_e_step",

@@ -1,0 +1,52 @@
+"""Rank-synchronized vocabulary capacity tiers for distributed
+window training.
+
+The continuous window (dataplane/window.py) pads its vocabulary to
+power-of-two capacity tiers so vocab churn inside a tier never changes
+a compiled [K, V] shape.  Distributed refreshes add a cross-rank
+hazard: each rank's window grows its vocabulary from the slices IT
+ingested, so two ranks can legally sit in different tiers — and the
+distributed EM driver's allreduce ships [V, K] sufficient statistics
+whose byte layout every rank must agree on, while the parity assert
+requires bit-identical models.  A rank-divergent tier is therefore not
+a performance bug but a correctness failure.
+
+`sync_capacity_tier` closes it: every rank contributes its LOCAL
+requirement (live vocab under its floor), the maximum wins, and every
+rank reserves that tier in its window (`CorpusWindow.
+reserve_capacity`) BEFORE the snapshot — so all ranks snapshot, build
+trainers, and compile at the same [K, V].  Tiers are monotone
+(capacity never shrinks while a service runs), so one slow rank can
+only ever pull the fleet UP a tier, never bounce it.
+"""
+
+from __future__ import annotations
+
+
+def sync_capacity_tier(collective, local_vocab: int, floor: int, *,
+                       tag: str, journal=None) -> int:
+    """Agree on one pow2 vocab capacity tier across all ranks.
+
+    Returns the agreed capacity (== the local one when single-process
+    or already in the max tier).  Journals `{"kind": "tier_sync"}`
+    when the sync actually RAISED this rank's tier — the event that
+    explains a retrace-free run suddenly minting a new program family.
+    """
+    from ..dataplane.window import pow2_capacity
+
+    local = pow2_capacity(int(local_vocab), int(floor))
+    if collective is None or collective.num_processes == 1:
+        return local
+    tiers = collective.allgather_obj(local, tag)
+    agreed = max(int(t) for t in tiers)
+    if agreed != local and journal is not None:
+        journal = getattr(journal, "journal", journal)
+        try:
+            journal.append({
+                "kind": "tier_sync", "tag": tag, "local": local,
+                "agreed": agreed, "rank": collective.rank,
+                "nprocs": collective.num_processes,
+            })
+        except Exception:
+            pass     # telemetry must never take down the service
+    return agreed

@@ -1526,7 +1526,9 @@ def build_parser() -> argparse.ArgumentParser:
         "(replaces ml_ops.sh YYYYMMDD {flow|dns} [TOL]); "
         "`ml_ops serve --help` for the streaming scoring service, "
         "`ml_ops continuous --help` for windowed streaming ingestion "
-        "with warm-start EM and drift-gated publishes",
+        "with warm-start EM and drift-gated publishes "
+        "(--stream/--replicated composes the multi-tenant standing "
+        "service over the replica fleet)",
     )
     from ..sources import names as source_names
 
@@ -1744,7 +1746,10 @@ def main(argv: list[str] | None = None) -> int:
     # (runner/continuous.py): a standing train-and-serve loop — ring-
     # buffered corpus window, warm-start EM refreshes, drift-gated
     # fleet publishes — rather than a per-day batch run, so it routes
-    # before the YYYYMMDD parser like serve.
+    # before the YYYYMMDD parser like serve.  With `--stream ...
+    # --replicated N` it is the COMPOSED standing service: N tenants
+    # share one train/serve co-scheduler (preemptible refresh chunks)
+    # and publish through the replicated router fleet.
     if argv and argv[0] == "continuous":
         from . import continuous
 

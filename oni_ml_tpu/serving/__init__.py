@@ -72,6 +72,7 @@ admission-window occupancy, journaling every decision.
 
 from .autoscale import AutoScaler
 from .batcher import BatchScorer, ScoreFuture
+from .coscheduler import CoScheduler
 from .fleet import (
     FleetRegistry,
     FleetScorer,
@@ -117,6 +118,7 @@ from .residency import (
 
 __all__ = [
     "BatchScorer",
+    "CoScheduler",
     "ScoreFuture",
     "FleetRegistry",
     "FleetScorer",

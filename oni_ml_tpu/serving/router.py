@@ -314,7 +314,13 @@ class FleetRouter:
         self._tenants: dict = {}       # tenant -> {spec, cuts, model, version}
         self._route: "dict[str, str]" = {}
         self._shadow: "dict[str, str | None]" = {}
-        self._hosted: "dict[str, set]" = {}
+        # replica -> {tenant: router_version last successfully pushed}.
+        # The version is what publish/drain/failover convergence keys
+        # on: membership alone cannot distinguish "hosts the tenant"
+        # from "hosts the tenant at the CURRENT model", and the
+        # drain/publish race (a re-placement concurrent with a publish
+        # fan-out) is exactly a replica holding the former.
+        self._hosted: "dict[str, dict]" = {}
         self._inflight: "dict[int, _Hop]" = {}
         self._inflight_by_replica: "dict[str, int]" = {}
         self._next_id = 0
@@ -371,7 +377,7 @@ class FleetRouter:
             except Exception:
                 pass
         with self._cond:
-            self._hosted.setdefault(replica_id, set())
+            self._hosted.setdefault(replica_id, {})
             self._inflight_by_replica.setdefault(replica_id, 0)
             self._edge.setdefault(replica_id, {
                 "events": 0, "bytes": 0, "errors": 0, "resends": 0,
@@ -488,7 +494,14 @@ class FleetRouter:
                                   "connected")
         link.call(req)
         with self._cond:
-            self._hosted.setdefault(replica_id, set()).add(tenant)
+            # Record the version this push CARRIED, monotone: a stale
+            # concurrent push must not roll the record back below what
+            # the replica actually holds (the replica itself keeps the
+            # max it has seen).
+            hosted = self._hosted.setdefault(replica_id, {})
+            have = hosted.get(tenant)
+            if have is None or req["router_version"] > have:
+                hosted[tenant] = req["router_version"]
 
     # -- scoring path --------------------------------------------------------
 
@@ -632,7 +645,18 @@ class FleetRouter:
                 ) -> int:
         """Fan one tenant's refreshed model out to its primary AND
         shadow — both stay fresh, so promotion never serves a stale
-        model.  Returns the router-level version."""
+        model.  Returns the router-level version.
+
+        The fan-out target set is computed under the lock but pushed
+        outside it, so a CONCURRENT re-placement (drain_replica,
+        join_replica, a failover promotion) can route the tenant onto
+        a replica this publish never covered — leaving primary and
+        shadow on DIFFERENT model versions until the next refresh.
+        The re-validation loop below closes that race: after the
+        pushes land, re-read the live route/shadow against the
+        per-replica pushed-version ledger (`_hosted`) and re-push any
+        mismatch, until the target set is stable or a newer publish
+        has taken over convergence."""
         with self._cond:
             if tenant not in self._tenants:
                 raise KeyError(f"unknown tenant {tenant!r}")
@@ -652,7 +676,9 @@ class FleetRouter:
                     "source": source, "router_version": version,
                 })
                 with self._cond:
-                    self._hosted.setdefault(r, set()).add(tenant)
+                    hosted = self._hosted.setdefault(r, {})
+                    if hosted.get(tenant, 0) < version:
+                        hosted[tenant] = version
             except Exception as e:
                 # The replica now holds a STALE model (or none): drop
                 # it from _hosted so the failover/drain backfill
@@ -661,12 +687,51 @@ class FleetRouter:
                 # later promotion would silently serve the superseded
                 # model.
                 with self._cond:
-                    self._hosted.get(r, set()).discard(tenant)
+                    self._hosted.get(r, {}).pop(tenant, None)
                 self._journal_safe({
                     "kind": "route", "edge": r, "event": "publish_error",
                     "tenant": tenant, "error": repr(e)[:200],
                 })
+        self._converge_publish(tenant, version)
         return version
+
+    def _converge_publish(self, tenant: str, version: int) -> None:
+        """Re-validate a publish's fan-out against LIVE membership:
+        any current route/shadow holder whose pushed-version ledger
+        entry is below `version` gets a re-push (through
+        `_push_tenant`, which always carries the latest model).
+        Bounded attempts — a target set churning faster than the
+        pushes land is a fleet in active failover, and the failover
+        backfill owns convergence there."""
+        for _ in range(4):
+            with self._cond:
+                if self._tenants[tenant]["version"] != version:
+                    return    # superseded: the newer publish converges
+                targets = [self._route.get(tenant)]
+                if self._shadow.get(tenant):
+                    targets.append(self._shadow[tenant])
+                stale = [
+                    r for r in targets
+                    if r and r in self._links
+                    and self._hosted.get(r, {}).get(tenant, 0) < version
+                ]
+            if not stale:
+                return
+            self._journal_safe({
+                "kind": "publish_repair", "tenant": tenant,
+                "version": version, "router": self.router_id,
+                "replicas": stale,
+            })
+            for r in stale:
+                try:
+                    self._push_tenant(r, tenant)
+                except Exception as e:
+                    self._journal_safe({
+                        "kind": "route", "edge": r,
+                        "event": "publish_error",
+                        "tenant": tenant, "error": repr(e)[:200],
+                    })
+                    return  # link died mid-repair; failover re-pushes
 
     def _dec_inflight_locked(self, replica_id: str, n: int) -> None:
         """Caller holds self._cond.  Shrink one edge's outstanding
@@ -797,14 +862,16 @@ class FleetRouter:
             for t in promoted + reshadowed:
                 with self._cond:
                     targets = [self._route.get(t), self._shadow.get(t)]
-                    hosted = {r: self._hosted.get(r, set())
-                              for r in targets if r}
-                for r in targets:
-                    if r and t not in hosted.get(r, set()):
-                        try:
-                            self._push_tenant(r, t)
-                        except Exception:
-                            pass
+                    want = self._tenants[t]["version"]
+                    stale = [
+                        r for r in targets
+                        if r and self._hosted.get(r, {}).get(t, 0) < want
+                    ]
+                for r in stale:
+                    try:
+                        self._push_tenant(r, t)
+                    except Exception:
+                        pass
         recovery_s = time.perf_counter() - t_detect
         record = {
             "kind": "failover", "replica": replica_id,
@@ -933,14 +1000,16 @@ class FleetRouter:
         for t in moved + reshadowed:
             with self._cond:
                 targets = [self._route.get(t), self._shadow.get(t)]
-                hosted = {r: set(self._hosted.get(r, set()))
-                          for r in targets if r}
-            for r in targets:
-                if r and t not in hosted.get(r, set()):
-                    try:
-                        self._push_tenant(r, t)
-                    except Exception:
-                        pass
+                want = self._tenants[t]["version"]
+                stale = [
+                    r for r in targets
+                    if r and self._hosted.get(r, {}).get(t, 0) < want
+                ]
+            for r in stale:
+                try:
+                    self._push_tenant(r, t)
+                except Exception:
+                    pass
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._cond:

@@ -367,6 +367,27 @@ def _patch_phases(bench, monkeypatch):
         },
     )
     monkeypatch.setattr(
+        bench, "bench_continuous_replicated",
+        lambda *a, **k: {
+            "replicas": 2, "replay_speed": 1440.0, "n_events": 12_000,
+            "events_scored": 9970, "failed_futures": 0, "failovers": 1,
+            "killed_replica": "r1",
+            "freshness_p50_s": 1.3, "freshness_p99_s": 8.8,
+            "freshness_event_p50_min": 0.04,
+            "freshness_event_p99_min": 82.6,
+            "p99_idle_ms": 92.8, "p99_during_refresh_ms": 106.6,
+            "refresh_over_idle_ratio": 1.15,
+            "p99_idle_uncoscheduled_ms": 62.3,
+            "p99_during_refresh_uncoscheduled_ms": 63.0,
+            "yield_wait_p99_ms": 7.1, "preempt_wait_p99_ms": None,
+            "train_chunks": 2031, "yields": 9, "preempts": 0,
+            "refreshes": 63, "publishes": 45,
+            "coalesced_refreshes": 31, "refresh_errors": 0,
+            "retraces_after_warmup": 0, "sustained_eps": 198.0,
+            "replay_wall_s": 60.6,
+        },
+    )
+    monkeypatch.setattr(
         bench, "bench_detection_quality",
         lambda *a, **k: {
             src: {"recall_at_k": 1.0, "precision_at_k": 1.0,
@@ -541,6 +562,7 @@ def test_bench_main_last_line_is_complete_record(capsys, monkeypatch):
         "serving_slo_replicated",
         "serving_crosshost",
         "streaming_freshness",
+        "continuous_replicated",
         "detection_quality",
         "distributed_em",
         "pipeline_e2e",

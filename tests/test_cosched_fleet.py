@@ -1,0 +1,459 @@
+"""The composed standing service (PR 20): train/serve co-scheduling,
+multi-tenant fleet composition with per-tenant freshness ledgers, the
+drain/publish-race convergence on the router, and chaos during a
+refresh.
+
+The contracts pinned hardest:
+
+* co-scheduler priority — a waiting serve slot blocks the NEXT train
+  chunk (never the current one), a train fit in flight delays a serve
+  slot by at most one chunk wall, and the starvation cap bounds how
+  long a saturated serve side can lock training out;
+* per-tenant event-time freshness — a tenant replaying YESTERDAY's
+  events next to a tenant replaying today's must get freshness
+  numbers off its OWN clock, not the fleet's newest slice;
+* drain/publish race — a publish whose fan-out raced a re-placement
+  converges: every live route/shadow holder ends on the latest
+  version, verified end-to-end by the version a scored future reports;
+* chaos during refresh — killing the primary mid-refresh drops zero
+  score futures and the in-flight fit's publish lands exactly once
+  through the promoted shadow (journal kinds: failover, cosched,
+  freshness).
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from oni_ml_tpu.config import (  # noqa: E402
+    ContinuousConfig,
+    PipelineConfig,
+    ServingConfig,
+)
+from oni_ml_tpu.runner.continuous import (  # noqa: E402
+    FleetContinuousService,
+    IngestSlice,
+    interleave_streams,
+    paced_tagged,
+)
+from oni_ml_tpu.serving import (  # noqa: E402
+    CoScheduler,
+    FleetRouter,
+    ReplicaServer,
+    TenantSpec,
+)
+
+
+# ---------------------------------------------------------------------------
+# CoScheduler
+# ---------------------------------------------------------------------------
+
+
+def test_cosched_refresh_active_brackets_fit():
+    cs = CoScheduler()
+    assert not cs.refresh_active
+    with cs.train_fit("t"):
+        assert cs.refresh_active
+    assert not cs.refresh_active
+    s = cs.summary()
+    assert s["train_chunks"] == 0 and s["serve_slots"] == 0
+
+
+def test_cosched_waiting_serve_blocks_next_chunk():
+    """A serve slot HELD keeps the next train chunk out (and counts
+    one contended yield); the chunk proceeds once the slot clears."""
+    cs = CoScheduler()
+    entered = threading.Event()
+
+    def one_chunk():
+        with cs.train_fit("t"):
+            with cs.train_chunk():
+                entered.set()
+
+    th = threading.Thread(target=one_chunk)
+    with cs.serve_slot():
+        th.start()
+        assert not entered.wait(0.25), "chunk ran under a live slot"
+    assert entered.wait(5.0), "chunk never ran after slot release"
+    th.join(timeout=5.0)
+    s = cs.summary()
+    assert s["yields"] == 1
+    assert s["yield_wait_s"] > 0
+
+
+def test_cosched_serve_waits_at_most_one_chunk():
+    """A serve slot arriving mid-chunk waits for THAT chunk only: the
+    train side parks before its next chunk while the slot runs."""
+    cs = CoScheduler()
+    stop = threading.Event()
+    in_chunk = threading.Event()
+
+    def train():
+        with cs.train_fit("t"):
+            while not stop.is_set():
+                with cs.train_chunk():
+                    in_chunk.set()
+                    time.sleep(0.05)
+
+    th = threading.Thread(target=train)
+    th.start()
+    try:
+        for _ in range(3):
+            assert in_chunk.wait(5.0)
+            in_chunk.clear()
+            with cs.serve_slot():
+                # Slot held: no chunk is active underneath us.
+                assert not cs._train_active
+    finally:
+        stop.set()
+        th.join(timeout=5.0)
+    s = cs.summary()
+    assert s["serve_slots"] == 3
+    assert s["preempts"] >= 1          # at least one contended wait
+    assert s["preempt_wait_p99_s"] is None or \
+        s["preempt_wait_p99_s"] < 5.0
+
+
+def test_cosched_starvation_cap_bounds_train_wait():
+    """A serve side that never drains cannot lock training out past
+    the starvation deadline."""
+    cs = CoScheduler(starvation_s=0.2)
+    holding = threading.Event()
+    release = threading.Event()
+
+    def serve_hold():
+        with cs.serve_slot():
+            holding.set()
+            release.wait(5.0)
+
+    th = threading.Thread(target=serve_hold)
+    th.start()
+    assert holding.wait(5.0)
+    t0 = time.perf_counter()
+    with cs.train_fit("t"):
+        with cs.train_chunk():
+            waited = time.perf_counter() - t0
+    release.set()
+    th.join(timeout=5.0)
+    assert 0.15 <= waited < 2.0, waited
+
+
+def test_cosched_journals_fit_rollup():
+    from oni_ml_tpu.telemetry import Recorder
+
+    records = []
+
+    class _J:
+        def append(self, rec, sync=False):
+            records.append(rec)
+
+    cs = CoScheduler(recorder=Recorder(), journal=_J())
+    with cs.train_fit("acme"):
+        with cs.train_chunk():
+            pass
+    fits = [r for r in records
+            if r.get("kind") == "cosched" and r.get("event") == "fit"]
+    assert len(fits) == 1
+    assert fits[0]["tenant"] == "acme"
+    assert fits[0]["chunks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet composition helpers
+# ---------------------------------------------------------------------------
+
+
+def _flow_line(rng, sip, dip, dport, h=None):
+    h = int(rng.integers(0, 24)) if h is None else h
+    return (
+        "2016-01-22 00:00:00,2016,1,22,"
+        f"{h},{int(rng.integers(0, 60))},{int(rng.integers(0, 60))},0.0,"
+        f"{sip},{dip},{int(rng.integers(1024, 60000))},{dport},TCP,,0,0,"
+        f"{int(rng.integers(1, 100))},{int(rng.integers(40, 100000))},"
+        "0,0,0,0,0,0,0,0,0"
+    )
+
+
+def _slice(rng, idx, n=120, t_base=0.0):
+    ports = (80, 443, 22, 53)
+    lines = [
+        _flow_line(rng, f"10.0.0.{int(rng.integers(0, 24))}",
+                   f"10.1.0.{int(rng.integers(0, 12))}",
+                   ports[int(rng.integers(0, len(ports)))])
+        for _ in range(n)
+    ]
+    return IngestSlice(lines=lines, t0=t_base + idx * 600.0,
+                       t1=t_base + (idx + 1) * 600.0, index=idx)
+
+
+def _fleet_config(tmp_path):
+    config = PipelineConfig(
+        data_dir=str(tmp_path),
+        continuous=ContinuousConfig(
+            window_s=1800.0, refresh_every_s=1200.0,
+            min_refresh_docs=8, drift_tol_nats=0.8,
+            drift_min_history=2, vocab_floor=512, batch_size=64,
+            holdout_frac=0.3,
+        ),
+    )
+    return dataclasses.replace(
+        config,
+        lda=dataclasses.replace(config.lda, num_topics=4,
+                                em_max_iters=20),
+        serving=ServingConfig(fleet_max_batch=32, fleet_max_wait_ms=5.0,
+                              device_score_min=None),
+    )
+
+
+def _journal_kinds(path):
+    kinds = set()
+    with open(path) as f:
+        for ln in f:
+            try:
+                kinds.add(json.loads(ln).get("kind"))
+            except json.JSONDecodeError:
+                pass
+    return kinds
+
+
+# ---------------------------------------------------------------------------
+# per-tenant freshness (satellite: the ledger is per tenant, not global)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_per_tenant_event_freshness(tmp_path):
+    """A tenant replaying YESTERDAY (event clock 24h behind) next to a
+    tenant replaying today must get event-time freshness off its OWN
+    slice clock: a global clock would charge the lagging tenant ~24h
+    of staleness at every publish."""
+    config = _fleet_config(tmp_path)
+    fleet = FleetContinuousService(
+        config, {"today": "flow", "yday": "flow"},
+        out_dir=str(tmp_path / "fleet"), coscheduler=True,
+        warmup_refreshes=2,
+    )
+    rng = np.random.default_rng(3)
+    lag = 86400.0
+    try:
+        for idx in range(6):
+            fleet.ingest("today", _slice(rng, idx))
+            fleet.ingest("yday", _slice(rng, idx, t_base=-lag))
+    finally:
+        payload = fleet.close()
+    t_today = payload["tenants"]["today"]
+    t_yday = payload["tenants"]["yday"]
+    assert t_today["freshness_samples"] > 0
+    assert t_yday["freshness_samples"] > 0
+    # Per-tenant clock: the lagging tenant's event freshness is the
+    # cadence lag + refresh wall (minutes), nowhere near 24h.
+    assert t_yday["freshness_event_p99_min"] < lag / 60.0 / 4
+    assert t_yday["freshness_event_p99_min"] < 120.0
+    # The shared journal's freshness records are tenant-keyed.
+    jpath = os.path.join(str(tmp_path / "fleet"), "run_journal.jsonl")
+    tenants_seen = set()
+    with open(jpath) as f:
+        for ln in f:
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "freshness":
+                tenants_seen.add(rec.get("tenant"))
+    assert tenants_seen == {"today", "yday"}
+    # Both tenants trained and published through the shared cosched.
+    assert payload["cosched"]["train_chunks"] > 0
+    assert payload["publishes"] >= 2
+    assert payload["refresh_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# drain/publish race (satellite: fan-out vs live membership)
+# ---------------------------------------------------------------------------
+
+
+def _dns_fleet(n_replicas=3, journal=None):
+    from oni_ml_tpu.runner.serve import _synthetic_day
+
+    cfg = ServingConfig(fleet_max_batch=32, fleet_max_wait_ms=5.0,
+                        device_score_min=None)
+    replicas = {
+        f"r{i}": ReplicaServer(f"r{i}", cfg) for i in range(n_replicas)
+    }
+    router = FleetRouter(cfg, journal=journal)
+    for rid, rep in replicas.items():
+        router.connect_replica(rid, rep.host, rep.port)
+    rows, model, cuts = _synthetic_day(n_events=48, seed=11)
+    router.add_tenant(TenantSpec(tenant="t0", dsource="dns"), cuts,
+                      model)
+    router.start(warmup=False)
+    return replicas, router, rows, model
+
+
+def test_publish_converges_stale_fanout_target(tmp_path):
+    """The drain/publish race, distilled: a route/shadow holder whose
+    push was lost (simulated by erasing its ledger entry) must be
+    re-pushed by the convergence pass — verified end-to-end by the
+    version a scored future reports."""
+    replicas, router, rows, model = _dns_fleet()
+    try:
+        v = router.publish("t0", model, source="test")
+        primary = router.placement()["t0"].primary
+        # Simulate the lost push the race produces: the ledger says
+        # the primary never got v (concurrent drain re-routed the
+        # fan-out past it).
+        with router._cond:
+            router._hosted[primary].pop("t0", None)
+        router._converge_publish("t0", v)
+        with router._cond:
+            assert router._hosted[primary].get("t0") == v
+        fut = router.submit("t0", rows[0])
+        router.flush()
+        _, got_version = fut.result(timeout=60.0)
+        assert got_version == v
+    finally:
+        router.close()
+        for rep in replicas.values():
+            rep.stop()
+
+
+def test_publish_during_drain_converges(tmp_path):
+    """Publish fanning out WHILE the primary drains: whatever
+    interleaving the scheduler picks, every live route/shadow holder
+    ends on the latest version and a scored future serves it."""
+    replicas, router, rows, model = _dns_fleet()
+    try:
+        primary = router.placement()["t0"].primary
+        versions = []
+
+        def do_publish():
+            versions.append(router.publish("t0", model, source="race"))
+
+        th = threading.Thread(target=do_publish)
+        th.start()
+        drained = router.drain_replica(primary)
+        th.join(timeout=60.0)
+        assert not th.is_alive()
+        assert drained["moved"] >= 1
+        v = versions[0]
+        place = router.placement()["t0"]
+        with router._cond:
+            for r in (place.primary, place.shadow):
+                if r and r in router._links:
+                    assert router._hosted[r].get("t0", 0) >= v, (
+                        r, dict(router._hosted)
+                    )
+        fut = router.submit("t0", rows[1])
+        router.flush()
+        _, got_version = fut.result(timeout=60.0)
+        assert got_version >= v
+    finally:
+        router.close()
+        for rep in replicas.values():
+            rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos during refresh (satellite: SIGKILL mid-chunk, zero drops)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_kill_primary_mid_refresh(tmp_path):
+    """Kill a tenant's primary replica while its refresh fit is
+    mid-chunk (ReplicaServer.kill: SIGKILL minus the process — the
+    composed bench does the real-subprocess variant).  The contract:
+    zero failed score futures (admission-journal replay through the
+    promoted shadow), the in-flight fit's publish lands exactly once
+    (versions stay monotone and consistent), and the journal pins the
+    episode via its failover/cosched/freshness kinds."""
+    from oni_ml_tpu.telemetry import Journal
+
+    config = _fleet_config(tmp_path)
+    scfg = config.serving
+    replicas = {f"r{i}": ReplicaServer(f"r{i}", scfg) for i in range(3)}
+    router_journal = Journal(str(tmp_path / "router_journal.jsonl"))
+    router = FleetRouter(scfg, journal=router_journal)
+    for rid, rep in replicas.items():
+        router.connect_replica(rid, rep.host, rep.port)
+    fleet = FleetContinuousService(
+        config, {"acme": "flow", "globex": "flow"},
+        out_dir=str(tmp_path / "fleet"), router=router,
+        coscheduler=True, warmup_refreshes=2,
+    )
+    rng = np.random.default_rng(5)
+    killed = None
+    try:
+        for idx in range(4):
+            for t in ("acme", "globex"):
+                fleet.ingest(t, _slice(rng, idx))
+        deadline = time.time() + 120.0
+        while not fleet.binding.ready("acme"):
+            assert time.time() < deadline, "router never bootstrapped"
+            time.sleep(0.1)
+        idx = 4
+        deadline = time.time() + 180.0
+        while idx < 40 and time.time() < deadline:
+            for t in ("acme", "globex"):
+                fleet.ingest(t, _slice(rng, idx))
+            if killed is None and fleet.cosched.refresh_active:
+                # A refresh fit is mid-chunk RIGHT NOW: kill acme's
+                # primary with scores and the fit both in flight.
+                victim = router.placement()["acme"].primary
+                replicas[victim].kill()
+                killed = (victim, idx)
+            if killed is not None and idx - killed[1] >= 4:
+                break
+            idx += 1
+        assert killed is not None, "no refresh ever active during feed"
+    finally:
+        payload = fleet.close()
+        router.close()
+        for rep in replicas.values():
+            rep.stop()
+        router_journal.close()
+    # Zero dropped score futures across the kill.
+    assert payload["serving"]["failed_futures"] == 0, payload["serving"]
+    assert payload["serving"]["events_scored"] > 0
+    # The in-flight fit published exactly once: the binding's version
+    # census matches the router's, monotone, no double-publish.
+    for t in ("acme", "globex"):
+        assert (payload["serving"]["versions"][t]
+                == router._tenants[t]["version"])
+    assert payload["refresh_errors"] == 0
+    assert len(payload["router"]["failovers"]) >= 1
+    # Journal pins: the episode is reconstructable from kinds.
+    assert "failover" in _journal_kinds(
+        str(tmp_path / "router_journal.jsonl"))
+    fleet_kinds = _journal_kinds(
+        os.path.join(str(tmp_path / "fleet"), "run_journal.jsonl"))
+    assert {"cosched", "freshness"} <= fleet_kinds
+
+
+# ---------------------------------------------------------------------------
+# stream interleaving helpers
+# ---------------------------------------------------------------------------
+
+
+def test_interleave_streams_orders_by_event_time():
+    a = [IngestSlice(lines=["x"], t0=0, t1=600, index=0),
+         IngestSlice(lines=["x"], t0=1200, t1=1800, index=1)]
+    b = [IngestSlice(lines=["y"], t0=600, t1=1200, index=0)]
+    tagged = interleave_streams({"a": a, "b": b})
+    assert [(t, sl.t1) for t, sl in tagged] == [
+        ("a", 600), ("b", 1200), ("a", 1800)]
+
+
+def test_paced_tagged_stamps_arrivals():
+    a = [IngestSlice(lines=["x"], t0=0, t1=600, index=0)]
+    b = [IngestSlice(lines=["y"], t0=600, t1=1200, index=0)]
+    out = list(paced_tagged(interleave_streams({"a": a, "b": b}),
+                            float("inf")))
+    assert [t for t, _ in out] == ["a", "b"]
+    assert all(sl.arrival_wall > 0 for _, sl in out)

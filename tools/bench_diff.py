@@ -91,6 +91,42 @@ def _streaming_rows(name: str, old: dict, new: dict,
     return rows
 
 
+# Composed standing-service phase (continuous x fleet x cosched):
+# direction per key — the fleet freshness latencies (wall seconds and
+# speed-invariant event-time minutes) and the serve-latency tails are
+# lower-better; `p99_during_refresh_ms` is the co-scheduler's
+# acceptance number (the serve tail WHILE a refresh fit holds the
+# process), and the yield/preempt waits are the arbitration's own
+# priced cost.  `sustained_eps` is the replayed multi-tenant drain
+# rate (higher-better).  The chaos bits (failed_futures == 0,
+# failovers >= 1, zero retraces) are asserted by the test suite and
+# reported in the payload, not trended here — they are correctness
+# bits, not performance trends.
+_CONTINUOUS_REPLICATED_PHASE = "continuous_replicated"
+_CONTINUOUS_REPLICATED_KEYS = (
+    ("freshness_p50_s", "s"),                    # lower-better
+    ("freshness_p99_s", "s"),
+    ("freshness_event_p50_min", "min"),          # minutes; latency
+    ("freshness_event_p99_min", "min"),
+    ("p99_idle_ms", "ms"),                       # lower-better
+    ("p99_during_refresh_ms", "ms"),             # the cosched claim
+    ("yield_wait_p99_ms", "ms"),
+    ("preempt_wait_p99_ms", "ms"),
+    ("sustained_eps", "events/sec"),             # higher-better
+)
+
+
+def _continuous_replicated_rows(name: str, old: dict, new: dict,
+                                threshold_pct: float) -> "list[dict]":
+    rows = []
+    for key, unit in _CONTINUOUS_REPLICATED_KEYS:
+        r = _rel_row(f"{name}.{key}", old.get(key), new.get(key), unit,
+                     threshold_pct)
+        if r:
+            rows.append(r)
+    return rows
+
+
 # Detection-quality phase: every key is HIGHER-better —
 # precision/recall@k are fractions of attacks ranked inside the top-k,
 # score_separation is the median benign-vs-attack log-score gap in
@@ -460,9 +496,26 @@ def diff_payloads(old: dict, new: dict, threshold_pct: float = 10.0,
     if isinstance(o, dict) and isinstance(n, dict):
         rows.extend(_streaming_rows(f"phase:{_STREAMING_PHASE}", o, n,
                                     threshold_pct, ll_drop))
-    if "freshness_p50_s" in old and "freshness_p50_s" in new:
+    if ("freshness_p50_s" in old and "freshness_p50_s" in new
+            and "p99_during_refresh_ms" not in new):
+        # A composed continuous_replicated capture also carries
+        # freshness keys; its own branch below owns them there.
         rows.extend(_streaming_rows("headline", old, new,
                                     threshold_pct, ll_drop))
+    # Composed standing-service keys (freshness + serve-during-refresh
+    # tails + yield/preempt waits lower-better, sustained eps
+    # higher-better) — phase payloads and composed-headline captures
+    # (sentinel: p99_during_refresh_ms, unique to this phase).
+    o, n = (old_sec.get(_CONTINUOUS_REPLICATED_PHASE),
+            new_sec.get(_CONTINUOUS_REPLICATED_PHASE))
+    if isinstance(o, dict) and isinstance(n, dict):
+        rows.extend(_continuous_replicated_rows(
+            f"phase:{_CONTINUOUS_REPLICATED_PHASE}", o, n,
+            threshold_pct))
+    if ("p99_during_refresh_ms" in old
+            and "p99_during_refresh_ms" in new):
+        rows.extend(_continuous_replicated_rows(
+            "headline", old, new, threshold_pct))
     # Detection-quality keys (all higher-better: recall/precision@k,
     # score separation; per-source sections too) — phase payloads and
     # quality-headline captures.

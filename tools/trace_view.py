@@ -54,6 +54,7 @@ def journal_to_trace(records: "list[dict]") -> dict:
         "args": {"name": "oni_ml_tpu journal"},
     }]
     open_stages: dict = {}
+    cosched_lanes = False
     for rec in records:
         kind = rec.get("kind")
         ns = rec.get("mono_ns")
@@ -393,6 +394,82 @@ def journal_to_trace(records: "list[dict]") -> dict:
                           "resend_failures", "recovery_s")
                          if k in rec},
             })
+        elif kind == "cosched":
+            # Train-vs-serve priority lanes: refresh fits render as
+            # complete spans on a low-priority "train" lane (tid 1,
+            # start reconstructed from the rollup's wall_s), each
+            # contended chunk entry as a YIELD instant there, and each
+            # scoring flush that waited out a chunk as a PREEMPT
+            # instant on the high-priority "serve" lane (tid 2) — the
+            # co-scheduler's arbitration drawn as two tracks whose
+            # instants line up where they contend.
+            if not cosched_lanes:
+                cosched_lanes = True
+                for tid, lane in ((1, "train (refresh fits, low prio)"),
+                                  (2, "serve (scoring, high prio)")):
+                    events.append({
+                        "name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": lane},
+                    })
+            event = rec.get("event")
+            if event == "fit":
+                wall_ns = int(float(rec.get("wall_s", 0)) * 1e9)
+                events.append({
+                    "name": f"refresh fit {rec.get('tenant', '?')}",
+                    "ph": "X", "cat": "cosched",
+                    "ts": us(ns - wall_ns), "dur": wall_ns / 1e3,
+                    "pid": pid, "tid": 1,
+                    "args": {k: rec[k] for k in
+                             ("tenant", "chunks", "yields",
+                              "yield_wait_s", "capped") if k in rec},
+                })
+            elif event == "yield":
+                events.append({
+                    "name": ("YIELD (capped)" if rec.get("capped")
+                             else "YIELD"),
+                    "ph": "i", "s": "t", "ts": us(ns), "pid": pid,
+                    "tid": 1, "args": {"wait_ms": rec.get("wait_ms")},
+                })
+                events.append({
+                    "name": "cosched yield_wait_ms", "ph": "C",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"wait_ms": rec.get("wait_ms", 0)},
+                })
+            elif event == "preempt":
+                events.append({
+                    "name": "PREEMPT", "ph": "i", "s": "t",
+                    "ts": us(ns), "pid": pid, "tid": 2,
+                    "args": {"wait_ms": rec.get("wait_ms")},
+                })
+                events.append({
+                    "name": "cosched preempt_wait_ms", "ph": "C",
+                    "ts": us(ns), "pid": pid, "tid": 0,
+                    "args": {"wait_ms": rec.get("wait_ms", 0)},
+                })
+        elif kind == "tier_sync":
+            # Rank-synchronized vocab capacity raise: the one event
+            # that explains a retrace-free distributed run minting a
+            # new program family.
+            events.append({
+                "name": (f"TIER SYNC {rec.get('local')} -> "
+                         f"{rec.get('agreed')}"),
+                "ph": "i", "s": "g", "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("tag", "rank", "nprocs") if k in rec},
+            })
+        elif kind == "publish_repair":
+            events.append({
+                "name": f"publish REPAIR: {rec.get('tenant')}",
+                "ph": "i", "s": "g", "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {k: rec[k] for k in
+                         ("version", "router", "replicas") if k in rec},
+            })
+        elif kind == "refresh_abandon":
+            events.append({
+                "name": f"refresh ABANDONED: {rec.get('tenant')}",
+                "ph": "i", "s": "g", "ts": us(ns), "pid": pid, "tid": 0,
+                "args": {"error": rec.get("error")},
+            })
         elif kind == "backend_lost":
             events.append({
                 "name": "BACKEND LOST", "ph": "i", "s": "g",
@@ -544,6 +621,34 @@ def continuous_table(records: "list[dict]") -> "dict | None":
     }
 
 
+def cosched_table(records: "list[dict]") -> "dict | None":
+    """Train/serve co-scheduler rollup from `cosched` records: per-fit
+    chunk/yield tallies plus the contended-wait instants — the
+    terminal answer to "what did refresh fits cost the serve tail"."""
+    fits = [r for r in records
+            if r.get("kind") == "cosched" and r.get("event") == "fit"]
+    yields = [r for r in records
+              if r.get("kind") == "cosched" and r.get("event") == "yield"]
+    preempts = [r for r in records
+                if r.get("kind") == "cosched"
+                and r.get("event") == "preempt"]
+    if not (fits or yields or preempts):
+        return None
+    return {
+        "fits": len(fits),
+        "fit_wall_s": round(
+            sum(float(r.get("wall_s") or 0.0) for r in fits), 3),
+        "chunks": sum(int(r.get("chunks") or 0) for r in fits),
+        "yields": len(yields),
+        "yield_wait_ms": round(
+            sum(float(r.get("wait_ms") or 0.0) for r in yields), 3),
+        "capped_yields": sum(1 for r in yields if r.get("capped")),
+        "preempts": len(preempts),
+        "preempt_wait_ms": round(
+            sum(float(r.get("wait_ms") or 0.0) for r in preempts), 3),
+    }
+
+
 def quality_table(records: "list[dict]") -> "dict | None":
     """Detection-quality rollup from `quality_gate` records: the gate
     tally plus the LAST verdict's per-scenario recall — the terminal
@@ -686,6 +791,15 @@ def print_summary(records: "list[dict]", dropped: int,
             print(f"  last held-out ll {cont['last_ll']}"
                   + (f", worst freshness {worst:.3f}s"
                      if worst is not None else ""), file=out)
+    cos = cosched_table(records)
+    if cos:
+        print("train/serve co-scheduler (refresh fits vs scoring):",
+              file=out)
+        print(f"  fits={cos['fits']} ({cos['fit_wall_s']}s wall, "
+              f"{cos['chunks']} chunks) yields={cos['yields']} "
+              f"({cos['yield_wait_ms']}ms, {cos['capped_yields']} "
+              f"capped) preempts={cos['preempts']} "
+              f"({cos['preempt_wait_ms']}ms)", file=out)
     qual = quality_table(records)
     if qual:
         print("detection quality (injection-suite gate):", file=out)
